@@ -1,0 +1,11 @@
+"""ops — GF(2^8) arithmetic and Reed-Solomon codec backends.
+
+Backends:
+  numpy  — pure-numpy reference implementation (conformance oracle)
+  native — C++ shared library (auto-vectorized), the CPU production path
+  tpu    — JAX/XLA bit-plane matmul on the MXU (the north star)
+
+All backends are bit-identical; see tests/test_rs_codec.py.
+"""
+
+from .codec import get_codec, ReedSolomonCodec  # noqa: F401

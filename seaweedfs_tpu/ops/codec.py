@@ -1,0 +1,181 @@
+"""Reed-Solomon codec API + backend registry.
+
+Semantics mirror the reference dependency's Encode/Reconstruct/ReconstructData
+(klauspost/reedsolomon, used at reference ec_encoder.go:118-134, 231-285 and
+store_ec.go:319-373): shards are equal-length byte rows, data rows are stored
+verbatim (systematic code), missing shards are None and are regenerated
+in place.
+
+Backend selection (the reference's `-ec.backend` analog, SURVEY §5.6):
+    get_codec(k, m, backend="numpy" | "native" | "tpu" | "auto")
+"auto" picks tpu if a TPU is visible, else native if the C++ library is
+built, else numpy. All backends produce bit-identical output.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import gf256
+
+
+class ReedSolomonCodec:
+    """Base class: matrix construction + reconstruction planning.
+
+    Subclasses implement _matmul(coeffs, data) — the GF(2^8) matrix-vector
+    product over byte rows — which is the only compute-heavy primitive.
+    """
+
+    backend = "abstract"
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 matrix_kind: str = "vandermonde"):
+        if data_shards <= 0 or parity_shards <= 0:
+            raise ValueError("data_shards and parity_shards must be > 0")
+        if data_shards + parity_shards > 256:
+            raise ValueError("k + m must be <= 256 in GF(2^8)")
+        self.k = data_shards
+        self.m = parity_shards
+        self.total = data_shards + parity_shards
+        self.matrix_kind = matrix_kind
+        self.matrix = gf256.build_matrix(self.k, self.total, matrix_kind)
+        self._decode_cache: dict = {}
+
+    # -- primitive ---------------------------------------------------------
+    def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data (k, n) uint8 -> parity (m, n) uint8."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if data.shape[0] != self.k:
+            raise ValueError(f"expected {self.k} data rows, got {data.shape[0]}")
+        return self._matmul(self.matrix[self.k:], data)
+
+    def encode_to_all(self, data: np.ndarray) -> np.ndarray:
+        """data (k, n) -> all shards (total, n); data rows verbatim."""
+        parity = self.encode(data)
+        return np.concatenate([np.asarray(data, dtype=np.uint8), parity], axis=0)
+
+    def _decode_coeffs(self, present: tuple) -> tuple:
+        """For a presence tuple, return (src_rows, inv_matrix) where
+        data = inv_matrix @ shards[src_rows]."""
+        key = present
+        hit = self._decode_cache.get(key)
+        if hit is not None:
+            return hit
+        src = [i for i, p in enumerate(present) if p][: self.k]
+        if len(src) < self.k:
+            raise ValueError(
+                f"too few shards: have {sum(present)}, need {self.k}")
+        sub = self.matrix[src, :]
+        inv = gf256.mat_inv(sub)
+        self._decode_cache[key] = (src, inv)
+        return src, inv
+
+    def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
+                    data_only: bool = False) -> List[np.ndarray]:
+        """Fill in missing (None) shards. Mirrors reference Reconstruct /
+        ReconstructData. Returns the full shard list (data-only mode leaves
+        missing parity as None)."""
+        shards = list(shards)
+        if len(shards) != self.total:
+            raise ValueError(f"expected {self.total} shards, got {len(shards)}")
+        present = tuple(s is not None for s in shards)
+        if all(present):
+            return shards
+        lens = {s.shape[-1] for s in shards if s is not None}
+        if len(lens) != 1:
+            raise ValueError("surviving shards have differing lengths")
+        src, inv = self._decode_coeffs(present)
+        survivors = np.stack([np.asarray(shards[i], dtype=np.uint8)
+                              for i in src], axis=0)
+        missing_data = [i for i in range(self.k) if shards[i] is None]
+        if missing_data:
+            rows = inv[missing_data, :]
+            out = self._matmul(rows, survivors)
+            for r, i in enumerate(missing_data):
+                shards[i] = out[r]
+        if not data_only:
+            missing_par = [i for i in range(self.k, self.total)
+                           if shards[i] is None]
+            if missing_par:
+                # parity row = matrix[row] @ data = (matrix[row] @ inv) @ survivors
+                coeffs = gf256.mat_mul(self.matrix[missing_par, :], inv)
+                out = self._matmul(coeffs, survivors)
+                for r, i in enumerate(missing_par):
+                    shards[i] = out[r]
+        return shards
+
+    def reconstruct_data(self, shards: Sequence[Optional[np.ndarray]]
+                         ) -> List[np.ndarray]:
+        return self.reconstruct(shards, data_only=True)
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        """True iff parity rows match the data rows."""
+        data = np.stack([np.asarray(s, dtype=np.uint8)
+                         for s in shards[: self.k]], axis=0)
+        parity = self.encode(data)
+        for i in range(self.m):
+            if not np.array_equal(parity[i],
+                                  np.asarray(shards[self.k + i], dtype=np.uint8)):
+                return False
+        return True
+
+
+class NumpyCodec(ReedSolomonCodec):
+    """Pure-numpy reference backend — the conformance oracle.
+
+    Inner loop: one 256-entry LUT gather + XOR per (output row, input row)
+    pair, equivalent to the reference dependency's galMulSlice without SIMD.
+    """
+
+    backend = "numpy"
+
+    def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+        coeffs = np.asarray(coeffs, dtype=np.uint8)
+        data = np.asarray(data, dtype=np.uint8)
+        r = coeffs.shape[0]
+        out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+        mt = gf256.MUL_TABLE
+        for i in range(r):
+            acc = out[i]
+            for j in range(coeffs.shape[1]):
+                c = coeffs[i, j]
+                if c == 0:
+                    continue
+                if c == 1:
+                    acc ^= data[j]
+                else:
+                    acc ^= mt[c][data[j]]
+        return out
+
+
+def get_codec(data_shards: int, parity_shards: int,
+              backend: str = "auto",
+              matrix_kind: str = "vandermonde") -> ReedSolomonCodec:
+    if backend == "auto":
+        from .rs_native import native_available
+        try:
+            import jax
+            has_tpu = any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            has_tpu = False
+        if has_tpu:
+            backend = "tpu"
+        elif native_available():
+            backend = "native"
+        else:
+            backend = "numpy"
+    if backend == "numpy":
+        return NumpyCodec(data_shards, parity_shards, matrix_kind)
+    if backend == "native":
+        from .rs_native import NativeCodec
+        return NativeCodec(data_shards, parity_shards, matrix_kind)
+    if backend == "tpu":
+        from .rs_tpu import TpuCodec
+        return TpuCodec(data_shards, parity_shards, matrix_kind)
+    raise ValueError(f"unknown backend {backend!r}")

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native EC codec shared library.
+# AVX2 is used when the build host supports it (-march=native); the source
+# has a portable scalar fallback, so this always succeeds.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -fPIC -shared -o libseaweed_ec.so seaweed_ec.cc
+echo "built $(pwd)/libseaweed_ec.so"

@@ -1,0 +1,165 @@
+// seaweed_ec — GF(2^8) Reed-Solomon matrix multiply, native CPU path.
+//
+// Replaces the reference's klauspost/reedsolomon SIMD dependency (reference
+// go.mod:47, hot loop ec_encoder.go:118-134): out[i] = XOR_j coeffs[i][j] *
+// data[j] over GF(2^8) with polynomial 0x11D.
+//
+// Algorithm: classic nibble-split table lookup. For a constant c,
+// c*b = LO[c][b & 15] ^ HI[c][b >> 4], so the inner loop is two 16-entry
+// shuffles + XOR — vectorized with AVX2 _mm256_shuffle_epi8 when available
+// (32 bytes/iteration), with a portable scalar fallback.
+//
+// Exposed C ABI (ctypes from Python, see ops/rs_native.py):
+//   void sw_ec_matmul(const uint8_t* coeffs, int r, int k,
+//                     const uint8_t* data, long long n, uint8_t* out);
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr int kPoly = 0x11D;
+
+struct Tables {
+  // lo[c][x]  = c * x        (x in 0..15)
+  // hi[c][x]  = c * (x<<4)
+  alignas(32) uint8_t lo[256][16];
+  alignas(32) uint8_t hi[256][16];
+
+  Tables() {
+    uint8_t mul[256][256];
+    uint8_t exp[512];
+    int log[256];
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    log[0] = 0;
+    for (int a = 0; a < 256; a++) {
+      for (int b = 0; b < 256; b++) {
+        mul[a][b] = (a && b)
+                        ? exp[log[a] + log[b]]
+                        : 0;
+      }
+    }
+    for (int c = 0; c < 256; c++) {
+      for (int xn = 0; xn < 16; xn++) {
+        lo[c][xn] = mul[c][xn];
+        hi[c][xn] = mul[c][xn << 4];
+      }
+    }
+  }
+};
+
+const Tables g_tables;
+
+// out[0..n) ^= c * src[0..n)
+void mul_xor_row(uint8_t c, const uint8_t* __restrict src, long long n,
+                 uint8_t* __restrict dst) {
+  if (c == 0) return;
+  if (c == 1) {
+    long long t = 0;
+#if defined(__AVX2__)
+    for (; t + 32 <= n; t += 32) {
+      __m256i d = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + t));
+      __m256i o = _mm256_loadu_si256(reinterpret_cast<__m256i*>(dst + t));
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + t),
+                          _mm256_xor_si256(o, d));
+    }
+#endif
+    for (; t < n; t++) dst[t] ^= src[t];
+    return;
+  }
+  const uint8_t* lo = g_tables.lo[c];
+  const uint8_t* hi = g_tables.hi[c];
+  long long t = 0;
+#if defined(__AVX2__)
+  const __m256i vlo = _mm256_broadcastsi128_si256(
+      _mm_load_si128(reinterpret_cast<const __m128i*>(lo)));
+  const __m256i vhi = _mm256_broadcastsi128_si256(
+      _mm_load_si128(reinterpret_cast<const __m128i*>(hi)));
+  const __m256i mask = _mm256_set1_epi8(0x0F);
+  for (; t + 32 <= n; t += 32) {
+    __m256i d = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(src + t));
+    __m256i dl = _mm256_and_si256(d, mask);
+    __m256i dh = _mm256_and_si256(_mm256_srli_epi64(d, 4), mask);
+    __m256i p = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, dl),
+                                 _mm256_shuffle_epi8(vhi, dh));
+    __m256i o = _mm256_loadu_si256(reinterpret_cast<__m256i*>(dst + t));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + t),
+                        _mm256_xor_si256(o, p));
+  }
+#endif
+  for (; t < n; t++) {
+    uint8_t d = src[t];
+    dst[t] ^= static_cast<uint8_t>(lo[d & 0x0F] ^ hi[d >> 4]);
+  }
+}
+
+}  // namespace
+
+namespace {
+
+// CRC32-C (Castagnoli), slicing-by-8 — needle checksums (the reference uses
+// klauspost/crc32 Castagnoli, weed/storage/needle/crc.go).
+struct CrcTables {
+  uint32_t t[8][256];
+  CrcTables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int j = 0; j < 8; j++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = t[0][i];
+      for (int s = 1; s < 8; s++) {
+        c = t[0][c & 0xFF] ^ (c >> 8);
+        t[s][i] = c;
+      }
+    }
+  }
+};
+
+const CrcTables g_crc;
+
+}  // namespace
+
+extern "C" {
+
+uint32_t sw_crc32c(uint32_t crc, const uint8_t* data, long long n) {
+  crc = ~crc;
+  long long i = 0;
+  for (; i + 8 <= n; i += 8) {
+    crc ^= static_cast<uint32_t>(data[i]) |
+           (static_cast<uint32_t>(data[i + 1]) << 8) |
+           (static_cast<uint32_t>(data[i + 2]) << 16) |
+           (static_cast<uint32_t>(data[i + 3]) << 24);
+    crc = g_crc.t[7][crc & 0xFF] ^ g_crc.t[6][(crc >> 8) & 0xFF] ^
+          g_crc.t[5][(crc >> 16) & 0xFF] ^ g_crc.t[4][crc >> 24] ^
+          g_crc.t[3][data[i + 4]] ^ g_crc.t[2][data[i + 5]] ^
+          g_crc.t[1][data[i + 6]] ^ g_crc.t[0][data[i + 7]];
+  }
+  for (; i < n; i++) crc = g_crc.t[0][(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+void sw_ec_matmul(const uint8_t* coeffs, int r, int k, const uint8_t* data,
+                  long long n, uint8_t* out) {
+  for (int i = 0; i < r; i++) {
+    uint8_t* dst = out + static_cast<long long>(i) * n;
+    for (int j = 0; j < k; j++) {
+      mul_xor_row(coeffs[i * k + j], data + static_cast<long long>(j) * n, n,
+                  dst);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,73 @@
+"""C++ native codec bridge (ctypes).
+
+The production CPU path, replacing the reference's SIMD assembly dependency
+(klauspost/reedsolomon, reference go.mod:47). The shared library lives at
+ops/native/libseaweed_ec.so and is built by ops/native/build.sh with g++
+auto-vectorization; falls back to the numpy backend when absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from .codec import ReedSolomonCodec
+from . import gf256
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "native",
+                         "libseaweed_ec.so")
+_lib = None
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.sw_ec_matmul.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),  # coeffs (r*k)
+            ctypes.c_int,                    # r
+            ctypes.c_int,                    # k
+            ctypes.POINTER(ctypes.c_uint8),  # data (k*n)
+            ctypes.c_longlong,               # n
+            ctypes.POINTER(ctypes.c_uint8),  # out (r*n)
+        ]
+        lib.sw_ec_matmul.restype = None
+        _lib = lib
+    except OSError:
+        _load_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeCodec(ReedSolomonCodec):
+    backend = "native"
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 matrix_kind: str = "vandermonde"):
+        super().__init__(data_shards, parity_shards, matrix_kind)
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native EC library not built at {_LIB_PATH}; "
+                "run seaweedfs_tpu/ops/native/build.sh")
+
+    def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        r, k = coeffs.shape
+        n = data.shape[1]
+        out = np.zeros((r, n), dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._lib.sw_ec_matmul(
+            coeffs.ctypes.data_as(u8p), r, k,
+            data.ctypes.data_as(u8p), n,
+            out.ctypes.data_as(u8p))
+        return out

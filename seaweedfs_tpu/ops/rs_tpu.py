@@ -1,0 +1,131 @@
+"""TPU Reed-Solomon backend — GF(2^8) coding as an MXU bit-plane matmul.
+
+The north star (BASELINE.json): the reference's EC hot loop
+(reference ec_encoder.go:118-134 -> klauspost AVX2 GF multiply) becomes a
+single batched matmul per chunk on TPU.
+
+Math: multiplication by a GF(2^8) constant is linear over GF(2)^8, so the
+(r x k) byte coefficient matrix lifts to a (k*8 x r*8) binary matrix B
+(ops/gf256.bit_matrix). With input bytes unpacked to bit-planes
+X (k*8, n) in {0,1}, the coded output is
+
+    Y = (B^T @ X) mod 2        -- int8 matmul on the MXU, ~896 MACs/byte
+    out = pack_bits(Y)         -- VPU shifts/adds
+
+This is exact integer arithmetic (row sums <= k*8 = 160 < 2^31), so the
+result is bit-identical to the numpy/native backends. No gathers, no
+data-dependent control flow; everything is static-shaped for XLA.
+
+Chunking: the bit-plane expansion is 8x the payload, so a whole 30GB volume
+cannot be lifted at once; the codec streams fixed-size chunks (default 32MB
+per shard-row) through one compiled executable (one compilation per
+(r, k, chunk) shape; tails are zero-padded to the chunk width, and GF
+linearity makes zero-padding exact).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .codec import ReedSolomonCodec
+from . import gf256
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
+
+
+@functools.lru_cache(maxsize=64)
+def _coded_fn(k: int, r: int, n: int):
+    """Jitted (bitmat (k*8, r*8) int8, data (k, n) uint8) -> (r, n) uint8."""
+    jax, jnp = _jax()
+
+    def fn(bitmat, data):
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        # unpack to bit-planes: row j*8+l is bit l of input shard j
+        bits = ((data[:, None, :] >> shifts[None, :, None]) & 1)
+        x = bits.reshape(k * 8, n).astype(jnp.int8)
+        # MXU: (r*8, k*8) @ (k*8, n) with int32 accumulation
+        y = jax.lax.dot_general(
+            bitmat.T, x,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        ybits = (y & 1).astype(jnp.uint8).reshape(r, 8, n)
+        weights = (jnp.uint8(1) << shifts)[None, :, None]
+        return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _bitmat_cached(coeff_bytes: bytes, r: int, k: int):
+    coeffs = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(r, k)
+    return gf256.bit_matrix(coeffs).astype(np.int8)
+
+
+class TpuCodec(ReedSolomonCodec):
+    """JAX backend. Runs on whatever jax.devices() offers (TPU in prod,
+    virtual CPU mesh in tests) — output is bit-identical everywhere."""
+
+    backend = "tpu"
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 matrix_kind: str = "vandermonde",
+                 chunk_bytes: int = 32 << 20):
+        super().__init__(data_shards, parity_shards, matrix_kind)
+        self.chunk_bytes = int(chunk_bytes)
+
+    def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        r, k = coeffs.shape
+        n = data.shape[1]
+        if n == 0:
+            return np.zeros((r, 0), dtype=np.uint8)
+        bitmat = _bitmat_cached(coeffs.tobytes(), r, k)
+        if n <= self.chunk_bytes:
+            # bucket to the next power of two so varied payload widths reuse
+            # compiled executables instead of jitting per exact n
+            bucket = max(512, 1 << (n - 1).bit_length())
+            bucket = min(bucket, self.chunk_bytes)
+            fn = _coded_fn(k, r, bucket)
+            if n < bucket:
+                pad = np.zeros((k, bucket), dtype=np.uint8)
+                pad[:, :n] = data
+                return np.asarray(fn(bitmat, pad))[:, :n]
+            return np.asarray(fn(bitmat, data))
+        out = np.empty((r, n), dtype=np.uint8)
+        fn = _coded_fn(k, r, self.chunk_bytes)
+        for off in range(0, n, self.chunk_bytes):
+            end = min(off + self.chunk_bytes, n)
+            chunk = data[:, off:end]
+            if end - off < self.chunk_bytes:
+                pad = np.zeros((k, self.chunk_bytes), dtype=np.uint8)
+                pad[:, : end - off] = chunk
+                out[:, off:end] = np.asarray(fn(bitmat, pad))[:, : end - off]
+            else:
+                out[:, off:end] = np.asarray(fn(bitmat, chunk))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Raw jax-level entry points (used by bench.py, __graft_entry__, parallel/)
+# ---------------------------------------------------------------------------
+
+def encode_bitmat(k: int, m: int, matrix_kind: str = "vandermonde") -> np.ndarray:
+    """The (k*8, m*8) int8 GF(2) lift of the parity rows."""
+    matrix = gf256.build_matrix(k, k + m, matrix_kind)
+    return gf256.bit_matrix(matrix[k:]).astype(np.int8)
+
+
+def make_encode_fn(k: int, m: int, n: int, matrix_kind: str = "vandermonde"):
+    """Returns (jitted_fn, bitmat): jitted_fn(bitmat, data (k, n)) -> (m, n).
+
+    This is the single-device flagship kernel; parallel/sharded_ec wraps it
+    in a mesh for multi-chip encode.
+    """
+    return _coded_fn(k, m, n), encode_bitmat(k, m, matrix_kind)

@@ -1,0 +1,14 @@
+"""storage — the Haystack-style needle/volume engine.
+
+Disk formats are compatible with the reference (SeaweedFS v1.71):
+  .dat  — superblock (8B) + append-only needles (weed/storage/needle)
+  .idx  — 16-byte entries: NeedleId(8) Offset(4) Size(4), big-endian
+  .vif  — volume info (JSON here; protobuf in the reference)
+"""
+
+from .types import (  # noqa: F401
+    NEEDLE_ENTRY_SIZE, NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE,
+    NEEDLE_ID_SIZE, OFFSET_SIZE, SIZE_SIZE,
+)
+from .needle import Needle  # noqa: F401
+from .volume import Volume  # noqa: F401

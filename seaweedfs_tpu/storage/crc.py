@@ -1,0 +1,76 @@
+"""CRC32-C (Castagnoli) needle checksums.
+
+The reference checksums needle payloads with Castagnoli CRC32 and stores the
+"masked" value ((crc>>15 | crc<<17) + 0xa282ead8 — reference
+weed/storage/needle/crc.go:25). Hot path uses the native library's
+slicing-by-8 implementation; falls back to a pure-Python table loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+_POLY = 0x82F63B78
+
+
+def _build_table():
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_TABLE = _build_table()
+
+
+def _crc32c_py(crc: int, data: bytes) -> int:
+    c = crc ^ 0xFFFFFFFF
+    t = _TABLE
+    for b in data:
+        c = t[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is not None:
+        return _native
+    try:
+        from ..ops.rs_native import _load
+        lib = _load()
+        if lib is not None:
+            # c_char_p passes Python bytes zero-copy (the C side only
+            # reads); avoids a full payload memcpy per checksum
+            lib.sw_crc32c.argtypes = [ctypes.c_uint32,
+                                      ctypes.c_char_p,
+                                      ctypes.c_longlong]
+            lib.sw_crc32c.restype = ctypes.c_uint32
+            _native = lib
+        else:
+            _native = False
+    except Exception:
+        _native = False
+    return _native
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _load_native()
+    if lib:
+        return lib.sw_crc32c(crc, bytes(data), len(data))
+    return _crc32c_py(crc, data)
+
+
+def masked_value(crc: int) -> int:
+    """The value actually stored on disk (reference crc.go:25)."""
+    crc &= 0xFFFFFFFF
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def needle_checksum(data: bytes) -> int:
+    return masked_value(crc32c(data))

@@ -1,0 +1,215 @@
+"""Needle maps — in-memory needleId -> (offset, size) indexes.
+
+The reference offers several variants (weed/storage/needle_map.go):
+CompactMap (sectioned sorted arrays), LevelDB, sorted-file, and a btree
+MemDb used for EC index sorting. Here:
+
+  * NeedleMap        — dict-backed (Python dicts are compact open-addressing
+                       tables; the CompactMap exists in the reference to
+                       dodge Go GC overheads that don't apply here), plus
+                       the same append-to-.idx write-through discipline
+                       (reference needle_map.go:51 baseNeedleMapper).
+  * MemDb            — sorted in-memory db for .idx -> .ecx sorting
+                       (reference needle_map/memdb.go).
+  * SortedFileMap    — binary search over a sorted 16B-record file
+                       (reference needle_map_sorted_file.go / the .ecx
+                       search in ec_volume.go:210-235).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+from .types import (NEEDLE_ENTRY_SIZE, TOMBSTONE_FILE_SIZE, bytes_to_offset,
+                    bytes_to_needle_id, needle_id_to_bytes, offset_to_bytes)
+
+
+def entry_to_bytes(nid: int, offset: int, size: int) -> bytes:
+    return needle_id_to_bytes(nid) + offset_to_bytes(offset) \
+        + struct.pack(">I", size)
+
+
+def bytes_to_entry(b: bytes) -> Tuple[int, int, int]:
+    return (bytes_to_needle_id(b[0:8]), bytes_to_offset(b[8:12]),
+            struct.unpack(">I", b[12:16])[0])
+
+
+class NeedleValue:
+    __slots__ = ("offset", "size")
+
+    def __init__(self, offset: int, size: int):
+        self.offset = offset
+        self.size = size
+
+
+class NeedleMap:
+    """Write-through needle map: in-memory dict + append-only .idx log."""
+
+    def __init__(self, idx_path: Optional[str] = None):
+        self._m: dict = {}
+        self.idx_path = idx_path
+        self._idx_file = None
+        self.file_counter = 0
+        self.file_byte_counter = 0
+        self.deletion_counter = 0
+        self.deletion_byte_counter = 0
+        self.maximum_file_key = 0
+        if idx_path is not None:
+            self._idx_file = open(idx_path, "ab")
+
+    # -- loading -----------------------------------------------------------
+    @classmethod
+    def load(cls, idx_path: str) -> "NeedleMap":
+        nm = cls.__new__(cls)
+        nm._m = {}
+        nm.idx_path = idx_path
+        nm.file_counter = nm.file_byte_counter = 0
+        nm.deletion_counter = nm.deletion_byte_counter = 0
+        nm.maximum_file_key = 0
+        if os.path.exists(idx_path):
+            for nid, offset, size in walk_index_file(idx_path):
+                nm._apply(nid, offset, size)
+        nm._idx_file = open(idx_path, "ab")
+        return nm
+
+    def _apply(self, nid: int, offset: int, size: int):
+        self.maximum_file_key = max(self.maximum_file_key, nid)
+        if size != TOMBSTONE_FILE_SIZE and offset != 0:
+            old = self._m.get(nid)
+            self._m[nid] = NeedleValue(offset, size)
+            self.file_counter += 1
+            self.file_byte_counter += size
+            if old is not None:
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old.size
+        else:
+            old = self._m.pop(nid, None)
+            if old is not None:
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old.size
+
+    # -- mutations ---------------------------------------------------------
+    def put(self, nid: int, offset: int, size: int):
+        self._apply(nid, offset, size)
+        if self._idx_file is not None:
+            self._idx_file.write(entry_to_bytes(nid, offset, size))
+            self._idx_file.flush()
+
+    def delete(self, nid: int):
+        """Tombstone: offset 0, size TOMBSTONE (reference appends an entry
+        with size=TombstoneFileSize)."""
+        old = self._m.pop(nid, None)
+        if old is not None:
+            self.deletion_counter += 1
+            self.deletion_byte_counter += old.size
+        if self._idx_file is not None:
+            self._idx_file.write(
+                entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE))
+            self._idx_file.flush()
+
+    def get(self, nid: int) -> Optional[NeedleValue]:
+        return self._m.get(nid)
+
+    def __contains__(self, nid: int) -> bool:
+        return nid in self._m
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def items(self) -> Iterator[Tuple[int, NeedleValue]]:
+        return iter(self._m.items())
+
+    @property
+    def content_size(self) -> int:
+        return self.file_byte_counter
+
+    @property
+    def deleted_size(self) -> int:
+        return self.deletion_byte_counter
+
+    def close(self):
+        if self._idx_file is not None:
+            self._idx_file.close()
+            self._idx_file = None
+
+
+class MemDb:
+    """Sorted needle db for building .ecx files (reference memdb.go)."""
+
+    def __init__(self):
+        self._m: dict = {}
+
+    def set(self, nid: int, offset: int, size: int):
+        self._m[nid] = (offset, size)
+
+    def delete(self, nid: int):
+        self._m.pop(nid, None)
+
+    def get(self, nid: int) -> Optional[Tuple[int, int]]:
+        return self._m.get(nid)
+
+    def ascending_visit(self):
+        for nid in sorted(self._m):
+            offset, size = self._m[nid]
+            yield nid, offset, size
+
+    @classmethod
+    def load_from_idx(cls, idx_path: str) -> "MemDb":
+        db = cls()
+        for nid, offset, size in walk_index_file(idx_path):
+            if size != TOMBSTONE_FILE_SIZE and offset != 0:
+                db.set(nid, offset, size)
+            else:
+                db.delete(nid)
+        return db
+
+    def save_to_idx(self, path: str):
+        with open(path, "wb") as f:
+            for nid, offset, size in self.ascending_visit():
+                f.write(entry_to_bytes(nid, offset, size))
+
+
+class SortedFileMap:
+    """Binary search over a sorted 16-byte-record index file (.ecx)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.f = open(path, "rb")
+        self.size = os.fstat(self.f.fileno()).st_size
+        self.count = self.size // NEEDLE_ENTRY_SIZE
+
+    def search(self, nid: int) -> Tuple[int, int, int]:
+        """Returns (offset, size, record_position) or raises KeyError.
+        Tombstoned entries (size==TOMBSTONE) are returned as-is — callers
+        decide (the EC delete path needs the record position)."""
+        lo, hi = 0, self.count - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            self.f.seek(mid * NEEDLE_ENTRY_SIZE)
+            rec = self.f.read(NEEDLE_ENTRY_SIZE)
+            rec_id, offset, size = bytes_to_entry(rec)
+            if rec_id == nid:
+                return offset, size, mid * NEEDLE_ENTRY_SIZE
+            if rec_id < nid:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        raise KeyError(nid)
+
+    def close(self):
+        self.f.close()
+
+
+def walk_index_file(idx_path: str):
+    """Stream (needle_id, offset, size) from a .idx file
+    (reference weed/storage/idx/walk.go:14)."""
+    with open(idx_path, "rb") as f:
+        while True:
+            chunk = f.read(NEEDLE_ENTRY_SIZE * 1024)
+            if not chunk:
+                break
+            for i in range(0, len(chunk) - NEEDLE_ENTRY_SIZE + 1,
+                           NEEDLE_ENTRY_SIZE):
+                yield bytes_to_entry(chunk[i:i + NEEDLE_ENTRY_SIZE])

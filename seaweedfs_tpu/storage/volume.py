@@ -1,0 +1,303 @@
+"""Volume — one append-only .dat file + .idx index (Haystack store).
+
+Mirrors reference behavior (weed/storage/volume.go, volume_read_write.go,
+volume_loading.go, volume_checking.go) over the same disk formats:
+  * append-only writes at 8-byte-aligned offsets, write-through .idx
+  * deletes append a zero-size tombstone needle and a tombstone idx entry
+  * reads validate cookie + CRC, honor TTL expiry
+  * boot: load superblock, replay .idx, truncate torn tails
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from .needle import Needle, get_actual_size
+from .needle_map import NeedleMap, walk_index_file
+from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+from .types import (NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE, TTL,
+                    ReplicaPlacement)
+
+
+class VolumeError(Exception):
+    pass
+
+
+class NotFound(VolumeError):
+    pass
+
+
+def volume_file_prefix(dirname: str, collection: str, vid: int) -> str:
+    name = f"{collection}_{vid}" if collection else str(vid)
+    return os.path.join(dirname, name)
+
+
+class Volume:
+    def __init__(self, dirname: str, collection: str, vid: int,
+                 replica_placement: Optional[ReplicaPlacement] = None,
+                 ttl: Optional[TTL] = None, create: bool = False,
+                 version: int = None):
+        self.dir = dirname
+        self.collection = collection or ""
+        self.id = vid
+        self.readonly = False
+        self.lock = threading.RLock()
+        self.last_modified = 0
+
+        prefix = volume_file_prefix(dirname, self.collection, vid)
+        self.dat_path = prefix + ".dat"
+        self.idx_path = prefix + ".idx"
+
+        if create and not os.path.exists(self.dat_path):
+            sb = SuperBlock(
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or TTL())
+            if version:
+                sb.version = version
+            with open(self.dat_path, "wb") as f:
+                f.write(sb.to_bytes())
+            self.super_block = sb
+            open(self.idx_path, "ab").close()
+        else:
+            with open(self.dat_path, "rb") as f:
+                self.super_block = SuperBlock.from_bytes(
+                    f.read(SUPER_BLOCK_SIZE))
+
+        self.dat = open(self.dat_path, "r+b")
+        self.check_integrity()
+        self.nm = NeedleMap.load(self.idx_path)
+        self.last_modified = int(os.path.getmtime(self.dat_path))
+
+    # -- properties --------------------------------------------------------
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    def file_name(self) -> str:
+        return volume_file_prefix(self.dir, self.collection, self.id)
+
+    def content_size(self) -> int:
+        return self.nm.content_size
+
+    def deleted_size(self) -> int:
+        return self.nm.deleted_size
+
+    def file_count(self) -> int:
+        return self.nm.file_counter
+
+    def deleted_count(self) -> int:
+        return self.nm.deletion_counter
+
+    def max_file_key(self) -> int:
+        return self.nm.maximum_file_key
+
+    def size(self) -> int:
+        with self.lock:
+            self.dat.seek(0, os.SEEK_END)
+            return self.dat.tell()
+
+    def garbage_level(self) -> float:
+        sz = self.size()
+        if sz <= SUPER_BLOCK_SIZE:
+            return 0.0
+        return self.nm.deleted_size / sz
+
+    def expired(self, volume_size_limit: int) -> bool:
+        """Reference semantics (volume.go expired()): a 0 size limit means
+        never expire; empty volumes don't expire either."""
+        if volume_size_limit == 0 or self.content_size() == 0:
+            return False
+        ttl = self.super_block.ttl
+        if ttl.minutes == 0:
+            return False
+        return time.time() - self.last_modified > ttl.minutes * 60
+
+    # -- integrity (reference volume_checking.go:14) ----------------------
+    def check_integrity(self):
+        """Truncate a torn tail: the .dat must end on an 8-byte boundary and
+        cover every .idx entry; trailing garbage after a crash is dropped."""
+        self.dat.seek(0, os.SEEK_END)
+        size = self.dat.tell()
+        if size < SUPER_BLOCK_SIZE:
+            raise VolumeError(f"volume {self.id}: missing superblock")
+        aligned = SUPER_BLOCK_SIZE + (
+            (size - SUPER_BLOCK_SIZE) // NEEDLE_PADDING_SIZE
+        ) * NEEDLE_PADDING_SIZE
+        if aligned != size:
+            self.dat.truncate(aligned)
+        # truncate trailing idx entries that point past the .dat end (crash
+        # lost .dat pages but kept .idx pages); partial trailing entry too
+        if os.path.exists(self.idx_path):
+            from .needle_map import bytes_to_entry
+            from .needle import get_actual_size
+            idx_size = os.path.getsize(self.idx_path)
+            idx_size -= idx_size % 16
+            dat_end = self.dat.seek(0, os.SEEK_END)
+            version = self.super_block.version
+            with open(self.idx_path, "r+b") as f:
+                while idx_size >= 16:
+                    f.seek(idx_size - 16)
+                    nid, offset, size = bytes_to_entry(f.read(16))
+                    if size == TOMBSTONE_FILE_SIZE or offset == 0 or \
+                            offset + get_actual_size(size, version) <= dat_end:
+                        break
+                    idx_size -= 16
+                f.truncate(idx_size)
+
+    # -- write -------------------------------------------------------------
+    def write_needle(self, n: Needle) -> int:
+        with self.lock:
+            if self.readonly:
+                raise VolumeError(f"volume {self.id} is read only")
+            self.dat.seek(0, os.SEEK_END)
+            offset = self.dat.tell()
+            if offset % NEEDLE_PADDING_SIZE:
+                offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
+                self.dat.truncate(offset)
+            if not n.append_at_ns:
+                n.append_at_ns = time.time_ns()
+            blob = n.to_bytes(self.version)
+            try:
+                self.dat.seek(offset)
+                self.dat.write(blob)
+                self.dat.flush()
+            except OSError:
+                self.dat.truncate(offset)
+                raise
+            if n.size > 0 or self.version == 1:
+                self.nm.put(n.id, offset, n.size)
+            self.last_modified = int(time.time())
+            return n.size
+
+    def delete_needle(self, n: Needle) -> int:
+        """Append a tombstone; returns freed size (0 if absent)."""
+        with self.lock:
+            if self.readonly:
+                raise VolumeError(f"volume {self.id} is read only")
+            nv = self.nm.get(n.id)
+            if nv is None or nv.size == TOMBSTONE_FILE_SIZE:
+                return 0
+            freed = nv.size
+            self.nm.delete(n.id)
+            tomb = Needle(cookie=n.cookie, id=n.id, data=b"",
+                          append_at_ns=time.time_ns())
+            self.dat.seek(0, os.SEEK_END)
+            offset = self.dat.tell()
+            self.dat.seek(offset)
+            self.dat.write(tomb.to_bytes(self.version))
+            self.dat.flush()
+            self.last_modified = int(time.time())
+            return freed
+
+    # -- read --------------------------------------------------------------
+    def read_needle(self, n: Needle) -> Needle:
+        """Read by id; validates cookie and TTL. n carries id+cookie."""
+        with self.lock:
+            nv = self.nm.get(n.id)
+            if nv is None or nv.offset == 0 or nv.size == TOMBSTONE_FILE_SIZE:
+                raise NotFound(f"needle {n.id} not found in volume {self.id}")
+            blob = self._read_blob(nv.offset, nv.size)
+        got = Needle.from_bytes(blob, self.version, expected_size=nv.size)
+        if got.cookie != n.cookie:
+            raise NotFound(
+                f"cookie mismatch for needle {n.id} in volume {self.id}")
+        if got.has_ttl() and got.ttl.minutes and got.has_last_modified():
+            if time.time() - got.last_modified > got.ttl.minutes * 60:
+                raise NotFound(f"needle {n.id} expired")
+        return got
+
+    def _read_blob(self, offset: int, size: int) -> bytes:
+        want = get_actual_size(size, self.version)
+        self.dat.seek(offset)
+        blob = self.dat.read(want)
+        if len(blob) < want:
+            from .needle import CorruptNeedle
+            raise CorruptNeedle(
+                f"volume {self.id}: short read at {offset} "
+                f"({len(blob)} < {want})")
+        return blob
+
+    # -- scan (used by export/fix/compact; reference volume_read_all.go) ---
+    def scan(self):
+        """Yield (needle, offset) for every record in the .dat, in order."""
+        with self.lock:
+            end = self.size()
+            offset = SUPER_BLOCK_SIZE
+            while offset + 16 <= end:
+                self.dat.seek(offset)
+                header = self.dat.read(16)
+                n = Needle.parse_header(header)
+                actual = get_actual_size(n.size, self.version)
+                self.dat.seek(offset)
+                blob = self.dat.read(actual)
+                if len(blob) < actual:
+                    break
+                yield Needle.from_bytes(blob, self.version), offset
+                offset += actual
+
+    # -- vacuum (reference volume_vacuum.go) -------------------------------
+    def compact(self) -> int:
+        """Copy live needles to .cpd/.cpx. Returns reclaimed byte estimate.
+
+        Iterates the needle map (not a raw .dat scan) so garbage records in
+        the .dat — e.g. a torn-but-aligned write followed by later appends —
+        can never cause live needles to be silently dropped; this matches
+        the reference's Compact2, which copies from the index."""
+        with self.lock:
+            prefix = self.file_name()
+            cpd, cpx = prefix + ".cpd", prefix + ".cpx"
+            new_sb = SuperBlock(
+                version=self.version,
+                replica_placement=self.super_block.replica_placement,
+                ttl=self.super_block.ttl,
+                compaction_revision=(
+                    self.super_block.compaction_revision + 1) & 0xFFFF)
+            from .needle_map import entry_to_bytes
+            live = sorted(self.nm.items(), key=lambda kv: kv[1].offset)
+            with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
+                dat_out.write(new_sb.to_bytes())
+                for nid, nv in live:
+                    if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
+                        continue
+                    new_off = dat_out.tell()
+                    dat_out.write(self._read_blob(nv.offset, nv.size))
+                    idx_out.write(entry_to_bytes(nid, new_off, nv.size))
+            return self.nm.deleted_size
+
+    def commit_compact(self):
+        with self.lock:
+            prefix = self.file_name()
+            cpd, cpx = prefix + ".cpd", prefix + ".cpx"
+            if not (os.path.exists(cpd) and os.path.exists(cpx)):
+                raise VolumeError("no compaction files to commit")
+            self.dat.close()
+            self.nm.close()
+            os.replace(cpd, self.dat_path)
+            os.replace(cpx, self.idx_path)
+            with open(self.dat_path, "rb") as f:
+                self.super_block = SuperBlock.from_bytes(
+                    f.read(SUPER_BLOCK_SIZE))
+            self.dat = open(self.dat_path, "r+b")
+            self.nm = NeedleMap.load(self.idx_path)
+
+    def cleanup_compact(self):
+        for ext in (".cpd", ".cpx"):
+            p = self.file_name() + ext
+            if os.path.exists(p):
+                os.remove(p)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        with self.lock:
+            self.nm.close()
+            self.dat.close()
+
+    def destroy(self):
+        self.close()
+        for ext in (".dat", ".idx", ".cpd", ".cpx", ".vif"):
+            p = self.file_name() + ext
+            if os.path.exists(p):
+                os.remove(p)

@@ -1,0 +1,22 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Tests never touch the real TPU; multi-chip sharding is validated on
+8 virtual CPU devices (the driver separately dry-runs __graft_entry__).
+
+Note: the environment's sitecustomize imports jax at interpreter startup
+with JAX_PLATFORMS=axon already in the env, so setting the env var here is
+not enough — jax.config must be updated directly (config values are read
+from the env at jax import time, which happened before this file ran).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

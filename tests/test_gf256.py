@@ -1,0 +1,95 @@
+"""GF(2^8) field + matrix algebra tests."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == \
+            gf256.gf_mul(gf256.gf_mul(a, b), c)
+        # distributivity over XOR (field addition)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+
+def test_mul_identity_zero():
+    for a in range(256):
+        assert gf256.gf_mul(a, 1) == a
+        assert gf256.gf_mul(a, 0) == 0
+
+
+def test_inverse():
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+    with pytest.raises(ZeroDivisionError):
+        gf256.gf_inv(0)
+
+
+def test_div():
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        a = int(rng.integers(0, 256))
+        b = int(rng.integers(1, 256))
+        assert gf256.gf_mul(gf256.gf_div(a, b), b) == a
+
+
+def test_pow():
+    assert gf256.gf_pow(0, 0) == 1  # matches reference dependency galExp
+    assert gf256.gf_pow(0, 5) == 0
+    assert gf256.gf_pow(2, 1) == 2
+    assert gf256.gf_pow(2, 8) == gf256.FIELD_POLY ^ 0x100  # x^8 = poly - x^8
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 5, 10):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.mat_inv(m)
+                break
+            except ValueError:
+                continue
+        eye = gf256.mat_mul(m, inv)
+        assert np.array_equal(eye, np.eye(n, dtype=np.uint8))
+
+
+def test_vandermonde_systematic_identity_top():
+    m = gf256.build_matrix(10, 14, "vandermonde")
+    assert np.array_equal(m[:10], np.eye(10, dtype=np.uint8))
+    # any 10 rows must be invertible (MDS property) — sample a few subsets
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        rows = sorted(rng.choice(14, 10, replace=False))
+        gf256.mat_inv(m[rows, :])  # must not raise
+
+
+def test_cauchy_identity_top_and_mds():
+    for k, total in ((6, 9), (10, 14), (20, 24)):
+        m = gf256.build_matrix(k, total, "cauchy")
+        assert np.array_equal(m[:k], np.eye(k, dtype=np.uint8))
+        rng = np.random.default_rng(4)
+        for _ in range(10):
+            rows = sorted(rng.choice(total, k, replace=False))
+            gf256.mat_inv(m[rows, :])
+
+
+def test_bit_matrix_equivalence():
+    """The GF(2) lift must agree with direct GF(2^8) matmul."""
+    rng = np.random.default_rng(5)
+    coeffs = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    data = rng.integers(0, 256, (10, 64)).astype(np.uint8)
+    direct = gf256.mat_mul(coeffs, data)
+
+    bm = gf256.bit_matrix(coeffs)  # (80, 32)
+    # unpack data bytes to bits, LSB-first, column layout (n, 10*8)
+    bits = ((data[:, :, None] >> np.arange(8)) & 1)  # (10, 64, 8)
+    x = bits.transpose(1, 0, 2).reshape(64, 80)
+    y = (x.astype(np.int32) @ bm.astype(np.int32)) & 1  # (64, 32)
+    out = (y.reshape(64, 4, 8) << np.arange(8)).sum(-1).astype(np.uint8).T
+    assert np.array_equal(out, direct)

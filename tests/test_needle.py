@@ -1,0 +1,86 @@
+"""Needle serialization tests (reference needle_read_write_test.go style)."""
+
+import pytest
+
+from seaweedfs_tpu.storage import crc
+from seaweedfs_tpu.storage.needle import (
+    Needle, get_actual_size, padding_length)
+from seaweedfs_tpu.storage.types import TTL, VERSION1, VERSION2, VERSION3
+
+
+def test_padding_never_zero():
+    # the reference pads 1..8 bytes, never 0 (needle_read_write.go:287)
+    for size in range(0, 64):
+        for v in (VERSION1, VERSION2, VERSION3):
+            p = padding_length(size, v)
+            assert 1 <= p <= 8
+            base = 16 + size + 4 + (8 if v == VERSION3 else 0)
+            assert (base + p) % 8 == 0
+
+
+@pytest.mark.parametrize("version", [VERSION1, VERSION2, VERSION3])
+def test_roundtrip_simple(version):
+    n = Needle(cookie=0x1234, id=42, data=b"hello world")
+    blob = n.to_bytes(version)
+    assert len(blob) == get_actual_size(n.size, version)
+    got = Needle.from_bytes(blob, version)
+    assert got.id == 42 and got.cookie == 0x1234
+    assert got.data == b"hello world"
+
+
+def test_roundtrip_full_metadata_v3():
+    n = Needle(cookie=7, id=99, data=b"payload" * 100)
+    n.set_name(b"file.txt")
+    n.set_mime(b"text/plain")
+    n.set_last_modified(1_700_000_000)
+    n.set_ttl(TTL.parse("3h"))
+    n.set_pairs(b'{"k":"v"}')
+    n.append_at_ns = 123456789
+    blob = n.to_bytes(VERSION3)
+    got = Needle.from_bytes(blob, VERSION3)
+    assert got.name == b"file.txt"
+    assert got.mime == b"text/plain"
+    assert got.last_modified == 1_700_000_000
+    assert got.ttl == TTL.parse("3h")
+    assert got.pairs == b'{"k":"v"}'
+    assert got.append_at_ns == 123456789
+    assert got.data == b"payload" * 100
+
+
+def test_crc_detects_corruption():
+    n = Needle(cookie=1, id=2, data=b"abcdef")
+    blob = bytearray(n.to_bytes(VERSION3))
+    blob[20] ^= 0xFF  # flip a data byte
+    from seaweedfs_tpu.storage.needle import CorruptNeedle
+    with pytest.raises(CorruptNeedle):
+        Needle.from_bytes(bytes(blob), VERSION3)
+
+
+def test_empty_needle_tombstone():
+    n = Needle(cookie=1, id=2, data=b"")
+    blob = n.to_bytes(VERSION3)
+    assert n.size == 0
+    got = Needle.from_bytes(blob, VERSION3)
+    assert got.size == 0 and got.data == b""
+
+
+def test_masked_crc_convention():
+    # masked CRC formula from reference crc.go:25
+    raw = crc.crc32c(b"123456789")
+    assert raw == 0xE3069283  # published crc32c check value
+    assert crc.masked_value(raw) == ((raw >> 15 | (raw << 17 & 0xFFFFFFFF))
+                                     + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def test_native_and_py_crc_agree():
+    from seaweedfs_tpu.storage.crc import _crc32c_py, crc32c
+    data = bytes(range(256)) * 33 + b"tail"
+    assert crc32c(data) == _crc32c_py(0, data)
+    assert crc32c(data[:7]) == _crc32c_py(0, data[:7])
+
+
+def test_name_capped_at_255():
+    n = Needle(cookie=1, id=2, data=b"x")
+    n.set_name(b"a" * 300)
+    got = Needle.from_bytes(n.to_bytes(VERSION2), VERSION2)
+    assert got.name == b"a" * 255

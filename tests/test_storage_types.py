@@ -1,0 +1,57 @@
+"""Types: file ids, TTL, replica placement (reference-style table tests)."""
+
+import pytest
+
+from seaweedfs_tpu.storage import types as t
+
+
+def test_fid_format_parse_roundtrip():
+    cases = [(3, 1, 0x637037d6), (1, 0x5d4, 0xdeadbeef), (7, 2**63, 1)]
+    for vid, key, cookie in cases:
+        fid = t.format_file_id(vid, key, cookie)
+        assert t.parse_file_id(fid) == (vid, key, cookie)
+
+
+def test_fid_known_string():
+    # reference README.md:186-194 example: "3,01637037d6"
+    assert t.parse_file_id("3,01637037d6") == (3, 0x01, 0x637037d6)
+    assert t.format_file_id(3, 0x01, 0x637037d6) == "3,01637037d6"
+
+
+def test_fid_slash_form():
+    assert t.parse_file_id("3/01637037d6") == (3, 0x01, 0x637037d6)
+
+
+def test_ttl_parse_and_bytes():
+    cases = [("", 0, t.TTL_EMPTY), ("3m", 3, t.TTL_MINUTE),
+             ("4h", 4, t.TTL_HOUR), ("5d", 5, t.TTL_DAY),
+             ("6w", 6, t.TTL_WEEK), ("7M", 7, t.TTL_MONTH),
+             ("8y", 8, t.TTL_YEAR), ("9", 9, t.TTL_MINUTE)]
+    for s, count, unit in cases:
+        ttl = t.TTL.parse(s)
+        assert (ttl.count, ttl.unit) == (count, unit), s
+        assert t.TTL.from_bytes(ttl.to_bytes()) == ttl
+        assert t.TTL.from_uint32(ttl.to_uint32()) == ttl
+
+
+def test_ttl_minutes():
+    assert t.TTL.parse("90m").minutes == 90
+    assert t.TTL.parse("2h").minutes == 120
+    assert t.TTL.parse("1d").minutes == 1440
+
+
+def test_replica_placement():
+    rp = t.ReplicaPlacement.parse("012")
+    assert (rp.diff_data_center, rp.diff_rack, rp.same_rack) == (0, 1, 2)
+    assert rp.copy_count == 4
+    assert str(rp) == "012"
+    assert t.ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    with pytest.raises(ValueError):
+        t.ReplicaPlacement.parse("abc")
+
+
+def test_offset_encoding():
+    for off in (0, 8, 32 * 1024 * 1024 * 1024 - 8):
+        assert t.bytes_to_offset(t.offset_to_bytes(off)) == off
+    with pytest.raises(ValueError):
+        t.offset_to_bytes(7)
