@@ -1,0 +1,17 @@
+"""EC geometry constants (reference ec_encoder.go:17-23)."""
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = 14
+
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1GB
+SMALL_BLOCK_SIZE = 1024 * 1024         # 1MB
+
+# the reference reads 256KB per shard per batch (ec_encoder.go:58); the TPU
+# pipeline batches far larger slabs per device call — this constant remains
+# only as the wire-compatible streaming granularity for shard reads
+BUFFER_SIZE = 256 * 1024
+
+
+def to_ext(shard_id: int) -> str:
+    return f".ec{shard_id:02d}"

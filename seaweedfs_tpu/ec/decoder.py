@@ -1,0 +1,114 @@
+"""EC shard files -> volume (.ec00-09 -> .dat, .ecx+.ecj -> .idx).
+
+Reference ec_decoder.go: decoding back to a volume is a pure interleave
+copy (no GF math — data shards hold the original bytes); the .idx is the
+.ecx stream plus tombstone entries replayed from the .ecj journal; the
+.dat size is inferred from the maximum ecx entry end.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from ..storage.needle import get_actual_size
+from ..storage.needle_map import bytes_to_entry, entry_to_bytes
+from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+from ..storage.types import NEEDLE_ENTRY_SIZE, NEEDLE_ID_SIZE, \
+    TOMBSTONE_FILE_SIZE, bytes_to_needle_id
+from .constants import DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
+
+
+def iterate_ecx_file(base_name: str):
+    with open(base_name + ".ecx", "rb") as f:
+        while True:
+            rec = f.read(NEEDLE_ENTRY_SIZE)
+            if len(rec) < NEEDLE_ENTRY_SIZE:
+                break
+            yield bytes_to_entry(rec)
+
+
+def iterate_ecj_file(base_name: str):
+    path = base_name + ".ecj"
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        while True:
+            rec = f.read(NEEDLE_ID_SIZE)
+            if len(rec) < NEEDLE_ID_SIZE:
+                break
+            yield bytes_to_needle_id(rec)
+
+
+def write_idx_file_from_ec_index(base_name: str):
+    """.ecx + .ecj -> .idx (reference WriteIdxFileFromEcIndex)."""
+    shutil.copyfile(base_name + ".ecx", base_name + ".idx")
+    with open(base_name + ".idx", "ab") as idx:
+        for nid in iterate_ecj_file(base_name):
+            idx.write(entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE))
+
+
+def read_ec_volume_version(base_name: str) -> int:
+    """The volume superblock rides at the start of .ec00 (data shards carry
+    the original bytes verbatim)."""
+    with open(base_name + to_ext(0), "rb") as f:
+        return SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE)).version
+
+
+def find_dat_file_size(base_name: str) -> int:
+    version = read_ec_volume_version(base_name)
+    dat_size = 0
+    for nid, offset, size in iterate_ecx_file(base_name):
+        if size == TOMBSTONE_FILE_SIZE:
+            continue
+        end = offset + get_actual_size(size, version)
+        dat_size = max(dat_size, end)
+    return dat_size
+
+
+def write_dat_file(base_name: str, dat_size: int,
+                   large_block: int = LARGE_BLOCK_SIZE,
+                   small_block: int = SMALL_BLOCK_SIZE,
+                   buf_size: int = 8 << 20):
+    """Interleave-copy .ec00-09 back into a .dat of dat_size bytes."""
+    ins = [open(base_name + to_ext(i), "rb") for i in range(DATA_SHARDS)]
+    try:
+        with open(base_name + ".dat", "wb") as dat:
+            remaining = dat_size
+            large_row = large_block * DATA_SHARDS
+            block_row = 0
+            while remaining > large_row:
+                for i in range(DATA_SHARDS):
+                    _copy_block(ins[i], block_row * large_block, large_block,
+                                dat, buf_size)
+                remaining -= large_row
+                block_row += 1
+            large_rows = block_row
+            small_row_idx = 0
+            small_row = small_block * DATA_SHARDS
+            while remaining > 0:
+                for i in range(DATA_SHARDS):
+                    want = min(remaining, small_block)
+                    if want <= 0:
+                        break
+                    _copy_block(
+                        ins[i],
+                        large_rows * large_block + small_row_idx * small_block,
+                        want, dat, buf_size)
+                    remaining -= want
+                small_row_idx += 1
+    finally:
+        for f in ins:
+            f.close()
+
+
+def _copy_block(src, offset: int, length: int, dst, buf_size: int):
+    src.seek(offset)
+    left = length
+    while left > 0:
+        chunk = src.read(min(buf_size, left))
+        if not chunk:
+            dst.write(b"\x00" * left)
+            return
+        dst.write(chunk)
+        left -= len(chunk)

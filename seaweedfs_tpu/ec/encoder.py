@@ -1,0 +1,149 @@
+"""Volume -> EC shard files (.dat -> .ec00..ec13), sorted index, rebuild.
+
+Behavior-compatible with reference ec_encoder.go:
+  * write_sorted_file_from_idx: .idx append log -> .ecx (same 16B entries,
+    sorted by needle id) [ec_encoder.go:27-54]
+  * write_ec_files: two-level striping — while MORE than one large row
+    (10 x 1GB) remains, emit a large row; tail as small rows (10 x 1MB),
+    zero-padded [ec_encoder.go:192-229]
+  * rebuild_ec_files: regenerate missing .ecNN from >=10 survivors
+    [ec_encoder.go:61-116, 231-285]
+
+TPU-first difference: the reference streams 10 x 256KB buffers per GF call;
+here each device call covers a whole slab (default 10 x 8MB) so a volume
+encode is a few hundred kernel launches instead of ~120k, and the GF math
+runs as one MXU matmul per slab (ops/rs_tpu.py). Slab reads are strided
+(block i of a row lives at start + i*block_size), the same column layout
+the reference uses, so shard bytes are identical.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..ops.codec import ReedSolomonCodec, get_codec
+from ..storage.needle_map import MemDb
+from .constants import (DATA_SHARDS, LARGE_BLOCK_SIZE, PARITY_SHARDS,
+                        SMALL_BLOCK_SIZE, TOTAL_SHARDS, to_ext)
+
+DEFAULT_SLAB = 8 << 20  # bytes per shard per device call
+
+
+def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx"):
+    """Build the sorted EC index next to the volume files."""
+    db = MemDb.load_from_idx(base_name + ".idx")
+    db.save_to_idx(base_name + ext)
+
+
+def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
+                   large_block: int = LARGE_BLOCK_SIZE,
+                   small_block: int = SMALL_BLOCK_SIZE,
+                   slab: int = DEFAULT_SLAB):
+    """Encode base_name.dat into base_name.ec00 .. .ec13."""
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    dat_path = base_name + ".dat"
+    dat_size = os.path.getsize(dat_path)
+    outs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
+    try:
+        with open(dat_path, "rb") as f:
+            remaining = dat_size
+            processed = 0
+            large_row = large_block * DATA_SHARDS
+            while remaining > large_row:
+                _encode_row(f, codec, processed, large_block, slab, outs)
+                remaining -= large_row
+                processed += large_row
+            small_row = small_block * DATA_SHARDS
+            while remaining > 0:
+                _encode_row(f, codec, processed, small_block, slab, outs)
+                remaining -= small_row
+                processed += small_row
+    finally:
+        for o in outs:
+            o.close()
+
+
+def _encode_row(f, codec: ReedSolomonCodec, start: int, block_size: int,
+                slab: int, outs: List):
+    """Encode one row of 10 blocks at [start, start + 10*block_size)."""
+    step = min(slab, block_size)
+    if block_size % step:
+        # keep full coverage for odd test geometries
+        step = block_size
+    for off in range(0, block_size, step):
+        data = np.zeros((DATA_SHARDS, step), dtype=np.uint8)
+        for i in range(DATA_SHARDS):
+            f.seek(start + i * block_size + off)
+            chunk = f.read(step)
+            if chunk:
+                data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        parity = codec.encode(data)
+        for i in range(DATA_SHARDS):
+            outs[i].write(data[i].tobytes())
+        for j in range(PARITY_SHARDS):
+            outs[DATA_SHARDS + j].write(parity[j].tobytes())
+
+
+def rebuild_ec_files(base_name: str,
+                     codec: Optional[ReedSolomonCodec] = None,
+                     slab: int = DEFAULT_SLAB) -> List[int]:
+    """Regenerate missing shard files from survivors. Returns the list of
+    rebuilt shard ids. Raises if fewer than DATA_SHARDS survive."""
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    present = [os.path.exists(base_name + to_ext(i))
+               for i in range(TOTAL_SHARDS)]
+    missing = [i for i, p in enumerate(present) if not p]
+    if not missing:
+        return []
+    if sum(present) < DATA_SHARDS:
+        raise ValueError(
+            f"cannot rebuild: only {sum(present)} of {TOTAL_SHARDS} shards")
+    shard_size = None
+    for i, p in enumerate(present):
+        if p:
+            sz = os.path.getsize(base_name + to_ext(i))
+            if shard_size is None:
+                shard_size = sz
+            elif shard_size != sz:
+                raise ValueError("surviving shards differ in size")
+    ins = [open(base_name + to_ext(i), "rb") if present[i] else None
+           for i in range(TOTAL_SHARDS)]
+    outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
+    try:
+        for off in range(0, shard_size, slab):
+            n = min(slab, shard_size - off)
+            shards: List[Optional[np.ndarray]] = []
+            for i in range(TOTAL_SHARDS):
+                if ins[i] is None:
+                    shards.append(None)
+                else:
+                    ins[i].seek(off)
+                    shards.append(np.frombuffer(ins[i].read(n),
+                                                dtype=np.uint8))
+            rebuilt = codec.reconstruct(shards)
+            for i in missing:
+                outs[i].write(rebuilt[i].tobytes())
+    finally:
+        for h in ins:
+            if h is not None:
+                h.close()
+        for h in outs.values():
+            h.close()
+    return missing
+
+
+def ec_shard_base_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
+                       small_block: int = SMALL_BLOCK_SIZE) -> int:
+    """Size every shard file will have for a given .dat size."""
+    large_row = large_block * DATA_SHARDS
+    n_large = 0
+    remaining = dat_size
+    while remaining > large_row:
+        n_large += 1
+        remaining -= large_row
+    small_row = small_block * DATA_SHARDS
+    n_small = (remaining + small_row - 1) // small_row
+    return n_large * large_block + n_small * small_block

@@ -1,0 +1,13 @@
+"""parallel — multi-chip EC compute over a jax.sharding.Mesh.
+
+The reference scales EC work by fanning volumes across volume servers over
+gRPC (SURVEY §2.6); the TPU-native equivalent adds a second, device-level
+tier: stripes and shard outputs sharded over a ('data', 'shard') mesh with
+XLA collectives over ICI (psum for the GF(2) XOR-reductions in distributed
+rebuild), multi-host over DCN via the same mesh axes.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .sharded_ec import (  # noqa: F401
+    sharded_encode_fn, sharded_rebuild_fn, distributed_ec_step,
+)

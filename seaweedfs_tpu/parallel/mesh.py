@@ -1,0 +1,34 @@
+"""Device mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(shape: Optional[Tuple[int, ...]] = None,
+              axis_names: Sequence[str] = ("data", "shard"),
+              devices=None):
+    """Build a Mesh over the available devices.
+
+    Default layout: as many devices as possible on the 'data' (stripe) axis
+    with the 'shard' axis sized 2 when the device count is even — encode is
+    embarrassingly parallel over stripes, so 'data' gets the bulk; 'shard'
+    exists to exercise output-sharding + psum paths (and maps to real
+    multi-host topologies where shard files live on different hosts).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if shape is None:
+        if n % 2 == 0 and n > 1:
+            shape = (n // 2, 2)
+        else:
+            shape = (n, 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names=tuple(axis_names[: len(shape)]))

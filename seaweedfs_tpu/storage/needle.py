@@ -205,9 +205,11 @@ class Needle:
     def _parse_body_v2(self, b: bytes):
         idx, ln = 0, len(b)
         if idx < ln:
+            if idx + 4 > ln:
+                raise CorruptNeedle("truncated data-size field")
             data_size = struct.unpack(">I", b[idx:idx + 4])[0]
             idx += 4
-            if data_size + idx > ln:
+            if data_size + idx >= ln:  # flags byte must follow the data
                 raise CorruptNeedle("data size out of range")
             self.data = b[idx:idx + data_size]
             idx += data_size

@@ -11,9 +11,9 @@ MemDb used for EC index sorting. Here:
                        (reference needle_map.go:51 baseNeedleMapper).
   * MemDb            — sorted in-memory db for .idx -> .ecx sorting
                        (reference needle_map/memdb.go).
-  * SortedFileMap    — binary search over a sorted 16B-record file
-                       (reference needle_map_sorted_file.go / the .ecx
-                       search in ec_volume.go:210-235).
+
+(The sorted-file binary search over 16B records lives with its only
+consumer: ec/ec_volume.search_needle_from_sorted_index.)
 """
 
 from __future__ import annotations
@@ -169,37 +169,6 @@ class MemDb:
         with open(path, "wb") as f:
             for nid, offset, size in self.ascending_visit():
                 f.write(entry_to_bytes(nid, offset, size))
-
-
-class SortedFileMap:
-    """Binary search over a sorted 16-byte-record index file (.ecx)."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self.f = open(path, "rb")
-        self.size = os.fstat(self.f.fileno()).st_size
-        self.count = self.size // NEEDLE_ENTRY_SIZE
-
-    def search(self, nid: int) -> Tuple[int, int, int]:
-        """Returns (offset, size, record_position) or raises KeyError.
-        Tombstoned entries (size==TOMBSTONE) are returned as-is — callers
-        decide (the EC delete path needs the record position)."""
-        lo, hi = 0, self.count - 1
-        while lo <= hi:
-            mid = (lo + hi) // 2
-            self.f.seek(mid * NEEDLE_ENTRY_SIZE)
-            rec = self.f.read(NEEDLE_ENTRY_SIZE)
-            rec_id, offset, size = bytes_to_entry(rec)
-            if rec_id == nid:
-                return offset, size, mid * NEEDLE_ENTRY_SIZE
-            if rec_id < nid:
-                lo = mid + 1
-            else:
-                hi = mid - 1
-        raise KeyError(nid)
-
-    def close(self):
-        self.f.close()
 
 
 def walk_index_file(idx_path: str):

@@ -152,6 +152,24 @@ class Volume:
         with self.lock:
             if self.readonly:
                 raise VolumeError(f"volume {self.id} is read only")
+            # reject overwrites that don't present the original cookie
+            # (cookies exist to stop id-guessing; reference
+            # volume_read_write.go checks the stored header's cookie)
+            existing = self.nm.get(n.id)
+            if existing is not None and existing.offset != 0 and \
+                    existing.size != TOMBSTONE_FILE_SIZE:
+                self.dat.seek(existing.offset)
+                stored = Needle.parse_header(self.dat.read(16))
+                if stored.cookie != n.cookie:
+                    raise VolumeError(
+                        f"needle {n.id}: mismatching cookie on overwrite")
+            # needles inherit the volume's TTL when they carry none
+            # (reference stamps n.Ttl = v.Ttl so per-needle expiry fires)
+            vol_ttl = self.super_block.ttl
+            if not n.has_ttl() and vol_ttl.to_uint32():
+                n.set_ttl(vol_ttl)
+                if not n.has_last_modified():
+                    n.set_last_modified()
             self.dat.seek(0, os.SEEK_END)
             offset = self.dat.tell()
             if offset % NEEDLE_PADDING_SIZE:
@@ -265,6 +283,10 @@ class Volume:
                     new_off = dat_out.tell()
                     dat_out.write(self._read_blob(nv.offset, nv.size))
                     idx_out.write(entry_to_bytes(nid, new_off, nv.size))
+            # remember where the live .idx stood so commit_compact can
+            # replay writes/deletes that land in the window (the
+            # reference's makeupDiff, volume_vacuum.go:181)
+            self._compact_idx_watermark = os.path.getsize(self.idx_path)
             return self.nm.deleted_size
 
     def commit_compact(self):
@@ -273,6 +295,7 @@ class Volume:
             cpd, cpx = prefix + ".cpd", prefix + ".cpx"
             if not (os.path.exists(cpd) and os.path.exists(cpx)):
                 raise VolumeError("no compaction files to commit")
+            self._makeup_diff(cpd, cpx)
             self.dat.close()
             self.nm.close()
             os.replace(cpd, self.dat_path)
@@ -282,6 +305,33 @@ class Volume:
                     f.read(SUPER_BLOCK_SIZE))
             self.dat = open(self.dat_path, "r+b")
             self.nm = NeedleMap.load(self.idx_path)
+
+    def _makeup_diff(self, cpd: str, cpx: str):
+        """Replay .idx entries appended after compact()'s snapshot onto the
+        compacted files (reference makeupDiff, volume_vacuum.go:181)."""
+        watermark = getattr(self, "_compact_idx_watermark", None)
+        if watermark is None:
+            return
+        idx_size = os.path.getsize(self.idx_path)
+        if idx_size <= watermark:
+            return
+        from .needle_map import bytes_to_entry, entry_to_bytes
+        with open(self.idx_path, "rb") as f:
+            f.seek(watermark)
+            delta = f.read(idx_size - watermark)
+        new_off = os.path.getsize(cpd)
+        with open(cpd, "ab") as dat_out, open(cpx, "ab") as idx_out:
+            for i in range(0, len(delta) - 15, 16):
+                nid, offset, size = bytes_to_entry(delta[i:i + 16])
+                if size == TOMBSTONE_FILE_SIZE or offset == 0:
+                    idx_out.write(
+                        entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE))
+                    continue
+                blob = self._read_blob(offset, size)
+                dat_out.write(blob)
+                idx_out.write(entry_to_bytes(nid, new_off, size))
+                new_off += len(blob)
+        self._compact_idx_watermark = None
 
     def cleanup_compact(self):
         for ext in (".cpd", ".cpx"):
