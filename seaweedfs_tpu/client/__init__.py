@@ -1,0 +1,5 @@
+"""client — operations library (reference weed/operation + weed/wdclient)."""
+
+from .operation import (  # noqa: F401
+    assign, delete_file, lookup, upload, upload_data, VidCache,
+)

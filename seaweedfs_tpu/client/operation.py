@@ -1,0 +1,105 @@
+"""Client operations: assign, upload, lookup, delete.
+
+Reference weed/operation/{assign_file_id,upload_content,lookup,
+delete_content}.go and wdclient/vid_map.go (the TTL'd volume-location
+cache).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..server.http_util import HttpError, get_json, http_call, post_multipart
+
+
+def assign(master_url: str, count: int = 1, collection: str = "",
+           replication: str = "", ttl: str = "",
+           data_center: str = "") -> dict:
+    q = f"count={count}"
+    if collection:
+        q += f"&collection={collection}"
+    if replication:
+        q += f"&replication={replication}"
+    if ttl:
+        q += f"&ttl={ttl}"
+    if data_center:
+        q += f"&dataCenter={data_center}"
+    return get_json(f"http://{master_url}/dir/assign?{q}")
+
+
+def upload(url: str, fid: str, data: bytes, filename: str = "",
+           content_type: str = "application/octet-stream",
+           ttl: str = "") -> dict:
+    target = f"http://{url}/{fid}"
+    if ttl:
+        target += f"?ttl={ttl}"
+    return post_multipart(target, filename, data, content_type)
+
+
+def upload_data(master_url: str, data: bytes, filename: str = "",
+                collection: str = "", replication: str = "",
+                ttl: str = "",
+                content_type: str = "application/octet-stream") -> str:
+    """Assign + upload; returns the fid."""
+    a = assign(master_url, collection=collection, replication=replication,
+               ttl=ttl)
+    upload(a["url"], a["fid"], data, filename, content_type, ttl)
+    return a["fid"]
+
+
+class VidCache:
+    """Volume-id -> locations cache with TTL
+    (reference lookup_vid_cache.go / vid_map.go)."""
+
+    def __init__(self, master_url: str, ttl_seconds: float = 10.0):
+        self.master_url = master_url
+        self.ttl = ttl_seconds
+        self._cache: Dict[int, tuple] = {}
+
+    def lookup(self, vid: int) -> List[str]:
+        hit = self._cache.get(vid)
+        if hit and time.time() - hit[0] < self.ttl:
+            return hit[1]
+        out = get_json(f"http://{self.master_url}/dir/lookup?volumeId={vid}")
+        urls = [l["url"] for l in out.get("locations", [])]
+        self._cache[vid] = (time.time(), urls)
+        return urls
+
+    def invalidate(self, vid: int):
+        self._cache.pop(vid, None)
+
+
+def lookup(master_url: str, vid: int) -> List[str]:
+    out = get_json(f"http://{master_url}/dir/lookup?volumeId={vid}")
+    return [l["url"] for l in out.get("locations", [])]
+
+
+def read_file(master_url: str, fid: str,
+              cache: Optional[VidCache] = None) -> bytes:
+    from ..storage.types import parse_file_id
+    vid, _, _ = parse_file_id(fid)
+    urls = cache.lookup(vid) if cache else lookup(master_url, vid)
+    last_err = None
+    for u in urls:
+        try:
+            return http_call("GET", f"http://{u}/{fid}")
+        except HttpError as e:
+            last_err = e
+    raise last_err or HttpError(404, f"no locations for {fid}")
+
+
+def delete_file(master_url: str, fid: str,
+                cache: Optional[VidCache] = None) -> bool:
+    from ..storage.types import parse_file_id
+    vid, _, _ = parse_file_id(fid)
+    urls = cache.lookup(vid) if cache else lookup(master_url, vid)
+    ok = False
+    for u in urls:
+        try:
+            http_call("DELETE", f"http://{u}/{fid}")
+            ok = True
+            break  # server fans out to replicas itself
+        except HttpError:
+            continue
+    return ok

@@ -70,14 +70,12 @@ def _encode_row(f, codec: ReedSolomonCodec, start: int, block_size: int,
                 slab: int, outs: List):
     """Encode one row of 10 blocks at [start, start + 10*block_size)."""
     step = min(slab, block_size)
-    if block_size % step:
-        # keep full coverage for odd test geometries
-        step = block_size
     for off in range(0, block_size, step):
-        data = np.zeros((DATA_SHARDS, step), dtype=np.uint8)
+        width = min(step, block_size - off)  # final chunk may be partial
+        data = np.zeros((DATA_SHARDS, width), dtype=np.uint8)
         for i in range(DATA_SHARDS):
             f.seek(start + i * block_size + off)
-            chunk = f.read(step)
+            chunk = f.read(width)
             if chunk:
                 data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
         parity = codec.encode(data)

@@ -165,11 +165,7 @@ def distributed_ec_step(mesh, k: int = 10, m: int = 4,
     survivors = list(range(k - m)) + list(range(k, k + m))
     missing = list(range(k - m, k))
     reb_fn = sharded_rebuild_fn(mesh, k, len(missing), n)
-    k8p = k * 8 + ((-k * 8) % shard_ax)
-    bm_dec = decode_bitmat(k, m, survivors, missing, pad_to_mult=1)
-    bm_dec = np.concatenate(
-        [bm_dec, np.zeros((k8p - k * 8, bm_dec.shape[1]), dtype=np.int8)],
-        axis=0)
+    bm_dec = decode_bitmat(k, m, survivors, missing, pad_to_mult=shard_ax)
     surv_data = np.concatenate(
         [data[: k - m], np.asarray(parity)], axis=0)  # (k, n)
     rebuilt = reb_fn(jnp.asarray(bm_dec), jnp.asarray(surv_data))

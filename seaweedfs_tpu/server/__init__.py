@@ -1,0 +1,10 @@
+"""server — master / volume / filer HTTP servers.
+
+The reference speaks HTTP on the public data path and gRPC for control
+(SURVEY §5.8); this environment has no gRPC, so control-plane RPCs are
+HTTP/JSON under /cluster/* and /admin/* — same message shapes, different
+framing. Bulk shard transfer streams over plain HTTP ranges.
+"""
+
+from .master import MasterServer  # noqa: F401
+from .volume_server import VolumeServer  # noqa: F401

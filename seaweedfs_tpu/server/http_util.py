@@ -1,0 +1,246 @@
+"""Tiny stdlib HTTP server framework + client helpers.
+
+Single dependency-free layer used by every server: prefix/exact routing on
+ThreadingHTTPServer, JSON responses, multipart/form-data parsing (the
+reference's upload format), and urllib-based client calls.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, handler: BaseHTTPRequestHandler):
+        self.handler = handler
+        parsed = urllib.parse.urlparse(handler.path)
+        self.path = parsed.path
+        self.query: Dict[str, str] = {
+            k: v[0] for k, v in
+            urllib.parse.parse_qs(parsed.query, keep_blank_values=True).items()}
+        self.method = handler.command
+        self.headers = handler.headers
+        self._body: Optional[bytes] = None
+
+    @property
+    def body(self) -> bytes:
+        if self._body is None:
+            length = int(self.headers.get("Content-Length") or 0)
+            self._body = self.handler.rfile.read(length) if length else b""
+        return self._body
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        return json.loads(self.body)
+
+    def multipart_file(self) -> Optional[Tuple[str, str, bytes]]:
+        """Parse the first file part of a multipart/form-data body.
+        Returns (filename, content_type, data) or None."""
+        ctype = self.headers.get("Content-Type", "")
+        if not ctype.startswith("multipart/form-data"):
+            return None
+        m = re.search(r'boundary="?([^";]+)"?', ctype)
+        if not m:
+            return None
+        boundary = m.group(1).encode()
+        parts = self.body.split(b"--" + boundary)
+        for part in parts:
+            part = part.strip(b"\r\n")
+            if not part or part == b"--":
+                continue
+            if b"\r\n\r\n" not in part:
+                continue
+            head, data = part.split(b"\r\n\r\n", 1)
+            head_s = head.decode("utf-8", "replace")
+            fn = re.search(r'filename="([^"]*)"', head_s)
+            ct = re.search(r"Content-Type:\s*([^\r\n]+)", head_s, re.I)
+            if fn is not None:
+                return (fn.group(1), ct.group(1).strip() if ct else "",
+                        data)
+        return None
+
+    def upload_payload(self) -> Tuple[str, str, bytes]:
+        """File data from multipart or raw body (reference accepts both)."""
+        mp = self.multipart_file()
+        if mp is not None:
+            return mp
+        return ("", self.headers.get("Content-Type", ""), self.body)
+
+
+Route = Tuple[str, str, bool, Callable]
+
+
+class Router:
+    def __init__(self):
+        self.routes: List[Route] = []
+        self.fallback: Optional[Callable] = None
+
+    def add(self, method: str, path: str, fn: Callable,
+            prefix: bool = False):
+        self.routes.append((method, path, prefix, fn))
+
+    def set_fallback(self, fn: Callable):
+        self.fallback = fn
+
+    def dispatch(self, req: Request):
+        for method, path, prefix, fn in self.routes:
+            if method != "*" and method != req.method:
+                continue
+            if (prefix and req.path.startswith(path)) or req.path == path:
+                return fn(req)
+        if self.fallback is not None:
+            return self.fallback(req)
+        raise HttpError(404, f"no route for {req.method} {req.path}")
+
+
+def _make_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _run(self):
+            req = Request(self)
+            try:
+                result = router.dispatch(req)
+            except HttpError as e:
+                self._send_json({"error": e.message or str(e)}, e.status)
+                return
+            except BrokenPipeError:
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send_json({"error": f"{type(e).__name__}: {e}"}, 500)
+                return
+            if result is None:
+                self._send_json({}, 200)
+            elif isinstance(result, Response):
+                result.send(self)
+            else:
+                self._send_json(result, 200)
+
+        def _send_json(self, obj, status: int):
+            data = json.dumps(obj).encode()
+            try:
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _run
+
+    return Handler
+
+
+class Response:
+    """Non-JSON response (bytes, custom status/headers)."""
+
+    def __init__(self, body: bytes = b"", status: int = 200,
+                 content_type: str = "application/octet-stream",
+                 headers: Optional[dict] = None):
+        self.body = body
+        self.status = status
+        self.content_type = content_type
+        self.headers = headers or {}
+
+    def send(self, handler: BaseHTTPRequestHandler):
+        try:
+            handler.send_response(self.status)
+            handler.send_header("Content-Type", self.content_type)
+            handler.send_header("Content-Length", str(len(self.body)))
+            for k, v in self.headers.items():
+                handler.send_header(k, v)
+            handler.end_headers()
+            if handler.command != "HEAD":
+                handler.wfile.write(self.body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class HttpServer:
+    def __init__(self, port: int, router: Router, host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(router))
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- client helpers ---------------------------------------------------------
+
+def http_call(method: str, url: str, body: bytes = None,
+              headers: dict = None, timeout: float = 30.0) -> bytes:
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode("utf-8", "replace")[:500]
+        raise HttpError(e.code, f"{method} {url}: {detail}") from None
+    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+        raise HttpError(503, f"{method} {url}: {e}") from None
+
+
+def get_json(url: str, timeout: float = 30.0) -> dict:
+    return json.loads(http_call("GET", url, timeout=timeout) or b"{}")
+
+
+def post_json(url: str, obj=None, timeout: float = 30.0) -> dict:
+    body = json.dumps(obj or {}).encode()
+    out = http_call("POST", url, body,
+                    {"Content-Type": "application/json"}, timeout)
+    return json.loads(out or b"{}")
+
+
+def post_multipart(url: str, filename: str, data: bytes,
+                   content_type: str = "application/octet-stream",
+                   timeout: float = 60.0) -> dict:
+    boundary = uuid.uuid4().hex
+    body = (f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="file"; '
+            f'filename="{filename or "file"}"\r\n'
+            f"Content-Type: {content_type}\r\n\r\n").encode() \
+        + data + f"\r\n--{boundary}--\r\n".encode()
+    out = http_call("POST", url, body,
+                    {"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"}, timeout)
+    return json.loads(out or b"{}")

@@ -1,0 +1,244 @@
+"""MasterServer — cluster coordinator.
+
+Reference weed/server/master_server.go: HTTP API (/dir/assign, /dir/lookup,
+/vol/grow, /vol/vacuum, /col/delete, /submit, status pages) + the heartbeat
+channel (HTTP POST here instead of a gRPC stream; same payload). Volume
+growth happens on demand under a lock when an Assign finds no writable
+volume (reference master_grpc_server_volume.go:43-101).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..storage.types import TTL, ReplicaPlacement, parse_file_id
+from ..topology.topology import Topology
+from ..topology.volume_growth import NoFreeSlots, find_empty_slots
+from .http_util import (HttpError, HttpServer, Request, Router, get_json,
+                        post_json, post_multipart)
+
+
+class MasterServer:
+    def __init__(self, port: int = 9333, host: str = "127.0.0.1",
+                 volume_size_limit_mb: int = 30 * 1024,
+                 default_replication: str = "000",
+                 pulse_seconds: int = 5,
+                 garbage_threshold: float = 0.3):
+        self.topology = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds)
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.vg_lock = threading.Lock()
+        self.host = host
+
+        router = Router()
+        router.add("*", "/dir/assign", self.dir_assign)
+        router.add("*", "/dir/lookup", self.dir_lookup)
+        router.add("*", "/dir/status", self.dir_status)
+        router.add("*", "/vol/grow", self.vol_grow)
+        router.add("*", "/vol/vacuum", self.vol_vacuum)
+        router.add("*", "/col/delete", self.col_delete)
+        router.add("POST", "/submit", self.submit)
+        router.add("POST", "/cluster/heartbeat", self.cluster_heartbeat)
+        router.add("*", "/cluster/status", self.cluster_status)
+        router.add("*", "/cluster/ec_lookup", self.ec_lookup)
+        self.server = HttpServer(port, router, host)
+        self.port = self.server.port
+        self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.server.start()
+        self._pruner.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _prune_loop(self):
+        while not self._stop.wait(self.topology.pulse_seconds):
+            self.topology.prune_dead_nodes()
+
+    # -- handlers ----------------------------------------------------------
+    def cluster_heartbeat(self, req: Request):
+        hb = req.json()
+        self.topology.register_heartbeat(
+            dc_id=hb.get("data_center", ""),
+            rack_id=hb.get("rack", ""),
+            ip=hb.get("ip", "127.0.0.1"),
+            port=int(hb.get("port", 0)),
+            public_url=hb.get("public_url", ""),
+            max_volume_count=int(hb.get("max_volume_count", 7)),
+            volumes=hb.get("volumes", []),
+            ec_shards={int(k): v
+                       for k, v in (hb.get("ec_shards") or {}).items()},
+            ec_collections={int(k): v
+                            for k, v in
+                            (hb.get("ec_collections") or {}).items()},
+            max_file_key=int(hb.get("max_file_key", 0)),
+        )
+        return {"volume_size_limit": self.topology.volume_size_limit,
+                "leader": self.url}
+
+    def dir_assign(self, req: Request):
+        count = int(req.query.get("count", 1))
+        collection = req.query.get("collection", "")
+        replication = req.query.get("replication") \
+            or self.default_replication
+        ttl = TTL.parse(req.query.get("ttl", ""))
+        preferred_dc = req.query.get("dataCenter", "")
+
+        picked = self.topology.pick_for_write(collection, replication, ttl,
+                                              count)
+        if picked is None:
+            with self.vg_lock:
+                picked = self.topology.pick_for_write(
+                    collection, replication, ttl, count)
+                if picked is None:
+                    try:
+                        self._grow_volumes(collection, replication, ttl,
+                                           preferred_dc)
+                    except NoFreeSlots as e:
+                        raise HttpError(
+                            406, f"no free volumes: {e}") from None
+                    picked = self.topology.pick_for_write(
+                        collection, replication, ttl, count)
+        if picked is None:
+            raise HttpError(406, "no writable volumes")
+        fid, cnt, node, _ = picked
+        return {"fid": fid, "url": node.url, "publicUrl": node.public_url,
+                "count": cnt}
+
+    def _grow_volumes(self, collection: str, replication: str, ttl: TTL,
+                      preferred_dc: str = "", count: int = None):
+        rp = ReplicaPlacement.parse(replication)
+        # reference growth counts by copy type (volume_growth.go:39-53)
+        if count is None:
+            count = {1: 7, 2: 6, 3: 3}.get(rp.copy_count, 1)
+        grown = 0
+        for _ in range(count):
+            try:
+                nodes = find_empty_slots(self.topology, rp, preferred_dc)
+            except NoFreeSlots:
+                if grown:
+                    break
+                raise
+            vid = self.topology.next_volume_id()
+            ok = True
+            for n in nodes:
+                try:
+                    post_json(
+                        f"http://{n.url}/admin/assign_volume"
+                        f"?volume={vid}&collection={collection}"
+                        f"&replication={replication}&ttl={ttl}")
+                except HttpError:
+                    ok = False
+                    break
+            if ok:
+                grown += 1
+        return grown
+
+    def vol_grow(self, req: Request):
+        collection = req.query.get("collection", "")
+        replication = req.query.get("replication") \
+            or self.default_replication
+        ttl = TTL.parse(req.query.get("ttl", ""))
+        count = int(req.query.get("count", 1))
+        with self.vg_lock:
+            grown = self._grow_volumes(collection, replication, ttl,
+                                       req.query.get("dataCenter", ""),
+                                       count)
+        return {"count": grown}
+
+    def dir_lookup(self, req: Request):
+        vid_s = req.query.get("volumeId", "")
+        if "," in vid_s:
+            vid_s = vid_s.split(",")[0]
+        if not vid_s:
+            raise HttpError(400, "volumeId required")
+        vid = int(vid_s)
+        locs = self.topology.lookup(req.query.get("collection", ""), vid)
+        if not locs:
+            raise HttpError(404, f"volume {vid} not found")
+        return {"volumeId": vid_s,
+                "locations": [{"url": n.url, "publicUrl": n.public_url}
+                              for n in locs]}
+
+    def ec_lookup(self, req: Request):
+        vid = int(req.query.get("volumeId", 0))
+        shards = self.topology.lookup_ec_shards(vid)
+        if shards is None:
+            raise HttpError(404, f"ec volume {vid} not found")
+        return {"volumeId": vid, "shards": shards}
+
+    def dir_status(self, req: Request):
+        return {"topology": self.topology.to_dict(),
+                "version": "seaweedfs_tpu 0.1"}
+
+    def cluster_status(self, req: Request):
+        return {"isLeader": True, "leader": self.url,
+                "nodes": [n.to_dict() for n in self.topology.all_nodes()]}
+
+    def vol_vacuum(self, req: Request):
+        threshold = float(req.query.get("garbageThreshold",
+                                        self.garbage_threshold))
+        results = []
+        for vid, nodes in self.topology.vacuum_candidates(threshold):
+            ok = True
+            for n in nodes:
+                try:
+                    post_json(f"http://{n.url}/admin/vacuum/compact"
+                              f"?volume={vid}")
+                except HttpError:
+                    ok = False
+                    break
+            if ok:
+                for n in nodes:
+                    try:
+                        post_json(f"http://{n.url}/admin/vacuum/commit"
+                                  f"?volume={vid}")
+                    except HttpError:
+                        ok = False
+            results.append({"volume": vid, "ok": ok})
+        return {"vacuumed": results}
+
+    def col_delete(self, req: Request):
+        collection = req.query.get("collection", "")
+        if not collection:
+            raise HttpError(400, "collection required")
+        deleted = []
+        for node in self.topology.all_nodes():
+            for vid, vi in list(node.volumes.items()):
+                if vi.collection == collection:
+                    try:
+                        post_json(f"http://{node.url}/admin/delete_volume"
+                                  f"?volume={vid}")
+                        deleted.append(vid)
+                    except HttpError:
+                        pass
+        # drop layouts for the collection
+        with self.topology.lock:
+            for key in [k for k in self.topology.layouts
+                        if k[0] == collection]:
+                del self.topology.layouts[key]
+        return {"deleted": sorted(set(deleted))}
+
+    def submit(self, req: Request):
+        """Convenience upload: assign + forward (reference /submit)."""
+        filename, ctype, data = req.upload_payload()
+        assign = self.dir_assign(req)
+        result = post_multipart(
+            f"http://{assign['url']}/{assign['fid']}", filename, data,
+            ctype or "application/octet-stream")
+        return {"fid": assign["fid"], "fileUrl":
+                f"{assign['publicUrl']}/{assign['fid']}",
+                "size": result.get("size", len(data))}

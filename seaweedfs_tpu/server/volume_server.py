@@ -1,0 +1,497 @@
+"""VolumeServer — the data plane.
+
+Reference weed/server/volume_server.go + handlers: public HTTP needle
+read/write/delete with synchronous replica fan-out
+(topology/store_replicate.go), heartbeat client loop
+(volume_grpc_client_to_master.go), admin ops (allocate/delete/vacuum), and
+the EC lifecycle + degraded read (store_ec.go): local shard -> remote
+shard over HTTP -> reconstruct-on-read from >=10 sibling intervals.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ec.constants import DATA_SHARDS, TOTAL_SHARDS, to_ext
+from ..ops.codec import get_codec
+from ..storage.needle import Needle
+from ..storage.store import Store
+from ..storage.types import parse_file_id
+from ..storage.volume import NotFound, VolumeError, volume_file_prefix
+from .http_util import (HttpError, HttpServer, Request, Response, Router,
+                        get_json, http_call, post_json)
+
+
+class VolumeServer:
+    def __init__(self, port: int = 8080, host: str = "127.0.0.1",
+                 directories=None, master_url: str = "127.0.0.1:9333",
+                 data_center: str = "", rack: str = "",
+                 max_volume_counts=None, pulse_seconds: int = 5,
+                 public_url: str = "", read_redirect: bool = True,
+                 ec_backend: str = "auto"):
+        router = Router()
+        router.add("*", "/status", self.status)
+        router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
+        router.add("POST", "/admin/delete_volume", self.admin_delete_volume)
+        router.add("POST", "/admin/volume/readonly", self.admin_readonly)
+        router.add("POST", "/admin/vacuum/check", self.admin_vacuum_check)
+        router.add("POST", "/admin/vacuum/compact", self.admin_vacuum_compact)
+        router.add("POST", "/admin/vacuum/commit", self.admin_vacuum_commit)
+        router.add("POST", "/admin/ec/generate", self.admin_ec_generate)
+        router.add("POST", "/admin/ec/mount", self.admin_ec_mount)
+        router.add("POST", "/admin/ec/unmount", self.admin_ec_unmount)
+        router.add("POST", "/admin/ec/rebuild", self.admin_ec_rebuild)
+        router.add("POST", "/admin/ec/copy", self.admin_ec_copy)
+        router.add("POST", "/admin/ec/to_volume", self.admin_ec_to_volume)
+        router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
+        router.add("GET", "/admin/file", self.admin_file)
+        router.set_fallback(self.data_handler)
+
+        self.server = HttpServer(port, router, host)
+        self.port = self.server.port
+        self.host = host
+        self.master_url = master_url
+        self.pulse_seconds = pulse_seconds
+        self.read_redirect = read_redirect
+        codec = get_codec(DATA_SHARDS, 4, backend=ec_backend) \
+            if ec_backend != "auto" else None
+        self.store = Store(
+            directories or ["./data"],
+            max_volume_counts=max_volume_counts,
+            ip=host, port=self.port,
+            public_url=public_url or f"{host}:{self.port}",
+            data_center=data_center, rack=rack, codec=codec)
+        self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        self._lookup_cache: Dict[int, tuple] = {}
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.server.start()
+        self.heartbeat_once()
+        self._hb_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+        self.store.close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.pulse_seconds):
+            try:
+                self.heartbeat_once()
+            except HttpError:
+                pass
+
+    def heartbeat_once(self):
+        resp = post_json(f"http://{self.master_url}/cluster/heartbeat",
+                         self.store.collect_heartbeat(), timeout=10)
+        if resp.get("volume_size_limit"):
+            self.volume_size_limit = resp["volume_size_limit"]
+
+    # -- admin -------------------------------------------------------------
+    def status(self, req: Request):
+        return self.store.status()
+
+    def admin_assign_volume(self, req: Request):
+        vid = int(req.query["volume"])
+        self.store.add_volume(vid, req.query.get("collection", ""),
+                              req.query.get("replication", "000"),
+                              req.query.get("ttl", ""))
+        self.heartbeat_once()
+        return {"volume": vid}
+
+    def admin_delete_volume(self, req: Request):
+        vid = int(req.query["volume"])
+        if not self.store.delete_volume(vid):
+            raise HttpError(404, f"volume {vid} not found")
+        self._lookup_cache.pop(vid, None)
+        self.heartbeat_once()
+        return {"deleted": vid}
+
+    def admin_readonly(self, req: Request):
+        vid = int(req.query["volume"])
+        readonly = req.query.get("readonly", "true") == "true"
+        if not self.store.mark_volume_readonly(vid, readonly):
+            raise HttpError(404, f"volume {vid} not found")
+        return {"volume": vid, "readonly": readonly}
+
+    def admin_vacuum_check(self, req: Request):
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        return {"volume": vid, "garbage": v.garbage_level()}
+
+    def admin_vacuum_compact(self, req: Request):
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        v.compact()
+        return {"volume": vid, "compacted": True}
+
+    def admin_vacuum_commit(self, req: Request):
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        v.commit_compact()
+        return {"volume": vid, "committed": True}
+
+    # -- EC admin (reference volume_grpc_erasure_coding.go) ----------------
+    def admin_ec_generate(self, req: Request):
+        vid = int(req.query["volume"])
+        base = self.store.generate_ec_shards(
+            vid, req.query.get("collection", ""))
+        return {"volume": vid, "base": os.path.basename(base)}
+
+    def admin_ec_mount(self, req: Request):
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
+                     if s != ""]
+        mounted = self.store.mount_ec_shards(vid, collection, shard_ids)
+        if not mounted and shard_ids:
+            # distinguish "already mounted" from "files not found" so a
+            # wrong/omitted collection fails loudly instead of no-opping
+            ev = self.store.find_ec_volume(vid)
+            if ev is None or not set(shard_ids) & set(ev.shards):
+                raise HttpError(
+                    404, f"no shard files for volume {vid} "
+                         f"collection={collection!r} here")
+        self.heartbeat_once()
+        return {"volume": vid, "mounted": mounted}
+
+    def admin_ec_unmount(self, req: Request):
+        vid = int(req.query["volume"])
+        shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
+                     if s != ""]
+        out = self.store.unmount_ec_shards(vid, shard_ids)
+        self.heartbeat_once()
+        return {"volume": vid, "unmounted": out}
+
+    def admin_ec_rebuild(self, req: Request):
+        vid = int(req.query["volume"])
+        rebuilt = self.store.rebuild_ec_shards(
+            vid, req.query.get("collection", ""))
+        return {"volume": vid, "rebuilt": rebuilt}
+
+    def admin_ec_copy(self, req: Request):
+        """Pull shard files from a source server (reference
+        VolumeEcShardsCopy: the target pulls via CopyFile stream)."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        source = req.query["source"]
+        shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
+                     if s != ""]
+        copy_ecx = req.query.get("copy_ecx", "true") == "true"
+        loc = self.store.find_free_location()
+        if loc is None:
+            raise HttpError(507, "no free disk location")
+        base = volume_file_prefix(loc.directory, collection, vid)
+        name = os.path.basename(base)
+        exts = [to_ext(s) for s in shard_ids]
+        if copy_ecx:
+            exts += [".ecx", ".vif"]
+            if self._remote_file_exists(source, name + ".ecj"):
+                exts.append(".ecj")
+        for ext in exts:
+            data = http_call(
+                "GET", f"http://{source}/admin/file?name={name}{ext}",
+                timeout=300)
+            with open(base + ext, "wb") as f:
+                f.write(data)
+        return {"volume": vid, "copied": exts}
+
+    def _remote_file_exists(self, source: str, name: str) -> bool:
+        try:
+            get_json(f"http://{source}/admin/file?name={name}&stat=true")
+            return True
+        except HttpError:
+            return False
+
+    def admin_ec_to_volume(self, req: Request):
+        """Decode mounted EC shards back into a normal volume (reference
+        VolumeEcShardsToVolume)."""
+        from ..ec import decoder as ec_decoder
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            raise HttpError(404, f"ec volume {vid} not mounted")
+        if len([s for s in ev.shard_ids() if s < DATA_SHARDS]) < DATA_SHARDS:
+            raise HttpError(409, "need all data shards local to decode")
+        base = ev.base_name
+        dat_size = ec_decoder.find_dat_file_size(base)
+        ec_decoder.write_dat_file(base, dat_size)
+        ec_decoder.write_idx_file_from_ec_index(base)
+        self.store.unmount_ec_shards(vid, list(range(TOTAL_SHARDS)))
+        for loc in self.store.locations:
+            if os.path.dirname(base) == loc.directory:
+                loc.load_existing_volumes()
+        self.heartbeat_once()
+        return {"volume": vid, "dat_size": dat_size}
+
+    def admin_ec_shard_read(self, req: Request):
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        offset = int(req.query.get("offset", 0))
+        size = int(req.query.get("size", 0))
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or sid not in ev.shards:
+            raise HttpError(404, f"shard {vid}.{sid} not here")
+        return Response(ev.shards[sid].read_at(offset, size))
+
+    def admin_file(self, req: Request):
+        """Serve a raw storage file (EC copy pull path). Restricted to the
+        store's own directories and known extensions."""
+        name = os.path.basename(req.query.get("name", ""))
+        ok_ext = name.endswith((".ecx", ".ecj", ".vif", ".dat", ".idx")) or \
+            ".ec" in name
+        if not name or not ok_ext:
+            raise HttpError(400, "bad file name")
+        for loc in self.store.locations:
+            path = os.path.join(loc.directory, name)
+            if os.path.exists(path):
+                if req.query.get("stat"):
+                    return {"size": os.path.getsize(path)}
+                offset = int(req.query.get("offset", 0))
+                size = int(req.query.get("size", 0)) \
+                    or os.path.getsize(path) - offset
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    return Response(f.read(size))
+        raise HttpError(404, f"{name} not found")
+
+    # -- data path ---------------------------------------------------------
+    def data_handler(self, req: Request):
+        if req.path == "/":
+            return self.status(req)
+        try:
+            vid, key, cookie = parse_file_id(req.path.lstrip("/"))
+        except ValueError:
+            raise HttpError(404, f"invalid fid path {req.path}") from None
+        if req.method in ("GET", "HEAD"):
+            return self.read_needle(req, vid, key, cookie)
+        if req.method in ("POST", "PUT"):
+            return self.write_needle(req, vid, key, cookie)
+        if req.method == "DELETE":
+            return self.delete_needle(req, vid, key, cookie)
+        raise HttpError(405, req.method)
+
+    def write_needle(self, req: Request, vid, key, cookie):
+        filename, ctype, data = req.upload_payload()
+        n = Needle(cookie=cookie, id=key, data=data)
+        if filename:
+            n.set_name(filename.encode())
+        if ctype and ctype != "application/octet-stream":
+            n.set_mime(ctype.encode())
+        n.set_last_modified()
+        from ..storage.types import TTL
+        ttl = TTL.parse(req.query.get("ttl", ""))
+        if ttl.to_uint32():
+            n.set_ttl(ttl)
+        try:
+            self.store.write_needle(vid, n)
+            size = len(data)  # reference reports DataSize, not needle Size
+        except VolumeError as e:
+            raise HttpError(500, str(e)) from None
+        # synchronous replica fan-out, all-must-succeed (reference
+        # store_replicate.go:20-83): attempt every replica, then fail the
+        # request if any write is missing so the client knows the needle is
+        # under-replicated
+        if req.query.get("type") != "replicate":
+            failed = []
+            for node_url in self._other_replicas(vid):
+                from .http_util import post_multipart
+                try:
+                    post_multipart(
+                        f"http://{node_url}{req.path}?type=replicate",
+                        filename, data, ctype or "application/octet-stream")
+                except HttpError as e:
+                    failed.append(f"{node_url}: {e.message or e.status}")
+            if failed:
+                raise HttpError(
+                    500, "replication failed on " + "; ".join(failed))
+        return {"name": filename, "size": size, "eTag": n.etag}
+
+    def _other_replicas(self, vid: int) -> List[str]:
+        cached = self._lookup_cache.get(vid)
+        if cached and time.time() - cached[0] < 10:
+            urls = cached[1]
+        else:
+            try:
+                out = get_json(f"http://{self.master_url}/dir/lookup"
+                               f"?volumeId={vid}", timeout=10)
+                urls = [l["url"] for l in out.get("locations", [])]
+            except HttpError:
+                urls = []
+            self._lookup_cache[vid] = (time.time(), urls)
+        return [u for u in urls if u != self.url]
+
+    def read_needle(self, req: Request, vid, key, cookie):
+        n = Needle(id=key, cookie=cookie)
+        v = self.store.find_volume(vid)
+        if v is None:
+            ev = self.store.find_ec_volume(vid)
+            if ev is not None:
+                return self._read_ec_needle(ev, vid, key, cookie)
+            # not local: redirect to a replica (reference
+            # volume_server_handlers_read.go:57-80)
+            if self.read_redirect:
+                others = self._other_replicas(vid)
+                if others:
+                    return Response(
+                        b"", 301,
+                        headers={"Location":
+                                 f"http://{others[0]}{req.path}"})
+            raise HttpError(404, f"volume {vid} not found")
+        try:
+            got = self.store.read_needle(vid, n)
+        except NotFound as e:
+            raise HttpError(404, str(e)) from None
+        return self._needle_response(got)
+
+    def _needle_response(self, got: Needle) -> Response:
+        ctype = got.mime.decode() if got.has_mime() \
+            else "application/octet-stream"
+        headers = {"Etag": f'"{got.etag}"'}
+        if got.has_name():
+            headers["Content-Disposition"] = \
+                f'inline; filename="{got.name.decode("utf-8", "replace")}"'
+        return Response(got.data, 200, ctype, headers)
+
+    # -- EC degraded read (reference store_ec.go:119-373) ------------------
+    def _read_ec_needle(self, ev, vid, key, cookie):
+        from ..ec.ec_volume import EcShardNotFound
+        try:
+            blob = ev.read_needle_blob(
+                key,
+                remote_fetch=self._fetch_remote_shard,
+                reconstruct_fetch=self._reconstruct_shard_range)
+        except KeyError:
+            raise HttpError(404, f"needle {key} not in ec volume {vid}") \
+                from None
+        except EcShardNotFound as e:
+            raise HttpError(503, f"ec volume {vid}: {e}") from None
+        got = Needle.from_bytes(blob, ev.version)
+        if got.cookie != cookie:
+            raise HttpError(404, "cookie mismatch")
+        return self._needle_response(got)
+
+    def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
+        try:
+            out = get_json(f"http://{self.master_url}/cluster/ec_lookup"
+                           f"?volumeId={vid}", timeout=10)
+            return {int(k): v for k, v in out.get("shards", {}).items()}
+        except HttpError:
+            return {}
+
+    def _fetch_remote_shard(self, vid, sid, offset, size) -> Optional[bytes]:
+        for holder in self._ec_shard_locations(vid).get(sid, []):
+            if holder == self.url:
+                continue
+            try:
+                return http_call(
+                    "GET",
+                    f"http://{holder}/admin/ec/shard_read?volume={vid}"
+                    f"&shard={sid}&offset={offset}&size={size}", timeout=30)
+            except HttpError:
+                continue
+        return None
+
+    def _reconstruct_shard_range(self, vid, sid, offset, size) -> bytes:
+        """Fetch the same range of >=DATA_SHARDS sibling shards and decode
+        (reference recoverOneRemoteEcShardInterval)."""
+        ev = self.store.find_ec_volume(vid)
+        locations = self._ec_shard_locations(vid)
+        shards: List[Optional[np.ndarray]] = [None] * TOTAL_SHARDS
+        have = 0
+        for other in range(TOTAL_SHARDS):
+            if other == sid or have >= DATA_SHARDS:
+                continue
+            data = None
+            if ev is not None and other in ev.shards:
+                data = ev.shards[other].read_at(offset, size)
+                if len(data) < size:
+                    data = data + b"\x00" * (size - len(data))
+            else:
+                for holder in locations.get(other, []):
+                    if holder == self.url:
+                        continue
+                    try:
+                        data = http_call(
+                            "GET",
+                            f"http://{holder}/admin/ec/shard_read"
+                            f"?volume={vid}&shard={other}&offset={offset}"
+                            f"&size={size}", timeout=30)
+                        break
+                    except HttpError:
+                        continue
+            if data is not None:
+                if len(data) < size:  # shard tail: zero-pad like local reads
+                    data = data + b"\x00" * (size - len(data))
+                shards[other] = np.frombuffer(data, dtype=np.uint8)
+                have += 1
+        if have < DATA_SHARDS:
+            raise HttpError(
+                503, f"cannot reconstruct {vid}.{sid}: {have} shards")
+        codec = self.store.codec or get_codec(DATA_SHARDS, 4)
+        out = codec.reconstruct(shards)
+        return out[sid].tobytes()
+
+    def _delete_ec_needle(self, req: Request, ev, vid, key):
+        """EC delete: tombstone + journal locally, then broadcast to every
+        other shard holder (reference store_ec_delete.go:15-110)."""
+        found = ev.delete_needle(key)
+        if req.query.get("type") != "replicate":
+            notified = {self.url}
+            for holders in self._ec_shard_locations(vid).values():
+                for holder in holders:
+                    if holder in notified:
+                        continue
+                    notified.add(holder)
+                    try:
+                        http_call(
+                            "DELETE",
+                            f"http://{holder}{req.path}?type=replicate")
+                        found = True
+                    except HttpError:
+                        pass
+        if not found:
+            raise HttpError(404, f"needle {key} not in ec volume {vid}")
+        return {"size": 0}
+
+    def delete_needle(self, req: Request, vid, key, cookie):
+        n = Needle(id=key, cookie=cookie)
+        v = self.store.find_volume(vid)
+        if v is None:
+            ev = self.store.find_ec_volume(vid)
+            if ev is not None:
+                return self._delete_ec_needle(req, ev, vid, key)
+            raise HttpError(404, f"volume {vid} not found")
+        try:
+            freed = self.store.delete_needle(vid, n)
+        except VolumeError as e:
+            raise HttpError(500, str(e)) from None
+        if req.query.get("type") != "replicate":
+            for node_url in self._other_replicas(vid):
+                try:
+                    http_call("DELETE",
+                              f"http://{node_url}{req.path}?type=replicate")
+                except HttpError:
+                    pass
+        return {"size": freed}

@@ -1,0 +1,114 @@
+"""DiskLocation — one data directory holding volumes and EC shards.
+
+Reference weed/storage/disk_location.go + disk_location_ec.go: scans the
+directory on boot, loading every .idx/.dat volume and every .ecx/.ecNN
+shard set.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from ..ec.ec_volume import EcVolume
+from .volume import Volume
+
+_VOL_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.idx$")
+_ECX_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ecx$")
+_EC_SHARD_RE = re.compile(
+    r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d{2})$")
+
+
+class DiskLocation:
+    def __init__(self, directory: str, max_volume_count: int = 7):
+        self.directory = os.path.abspath(directory)
+        self.max_volume_count = max_volume_count
+        self.volumes: Dict[int, Volume] = {}
+        self.ec_volumes: Dict[int, EcVolume] = {}
+        self.lock = threading.RLock()
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- boot scan ---------------------------------------------------------
+    def load_existing_volumes(self):
+        with self.lock:
+            for fname in sorted(os.listdir(self.directory)):
+                m = _VOL_RE.match(fname)
+                if not m:
+                    continue
+                vid = int(m.group("vid"))
+                collection = m.group("collection") or ""
+                dat = os.path.join(
+                    self.directory,
+                    fname[: -len(".idx")] + ".dat")
+                if not os.path.exists(dat):
+                    continue
+                if vid not in self.volumes:
+                    try:
+                        self.volumes[vid] = Volume(
+                            self.directory, collection, vid)
+                    except Exception:
+                        continue  # quarantine unloadable volumes
+
+    def load_all_ec_shards(self):
+        with self.lock:
+            shard_sets: Dict[int, tuple] = {}
+            for fname in sorted(os.listdir(self.directory)):
+                m = _EC_SHARD_RE.match(fname)
+                if not m:
+                    continue
+                vid = int(m.group("vid"))
+                shard_sets.setdefault(
+                    vid, (m.group("collection") or "", []))[1].append(
+                    int(m.group("shard")))
+            for vid, (collection, shards) in shard_sets.items():
+                base = os.path.join(
+                    self.directory,
+                    f"{collection}_{vid}" if collection else str(vid))
+                if not os.path.exists(base + ".ecx"):
+                    continue
+                try:
+                    ev = EcVolume(self.directory, collection, vid)
+                    for sid in sorted(shards):
+                        ev.add_shard(sid)
+                    self.ec_volumes[vid] = ev
+                except Exception:
+                    continue
+
+    # -- volume management -------------------------------------------------
+    def add_volume(self, collection: str, vid: int, **kwargs) -> Volume:
+        with self.lock:
+            if vid in self.volumes:
+                return self.volumes[vid]
+            v = Volume(self.directory, collection, vid, create=True, **kwargs)
+            self.volumes[vid] = v
+            return v
+
+    def get_volume(self, vid: int) -> Optional[Volume]:
+        return self.volumes.get(vid)
+
+    def delete_volume(self, vid: int) -> bool:
+        with self.lock:
+            v = self.volumes.pop(vid, None)
+            if v is None:
+                return False
+            v.destroy()
+            return True
+
+    def unload_volume(self, vid: int) -> bool:
+        with self.lock:
+            v = self.volumes.pop(vid, None)
+            if v is None:
+                return False
+            v.close()
+            return True
+
+    def close(self):
+        with self.lock:
+            for v in self.volumes.values():
+                v.close()
+            for ev in self.ec_volumes.values():
+                ev.close()
+            self.volumes.clear()
+            self.ec_volumes.clear()

@@ -1,0 +1,230 @@
+"""Store — a volume server's aggregate of disk locations.
+
+Reference weed/storage/store.go: owns volumes + EC volumes across
+directories, assembles heartbeats for the master, routes reads/writes to
+volumes, and hosts the EC lifecycle operations (generate/mount/rebuild).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..ec import encoder as ec_encoder
+from ..ec.constants import TOTAL_SHARDS, to_ext
+from ..ec.ec_volume import EcVolume, rebuild_ecx_file
+from ..ops.codec import ReedSolomonCodec
+from .disk_location import DiskLocation
+from .needle import Needle
+from .types import TTL, ReplicaPlacement
+from .volume import Volume, VolumeError, volume_file_prefix
+
+
+class Store:
+    def __init__(self, directories: List[str], max_volume_counts=None,
+                 ip: str = "127.0.0.1", port: int = 8080,
+                 public_url: str = "", data_center: str = "",
+                 rack: str = "", codec: Optional[ReedSolomonCodec] = None):
+        if isinstance(directories, str):
+            directories = [directories]
+        max_volume_counts = max_volume_counts or [7] * len(directories)
+        self.locations = [DiskLocation(d, m)
+                          for d, m in zip(directories, max_volume_counts)]
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.data_center = data_center
+        self.rack = rack
+        self.codec = codec
+        self.lock = threading.RLock()
+        for loc in self.locations:
+            loc.load_existing_volumes()
+            loc.load_all_ec_shards()
+
+    # -- lookup ------------------------------------------------------------
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.get_volume(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def find_free_location(self) -> Optional[DiskLocation]:
+        """Location with a free slot; EC shards count as 1/10 volume
+        (reference store.go:99-112)."""
+        best, best_free = None, 0.0
+        for loc in self.locations:
+            ec_shards = sum(len(ev.shards) for ev in loc.ec_volumes.values())
+            free = loc.max_volume_count - len(loc.volumes) - ec_shards / 10.0
+            if free >= 1 and free > best_free:
+                best, best_free = loc, free
+        return best
+
+    # -- volume lifecycle --------------------------------------------------
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "000", ttl: str = "") -> Volume:
+        if self.find_volume(vid) is not None:
+            return self.find_volume(vid)
+        loc = self.find_free_location()
+        if loc is None:
+            raise VolumeError("no free volume slots")
+        return loc.add_volume(
+            collection, vid,
+            replica_placement=ReplicaPlacement.parse(replication),
+            ttl=TTL.parse(ttl))
+
+    def delete_volume(self, vid: int) -> bool:
+        for loc in self.locations:
+            if loc.delete_volume(vid):
+                return True
+        return False
+
+    def mark_volume_readonly(self, vid: int, readonly: bool = True) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.readonly = readonly
+        return True
+
+    # -- data path ---------------------------------------------------------
+    def write_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_needle(self, vid: int, n: Needle) -> Needle:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.read_needle(n)
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.delete_needle(n)
+
+    # -- EC lifecycle (reference volume_grpc_erasure_coding.go) ------------
+    def generate_ec_shards(self, vid: int, collection: str = "") -> str:
+        """Volume .dat/.idx -> .ec00-13 + .ecx + .vif on the same disk."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        if not v.readonly:
+            raise VolumeError(f"volume {vid} must be readonly for ec encode")
+        base = v.file_name()
+        ec_encoder.write_sorted_file_from_idx(base)
+        ec_encoder.write_ec_files(base, codec=self.codec)
+        import json
+        with open(base + ".vif", "w") as f:
+            json.dump({"version": v.version}, f)
+        return base
+
+    def mount_ec_shards(self, vid: int, collection: str,
+                        shard_ids: List[int]) -> List[int]:
+        mounted = []
+        for loc in self.locations:
+            base = volume_file_prefix(loc.directory, collection, vid)
+            if not os.path.exists(base + ".ecx"):
+                continue
+            ev = loc.ec_volumes.get(vid)
+            created = ev is None
+            if created:
+                ev = EcVolume(loc.directory, collection, vid)
+            for sid in shard_ids:
+                if os.path.exists(base + to_ext(sid)) and ev.add_shard(sid):
+                    mounted.append(sid)
+            if created:
+                # never leave a shard-less EcVolume registered — it would
+                # shadow the replica-redirect path for reads
+                if ev.shards:
+                    loc.ec_volumes[vid] = ev
+                else:
+                    ev.close()
+            break
+        return mounted
+
+    def unmount_ec_shards(self, vid: int, shard_ids: List[int]) -> List[int]:
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            return []
+        out = []
+        for sid in shard_ids:
+            shard = ev.delete_shard(sid)
+            if shard is not None:
+                shard.close()
+                out.append(sid)
+        if not ev.shards:
+            for loc in self.locations:
+                if loc.ec_volumes.get(vid) is ev:
+                    loc.ec_volumes.pop(vid)
+            ev.close()
+        return out
+
+    def rebuild_ec_shards(self, vid: int, collection: str = "") -> List[int]:
+        for loc in self.locations:
+            base = volume_file_prefix(loc.directory, collection, vid)
+            if os.path.exists(base + ".ecx"):
+                rebuilt = ec_encoder.rebuild_ec_files(base, codec=self.codec)
+                rebuild_ecx_file(base)
+                return rebuilt
+        raise VolumeError(f"ec volume {vid} not found")
+
+    # -- heartbeat (reference store.go:193-247 CollectHeartbeat) -----------
+    def collect_heartbeat(self) -> dict:
+        volumes = []
+        ec_shards: Dict[int, int] = {}
+        ec_collections: Dict[int, str] = {}
+        max_file_key = 0
+        max_volume_count = 0
+        for loc in self.locations:
+            max_volume_count += loc.max_volume_count
+            for vid, v in list(loc.volumes.items()):
+                max_file_key = max(max_file_key, v.max_file_key())
+                volumes.append({
+                    "id": vid,
+                    "collection": v.collection,
+                    "size": v.size(),
+                    "file_count": v.file_count(),
+                    "delete_count": v.deleted_count(),
+                    "deleted_byte_count": v.deleted_size(),
+                    "read_only": v.readonly,
+                    "replica_placement":
+                        str(v.super_block.replica_placement),
+                    "ttl": v.super_block.ttl.to_uint32(),
+                    "version": v.version,
+                    "compact_revision": v.super_block.compaction_revision,
+                })
+            for vid, ev in loc.ec_volumes.items():
+                bits = 0
+                for sid in ev.shard_ids():
+                    bits |= 1 << sid
+                ec_shards[vid] = bits
+                ec_collections[vid] = ev.collection
+        return {
+            "ip": self.ip, "port": self.port, "public_url": self.public_url,
+            "data_center": self.data_center, "rack": self.rack,
+            "max_volume_count": max_volume_count,
+            "max_file_key": max_file_key,
+            "volumes": volumes,
+            "ec_shards": ec_shards,
+            "ec_collections": ec_collections,
+        }
+
+    def status(self) -> dict:
+        hb = self.collect_heartbeat()
+        hb["directories"] = [loc.directory for loc in self.locations]
+        return hb
+
+    def close(self):
+        for loc in self.locations:
+            loc.close()

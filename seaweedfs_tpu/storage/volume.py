@@ -198,6 +198,14 @@ class Volume:
             nv = self.nm.get(n.id)
             if nv is None or nv.size == TOMBSTONE_FILE_SIZE:
                 return 0
+            # deletes must present the original cookie too (same id-guessing
+            # protection as the overwrite path; reference DeleteHandler
+            # reads the needle and compares cookies)
+            self.dat.seek(nv.offset)
+            stored = Needle.parse_header(self.dat.read(16))
+            if stored.cookie != n.cookie:
+                raise VolumeError(
+                    f"needle {n.id}: mismatching cookie on delete")
             freed = nv.size
             self.nm.delete(n.id)
             tomb = Needle(cookie=n.cookie, id=n.id, data=b"",

@@ -1,0 +1,11 @@
+"""topology — master-side cluster state and placement.
+
+DataCenter -> Rack -> DataNode tree with up-adjusting capacity counters,
+per-(collection, replication, ttl) volume layouts, growth/placement, and
+EC shard maps (reference weed/topology/).
+"""
+
+from .node import DataCenter, DataNode, Rack  # noqa: F401
+from .topology import Topology  # noqa: F401
+from .volume_layout import VolumeLayout  # noqa: F401
+from .volume_growth import VolumeGrowth  # noqa: F401

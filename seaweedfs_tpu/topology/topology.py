@@ -1,0 +1,239 @@
+"""Topology — the master's root cluster state.
+
+Reference weed/topology/topology.go + topology_ec.go +
+master_grpc_server.go heartbeat handling: registers volume servers from
+heartbeats, tracks per-layout writable volumes and the EC shard map, hands
+out file ids (sequencer), and scans for vacuum candidates.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..storage.types import TTL, ReplicaPlacement
+from .node import DataCenter, DataNode, VolumeInfo
+from .volume_layout import VolumeLayout
+
+
+class Sequencer:
+    """In-memory monotonically increasing file-key generator
+    (reference weed/sequence/memory_sequencer.go)."""
+
+    def __init__(self, start: int = 1):
+        self._counter = start
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            return start
+
+    def set_max(self, seen: int):
+        with self._lock:
+            if seen >= self._counter:
+                self._counter = seen + 1
+
+
+class Topology:
+    def __init__(self, volume_size_limit: int = 30 * 1024 * 1024 * 1024,
+                 pulse_seconds: int = 5, sequencer: Sequencer = None):
+        self.data_centers: Dict[str, DataCenter] = {}
+        self.volume_size_limit = volume_size_limit
+        self.pulse_seconds = pulse_seconds
+        self.sequencer = sequencer or Sequencer()
+        self.layouts: Dict[Tuple[str, str, int], VolumeLayout] = {}
+        # vid -> shard_id -> [DataNode] (reference topology_ec.go ecShardMap)
+        self.ec_shard_map: Dict[int, List[List[DataNode]]] = {}
+        self.ec_collections: Dict[int, str] = {}
+        self.max_volume_id = 0
+        self.lock = threading.RLock()
+
+    # -- tree --------------------------------------------------------------
+    def get_or_create_dc(self, dc_id: str) -> DataCenter:
+        with self.lock:
+            dc = self.data_centers.get(dc_id)
+            if dc is None:
+                dc = DataCenter(dc_id)
+                self.data_centers[dc_id] = dc
+            return dc
+
+    def all_nodes(self) -> List[DataNode]:
+        return [n for dc in self.data_centers.values()
+                for n in dc.all_nodes()]
+
+    def find_node(self, url: str) -> Optional[DataNode]:
+        for n in self.all_nodes():
+            if n.url == url:
+                return n
+        return None
+
+    # -- layouts -----------------------------------------------------------
+    def get_layout(self, collection: str, replication: str,
+                   ttl: int) -> VolumeLayout:
+        key = (collection, replication, ttl)
+        with self.lock:
+            layout = self.layouts.get(key)
+            if layout is None:
+                layout = VolumeLayout(ReplicaPlacement.parse(replication),
+                                      ttl, self.volume_size_limit)
+                self.layouts[key] = layout
+            return layout
+
+    # -- heartbeat registration (reference master_grpc_server.go:20-176) ---
+    def register_heartbeat(self, dc_id: str, rack_id: str, ip: str,
+                           port: int, public_url: str,
+                           max_volume_count: int,
+                           volumes: List[dict],
+                           ec_shards: Dict[int, int] = None,
+                           ec_collections: Dict[int, str] = None,
+                           max_file_key: int = 0) -> DataNode:
+        with self.lock:
+            dc = self.get_or_create_dc(dc_id or "DefaultDataCenter")
+            rack = dc.get_or_create_rack(rack_id or "DefaultRack")
+            node = rack.get_or_create_node(ip, port, public_url,
+                                           max_volume_count)
+            node.last_seen = time.time()
+            self.sequencer.set_max(max_file_key)
+
+            infos = [VolumeInfo.from_dict(v) for v in volumes]
+            old_vids = set(node.volumes)
+            new_vids = {vi.id for vi in infos}
+            node.update_volumes(infos)
+            for vi in infos:
+                self.max_volume_id = max(self.max_volume_id, vi.id)
+                layout = self.get_layout(vi.collection, vi.replica_placement,
+                                         vi.ttl)
+                layout.register_volume(vi, node)
+            for vid in old_vids - new_vids:
+                for layout in self.layouts.values():
+                    layout.unregister_volume(vid, node)
+
+            if ec_shards is not None:
+                node.update_ec_shards(ec_shards, ec_collections or {})
+                self._sync_ec_shards(node)
+            return node
+
+    def _sync_ec_shards(self, node: DataNode):
+        # rebuild this node's contribution to the ec shard map
+        for vid, per_shard in self.ec_shard_map.items():
+            for holders in per_shard:
+                if node in holders:
+                    holders.remove(node)
+        from ..ec.constants import TOTAL_SHARDS
+        for vid, bits in node.ec_shards.items():
+            per_shard = self.ec_shard_map.setdefault(
+                vid, [[] for _ in range(TOTAL_SHARDS)])
+            self.ec_collections[vid] = \
+                node.ec_shard_collections.get(vid, "")
+            self.max_volume_id = max(self.max_volume_id, vid)
+            for sid in bits.shard_ids():
+                if node not in per_shard[sid]:
+                    per_shard[sid].append(node)
+
+    def unregister_node(self, node: DataNode):
+        """Heartbeat stream broke: drop the node and its volumes."""
+        with self.lock:
+            for layout in self.layouts.values():
+                for vid in list(node.volumes):
+                    layout.set_volume_unavailable(vid, node)
+            for per_shard in self.ec_shard_map.values():
+                for holders in per_shard:
+                    if node in holders:
+                        holders.remove(node)
+            if node.rack:
+                node.rack.nodes.pop(node.url, None)
+
+    def prune_dead_nodes(self, timeout: float = None) -> List[DataNode]:
+        timeout = timeout or self.pulse_seconds * 5
+        dead = [n for n in self.all_nodes()
+                if time.time() - n.last_seen > timeout]
+        for n in dead:
+            self.unregister_node(n)
+        return dead
+
+    # -- assignment --------------------------------------------------------
+    def next_volume_id(self) -> int:
+        with self.lock:
+            self.max_volume_id += 1
+            return self.max_volume_id
+
+    def pick_for_write(self, collection: str, replication: str,
+                       ttl: TTL, count: int = 1) -> Optional[tuple]:
+        """-> (fid, count, node, all_replica_nodes) or None."""
+        layout = self.get_layout(collection, replication, ttl.to_uint32())
+        picked = layout.pick_for_write()
+        if picked is None:
+            return None
+        vid, locs = picked
+        key = self.sequencer.next_file_id(count)
+        cookie = random.getrandbits(32)
+        from ..storage.types import format_file_id
+        fid = format_file_id(vid, key, cookie)
+        return fid, count, locs[0], locs
+
+    def lookup(self, collection: str, vid: int) -> Optional[List[DataNode]]:
+        with self.lock:
+            for (coll, _, _), layout in self.layouts.items():
+                if collection and coll != collection:
+                    continue
+                locs = layout.lookup(vid)
+                if locs:
+                    return locs
+        # EC volumes resolve via the shard map
+        per_shard = self.ec_shard_map.get(vid)
+        if per_shard:
+            nodes = []
+            for holders in per_shard:
+                for n in holders:
+                    if n not in nodes:
+                        nodes.append(n)
+            return nodes or None
+        return None
+
+    def lookup_ec_shards(self, vid: int) -> Optional[dict]:
+        with self.lock:
+            per_shard = self.ec_shard_map.get(vid)
+            if not per_shard:
+                return None
+            return {sid: [n.url for n in holders]
+                    for sid, holders in enumerate(per_shard) if holders}
+
+    # -- vacuum scan (reference topology_vacuum.go) ------------------------
+    def vacuum_candidates(self, garbage_threshold: float = 0.3
+                          ) -> List[Tuple[int, List[DataNode]]]:
+        out = []
+        with self.lock:
+            seen = set()
+            for node in self.all_nodes():
+                for vi in node.volumes.values():
+                    if vi.id in seen or vi.read_only:
+                        continue
+                    if vi.size > 0 and \
+                            vi.deleted_byte_count / max(vi.size, 1) \
+                            > garbage_threshold:
+                        layout = self.get_layout(
+                            vi.collection, vi.replica_placement, vi.ttl)
+                        locs = layout.lookup(vi.id) or [node]
+                        out.append((vi.id, locs))
+                        seen.add(vi.id)
+        return out
+
+    def to_dict(self) -> dict:
+        with self.lock:
+            return {
+                "max_volume_id": self.max_volume_id,
+                "data_centers": {
+                    dc.id: {
+                        rack.id: {n.url: n.to_dict()
+                                  for n in rack.all_nodes()}
+                        for rack in dc.racks.values()
+                    } for dc in self.data_centers.values()
+                },
+                "layouts": [layout.to_dict()
+                            for layout in self.layouts.values()],
+                "ec_volumes": sorted(self.ec_shard_map),
+            }

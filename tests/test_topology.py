@@ -1,0 +1,138 @@
+"""Placement + topology logic on synthetic clusters (reference
+volume_growth_test.go / topology_test.go style — pure logic, no servers)."""
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage.types import ReplicaPlacement
+from seaweedfs_tpu.topology.topology import Topology
+from seaweedfs_tpu.topology.volume_growth import NoFreeSlots, \
+    find_empty_slots
+
+
+def _build_topo(spec):
+    """spec: {dc: {rack: [(ip, port, max_count), ...]}}"""
+    topo = Topology()
+    for dc_id, racks in spec.items():
+        for rack_id, nodes in racks.items():
+            for ip, port, maxc in nodes:
+                topo.register_heartbeat(dc_id, rack_id, ip, port, "",
+                                        maxc, [])
+    return topo
+
+
+THREE_DC = {
+    "dc1": {"r11": [("10.0.1.1", 8080, 10), ("10.0.1.2", 8080, 10)],
+            "r12": [("10.0.1.3", 8080, 10)]},
+    "dc2": {"r21": [("10.0.2.1", 8080, 10)]},
+    "dc3": {"r31": [("10.0.3.1", 8080, 10), ("10.0.3.2", 8080, 10)]},
+}
+
+
+def test_placement_000():
+    topo = _build_topo(THREE_DC)
+    nodes = find_empty_slots(topo, ReplicaPlacement.parse("000"),
+                             rng=random.Random(0))
+    assert len(nodes) == 1
+
+
+def test_placement_001_same_rack():
+    topo = _build_topo(THREE_DC)
+    for seed in range(10):
+        nodes = find_empty_slots(topo, ReplicaPlacement.parse("001"),
+                                 rng=random.Random(seed))
+        assert len(nodes) == 2
+        assert nodes[0].rack is nodes[1].rack
+        assert nodes[0] is not nodes[1]
+
+
+def test_placement_010_other_rack():
+    topo = _build_topo(THREE_DC)
+    for seed in range(10):
+        nodes = find_empty_slots(topo, ReplicaPlacement.parse("010"),
+                                 rng=random.Random(seed))
+        assert len(nodes) == 2
+        assert nodes[0].rack is not nodes[1].rack
+        assert nodes[0].rack.data_center is nodes[1].rack.data_center
+
+
+def test_placement_100_other_dc():
+    topo = _build_topo(THREE_DC)
+    for seed in range(10):
+        nodes = find_empty_slots(topo, ReplicaPlacement.parse("100"),
+                                 rng=random.Random(seed))
+        assert len(nodes) == 2
+        assert nodes[0].rack.data_center is not nodes[1].rack.data_center
+
+
+def test_placement_200_three_dcs():
+    topo = _build_topo(THREE_DC)
+    nodes = find_empty_slots(topo, ReplicaPlacement.parse("200"),
+                             rng=random.Random(1))
+    dcs = {n.rack.data_center.id for n in nodes}
+    assert len(dcs) == 3
+
+
+def test_placement_fails_when_impossible():
+    topo = _build_topo({"dc1": {"r1": [("10.0.0.1", 8080, 10)]}})
+    with pytest.raises(NoFreeSlots):
+        find_empty_slots(topo, ReplicaPlacement.parse("001"))
+    with pytest.raises(NoFreeSlots):
+        find_empty_slots(topo, ReplicaPlacement.parse("100"))
+
+
+def test_placement_respects_full_nodes():
+    topo = _build_topo({"dc1": {"r1": [("10.0.0.1", 8080, 0),
+                                       ("10.0.0.2", 8080, 5)]}})
+    for seed in range(5):
+        nodes = find_empty_slots(topo, ReplicaPlacement.parse("000"),
+                                 rng=random.Random(seed))
+        assert nodes[0].url == "10.0.0.2:8080"
+
+
+def test_heartbeat_registration_and_layout():
+    topo = _build_topo(THREE_DC)
+    vi = {"id": 5, "collection": "", "size": 1000, "file_count": 3,
+          "replica_placement": "000", "ttl": 0}
+    node = topo.register_heartbeat("dc1", "r11", "10.0.1.1", 8080, "", 10,
+                                   [vi])
+    assert node.volume_count() == 1
+    layout = topo.get_layout("", "000", 0)
+    assert layout.lookup(5)[0] is node
+    assert 5 in layout.writables
+    # volume disappears from next heartbeat -> unregistered
+    topo.register_heartbeat("dc1", "r11", "10.0.1.1", 8080, "", 10, [])
+    assert layout.lookup(5) is None
+
+
+def test_ec_shard_sync_and_lookup():
+    topo = _build_topo(THREE_DC)
+    bits = 0
+    for sid in (0, 1, 2):
+        bits |= 1 << sid
+    topo.register_heartbeat("dc1", "r11", "10.0.1.1", 8080, "", 10, [],
+                            ec_shards={7: bits}, ec_collections={7: "c"})
+    bits2 = 0
+    for sid in range(3, 14):
+        bits2 |= 1 << sid
+    topo.register_heartbeat("dc2", "r21", "10.0.2.1", 8080, "", 10, [],
+                            ec_shards={7: bits2}, ec_collections={7: "c"})
+    shards = topo.lookup_ec_shards(7)
+    assert set(shards) == set(range(14))
+    assert shards[0] == ["10.0.1.1:8080"]
+    assert shards[13] == ["10.0.2.1:8080"]
+    # node drops its shards on next heartbeat
+    topo.register_heartbeat("dc1", "r11", "10.0.1.1", 8080, "", 10, [],
+                            ec_shards={}, ec_collections={})
+    shards = topo.lookup_ec_shards(7)
+    assert 0 not in shards
+
+
+def test_sequencer_monotonic_across_heartbeats():
+    topo = _build_topo(THREE_DC)
+    a = topo.sequencer.next_file_id()
+    topo.register_heartbeat("dc1", "r11", "10.0.1.1", 8080, "", 10, [],
+                            max_file_key=1000)
+    b = topo.sequencer.next_file_id()
+    assert b > 1000 > a

@@ -53,6 +53,16 @@ def test_overwrite_requires_matching_cookie(tmp_path):
     v.close()
 
 
+def test_delete_requires_matching_cookie(tmp_path):
+    v = Volume(str(tmp_path), "", 4, create=True)
+    v.write_needle(_n(9))
+    with pytest.raises(VolumeError):
+        v.delete_needle(Needle(id=9, cookie=0xBAD))
+    assert v.read_needle(Needle(id=9, cookie=0x1009)).data == _n(9).data
+    assert v.delete_needle(Needle(id=9, cookie=0x1009)) > 0
+    v.close()
+
+
 def test_volume_ttl_stamped_on_needles(tmp_path):
     v = Volume(str(tmp_path), "", 3, create=True, ttl=TTL.parse("3h"))
     v.write_needle(_n(1))
