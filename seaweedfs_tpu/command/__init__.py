@@ -1,0 +1,1 @@
+"""command — the `weed`-style CLI entry points."""

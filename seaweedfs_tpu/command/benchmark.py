@@ -1,0 +1,116 @@
+"""Built-in cluster load generator.
+
+Reference weed/command/benchmark.go (defaults: 16 concurrent, 1KB files,
+1M files, collection "benchmark"): concurrent assign+upload, then random
+reads, reporting req/s, throughput, and latency percentiles — the
+reference's README numbers (README.md:477-522) come from exactly this
+tool.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+from ..client import operation as op
+from ..server.http_util import HttpError, http_call
+
+
+class Stats:
+    def __init__(self):
+        self.latencies: List[float] = []
+        self.failed = 0
+        self.bytes = 0
+        self.lock = threading.Lock()
+
+    def add(self, dt: float, nbytes: int):
+        with self.lock:
+            self.latencies.append(dt)
+            self.bytes += nbytes
+
+    def fail(self):
+        with self.lock:
+            self.failed += 1
+
+    def report(self, title: str, wall: float, out):
+        lat = sorted(self.latencies)
+        n = len(lat)
+        print(f"\n--- {title} ---", file=out)
+        print(f"requests: {n} ok, {self.failed} failed in {wall:.3f}s",
+              file=out)
+        if not n:
+            return
+        print(f"throughput: {n / wall:.2f} req/s, "
+              f"{self.bytes / wall / 1024:.2f} KB/s", file=out)
+        for p in (50, 75, 90, 95, 99):
+            print(f"  p{p}: {lat[min(n - 1, n * p // 100)] * 1000:.1f} ms",
+                  file=out)
+        print(f"  max: {lat[-1] * 1000:.1f} ms", file=out)
+
+
+def run_benchmark(master_url: str, num_files: int = 1024,
+                  file_size: int = 1024, concurrency: int = 16,
+                  collection: str = "benchmark", write: bool = True,
+                  read: bool = True, out=None):
+    import sys
+    out = out or sys.stdout
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, file_size).astype(np.uint8).tobytes()
+    fids: List[str] = []
+    fid_lock = threading.Lock()
+
+    if write:
+        stats = Stats()
+        per_worker = num_files // concurrency
+
+        def writer(wid: int):
+            for i in range(per_worker):
+                t = time.perf_counter()
+                try:
+                    a = op.assign(master_url, collection=collection)
+                    op.upload(a["url"], a["fid"], payload,
+                              filename=f"b{wid}_{i}")
+                    stats.add(time.perf_counter() - t, file_size)
+                    with fid_lock:
+                        fids.append(a["fid"])
+                except HttpError:
+                    stats.fail()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(concurrency)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stats.report("write", time.perf_counter() - t0, out)
+
+    if read and fids:
+        stats = Stats()
+        cache = op.VidCache(master_url)
+        reads = len(fids)
+        idx_seq = rng.integers(0, len(fids), reads)
+        chunks = np.array_split(idx_seq, concurrency)
+
+        def reader(idxs):
+            for i in idxs:
+                fid = fids[int(i)]
+                t = time.perf_counter()
+                try:
+                    data = op.read_file(master_url, fid, cache)
+                    stats.add(time.perf_counter() - t, len(data))
+                except HttpError:
+                    stats.fail()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=reader, args=(c,))
+                   for c in chunks]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stats.report("random read", time.perf_counter() - t0, out)
+    return fids

@@ -1,0 +1,200 @@
+"""The `weed`-style CLI (reference weed/weed.go + weed/command/).
+
+Usage: python -m seaweedfs_tpu.command.cli <command> [flags]
+Commands: master, volume, server, shell, benchmark, upload, download,
+          version
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def cmd_master(args):
+    from ..server.master import MasterServer
+    m = MasterServer(port=args.port, host=args.ip,
+                     volume_size_limit_mb=args.volumeSizeLimitMB,
+                     default_replication=args.defaultReplication,
+                     pulse_seconds=args.pulseSeconds).start()
+    print(f"master listening on {m.url}")
+    _wait()
+
+
+def cmd_volume(args):
+    from ..server.volume_server import VolumeServer
+    dirs = args.dir.split(",")
+    maxes = [int(x) for x in args.max.split(",")] if args.max else None
+    if maxes and len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    vs = VolumeServer(port=args.port, host=args.ip, directories=dirs,
+                      master_url=args.mserver, data_center=args.dataCenter,
+                      rack=args.rack, max_volume_counts=maxes,
+                      pulse_seconds=args.pulseSeconds,
+                      ec_backend=args.ec_backend).start()
+    print(f"volume server listening on {vs.url}, "
+          f"heartbeating to {args.mserver}")
+    _wait()
+
+
+def cmd_server(args):
+    """Combined master + volume (+ filer) in one process
+    (reference `weed server`)."""
+    from ..server.master import MasterServer
+    from ..server.volume_server import VolumeServer
+    m = MasterServer(port=args.masterPort, host=args.ip,
+                     default_replication=args.defaultReplication).start()
+    dirs = args.dir.split(",")
+    maxes = [int(args.max)] * len(dirs)
+    vs = VolumeServer(port=args.port, host=args.ip, directories=dirs,
+                      master_url=m.url, data_center=args.dataCenter,
+                      rack=args.rack, pulse_seconds=args.pulseSeconds,
+                      max_volume_counts=maxes,
+                      ec_backend=args.ec_backend).start()
+    print(f"master on {m.url}, volume server on {vs.url}")
+    if args.filer:
+        from ..server.filer_server import FilerServer
+        f = FilerServer(port=args.filerPort, host=args.ip,
+                        master_url=m.url).start()
+        print(f"filer on {f.url}")
+    _wait()
+
+
+def cmd_shell(args):
+    from ..shell.command_env import CommandEnv, run_command
+    env = CommandEnv(args.master)
+    if args.c:
+        run_command(env, args.c)
+        return
+    print("seaweedfs_tpu shell; 'help' lists commands, 'exit' quits")
+    while True:
+        try:
+            line = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not run_command(env, line):
+            break
+
+
+def cmd_benchmark(args):
+    from .benchmark import run_benchmark
+    run_benchmark(args.master, num_files=args.n, file_size=args.size,
+                  concurrency=args.c, collection=args.collection)
+
+
+def cmd_upload(args):
+    from ..client import operation as op
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        fid = op.upload_data(args.master, data, filename=path,
+                             collection=args.collection,
+                             replication=args.replication, ttl=args.ttl)
+        print(f"{path} -> {fid}")
+
+
+def cmd_download(args):
+    from ..client import operation as op
+    for fid in args.fids:
+        data = op.read_file(args.master, fid)
+        out = fid.replace(",", "_")
+        with open(out, "wb") as f:
+            f.write(data)
+        print(f"{fid} -> {out} ({len(data)} bytes)")
+
+
+def cmd_version(args):
+    from .. import VERSION
+    print(f"seaweedfs_tpu {VERSION}")
+
+
+def _wait():
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        while True:
+            time.sleep(3600)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="weed-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    m = sub.add_parser("master", help="start a master server")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    m.add_argument("-defaultReplication", default="000")
+    m.add_argument("-pulseSeconds", type=int, default=5)
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume", help="start a volume server")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-dir", default="./data")
+    v.add_argument("-max", default="7")
+    v.add_argument("-mserver", default="127.0.0.1:9333")
+    v.add_argument("-dataCenter", default="")
+    v.add_argument("-rack", default="")
+    v.add_argument("-pulseSeconds", type=int, default=5)
+    v.add_argument("-ec.backend", dest="ec_backend", default="auto",
+                   choices=["auto", "numpy", "native", "tpu"])
+    v.set_defaults(fn=cmd_volume)
+
+    s = sub.add_parser("server", help="master + volume (+filer) combined")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-masterPort", type=int, default=9333)
+    s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-dir", default="./data")
+    s.add_argument("-max", default="50",
+                   help="volume slots per directory")
+    s.add_argument("-defaultReplication", default="000")
+    s.add_argument("-dataCenter", default="")
+    s.add_argument("-rack", default="")
+    s.add_argument("-pulseSeconds", type=int, default=5)
+    s.add_argument("-filer", action="store_true")
+    s.add_argument("-filerPort", type=int, default=8888)
+    s.add_argument("-ec.backend", dest="ec_backend", default="auto",
+                   choices=["auto", "numpy", "native", "tpu"])
+    s.set_defaults(fn=cmd_server)
+
+    sh = sub.add_parser("shell", help="admin shell")
+    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-c", default="", help="run one command and exit")
+    sh.set_defaults(fn=cmd_shell)
+
+    b = sub.add_parser("benchmark", help="cluster load test")
+    b.add_argument("-master", default="127.0.0.1:9333")
+    b.add_argument("-n", type=int, default=1024)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-c", type=int, default=16)
+    b.add_argument("-collection", default="benchmark")
+    b.set_defaults(fn=cmd_benchmark)
+
+    u = sub.add_parser("upload", help="upload files")
+    u.add_argument("-master", default="127.0.0.1:9333")
+    u.add_argument("-collection", default="")
+    u.add_argument("-replication", default="")
+    u.add_argument("-ttl", default="")
+    u.add_argument("files", nargs="+")
+    u.set_defaults(fn=cmd_upload)
+
+    d = sub.add_parser("download", help="download files by fid")
+    d.add_argument("-master", default="127.0.0.1:9333")
+    d.add_argument("fids", nargs="+")
+    d.set_defaults(fn=cmd_download)
+
+    ver = sub.add_parser("version", help="print version")
+    ver.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

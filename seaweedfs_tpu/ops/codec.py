@@ -154,17 +154,39 @@ class NumpyCodec(ReedSolomonCodec):
         return out
 
 
+_TPU_PROBE_RESULT = None
+
+
+def _tpu_present(timeout_s: float = 60.0) -> bool:
+    """Watchdogged TPU probe: jax.devices() can hang forever when the
+    device tunnel is broken, and a hung probe must not take the whole
+    server down with it. Result is cached for the process."""
+    global _TPU_PROBE_RESULT
+    if _TPU_PROBE_RESULT is not None:
+        return _TPU_PROBE_RESULT
+    import threading
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["tpu"] = any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            result["tpu"] = False
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    _TPU_PROBE_RESULT = bool(result.get("tpu", False))
+    return _TPU_PROBE_RESULT
+
+
 def get_codec(data_shards: int, parity_shards: int,
               backend: str = "auto",
               matrix_kind: str = "vandermonde") -> ReedSolomonCodec:
     if backend == "auto":
         from .rs_native import native_available
-        try:
-            import jax
-            has_tpu = any(d.platform == "tpu" for d in jax.devices())
-        except Exception:
-            has_tpu = False
-        if has_tpu:
+        if _tpu_present():
             backend = "tpu"
         elif native_available():
             backend = "native"

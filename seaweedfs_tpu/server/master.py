@@ -45,6 +45,8 @@ class MasterServer:
         router.add("POST", "/cluster/heartbeat", self.cluster_heartbeat)
         router.add("*", "/cluster/status", self.cluster_status)
         router.add("*", "/cluster/ec_lookup", self.ec_lookup)
+        router.add("*", "/cluster/ec_status", self.ec_status)
+        router.add("*", "/cluster/volumes", self.cluster_volumes)
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
@@ -179,6 +181,28 @@ class MasterServer:
         if shards is None:
             raise HttpError(404, f"ec volume {vid} not found")
         return {"volumeId": vid, "shards": shards}
+
+    def ec_status(self, req: Request):
+        """Full EC shard map: vid -> shard -> holder urls."""
+        with self.topology.lock:
+            return {"volumes": {
+                str(vid): {
+                    "collection": self.topology.ec_collections.get(vid, ""),
+                    "shards": {str(sid): [n.url for n in holders]
+                               for sid, holders in enumerate(per_shard)
+                               if holders},
+                } for vid, per_shard in self.topology.ec_shard_map.items()}}
+
+    def cluster_volumes(self, req: Request):
+        """Every volume replica: vid -> [{url, ...volume info}]."""
+        out = {}
+        with self.topology.lock:
+            for node in self.topology.all_nodes():
+                for vid, vi in list(node.volumes.items()):
+                    d = vi.to_dict()
+                    d["url"] = node.url
+                    out.setdefault(str(vid), []).append(d)
+        return {"volumes": out}
 
     def dir_status(self, req: Request):
         return {"topology": self.topology.to_dict(),

@@ -47,6 +47,10 @@ class VolumeServer:
         router.add("POST", "/admin/ec/unmount", self.admin_ec_unmount)
         router.add("POST", "/admin/ec/rebuild", self.admin_ec_rebuild)
         router.add("POST", "/admin/ec/copy", self.admin_ec_copy)
+        router.add("POST", "/admin/ec/delete_shards",
+                   self.admin_ec_delete_shards)
+        router.add("POST", "/admin/volume/copy", self.admin_volume_copy)
+        router.add("POST", "/admin/volume/verify", self.admin_volume_verify)
         router.add("POST", "/admin/ec/to_volume", self.admin_ec_to_volume)
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
         router.add("GET", "/admin/file", self.admin_file)
@@ -222,6 +226,93 @@ class VolumeServer:
             return True
         except HttpError:
             return False
+
+    def admin_ec_delete_shards(self, req: Request):
+        """Unmount + remove shard files (reference VolumeEcShardsDelete);
+        drops .ecx/.ecj/.vif once no shard files remain."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
+                     if s != ""]
+        self.store.unmount_ec_shards(vid, shard_ids)
+        removed = []
+        for loc in self.store.locations:
+            base = volume_file_prefix(loc.directory, collection, vid)
+            for sid in shard_ids:
+                p = base + to_ext(sid)
+                if os.path.exists(p):
+                    os.remove(p)
+                    removed.append(sid)
+            if not any(os.path.exists(base + to_ext(s))
+                       for s in range(TOTAL_SHARDS)):
+                for ext in (".ecx", ".ecj", ".vif"):
+                    if os.path.exists(base + ext):
+                        os.remove(base + ext)
+        self.heartbeat_once()
+        return {"volume": vid, "removed": removed}
+
+    def admin_volume_copy(self, req: Request):
+        """Pull a whole volume (.dat/.idx) from a source server and load it
+        (reference VolumeCopy: target pulls via CopyFile)."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        source = req.query["source"]
+        if self.store.find_volume(vid) is not None:
+            raise HttpError(409, f"volume {vid} already here")
+        loc = self.store.find_free_location()
+        if loc is None:
+            raise HttpError(507, "no free disk location")
+        base = volume_file_prefix(loc.directory, collection, vid)
+        name = os.path.basename(base)
+        # .idx before .dat: the .dat is append-only, so an index snapshot
+        # taken first can only reference bytes the later .dat snapshot
+        # already contains (a torn copy the other way yields index entries
+        # past the data end). Extra unindexed .dat tail is harmless.
+        for ext in (".idx", ".dat"):
+            self._pull_file(source, name + ext, base + ext)
+        loc.load_existing_volumes()
+        self.heartbeat_once()
+        return {"volume": vid, "copied": True}
+
+    def _pull_file(self, source: str, name: str, dest: str,
+                   chunk: int = 64 << 20):
+        """Ranged streaming pull — never buffers whole volumes in RAM."""
+        stat = get_json(f"http://{source}/admin/file?name={name}&stat=true")
+        total = stat["size"]
+        with open(dest, "wb") as f:
+            off = 0
+            while off < total:
+                n = min(chunk, total - off)
+                data = http_call(
+                    "GET", f"http://{source}/admin/file?name={name}"
+                           f"&offset={off}&size={n}", timeout=600)
+                f.write(data)
+                off += len(data)
+                if not data:
+                    raise HttpError(502, f"short pull of {name} at {off}")
+
+    def admin_volume_verify(self, req: Request):
+        """Deep integrity check: walk the volume, CRC-verify every live
+        needle against the index (volume.fsck's server side)."""
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        from ..storage.needle import CorruptNeedle
+        checked = errors = 0
+        with v.lock:
+            snapshot = list(v.nm.items())
+        for nid, nv in snapshot:
+            checked += 1
+            try:
+                # lock per needle, not for the whole scan — a multi-GB walk
+                # must not stall reads/writes on the volume
+                with v.lock:
+                    blob = v._read_blob(nv.offset, nv.size)
+                Needle.from_bytes(blob, v.version, expected_size=nv.size)
+            except (CorruptNeedle, OSError, VolumeError):
+                errors += 1
+        return {"volume": vid, "checked": checked, "errors": errors}
 
     def admin_ec_to_volume(self, req: Request):
         """Decode mounted EC shards back into a normal volume (reference

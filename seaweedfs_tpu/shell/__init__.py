@@ -1,0 +1,11 @@
+"""shell — the admin REPL and maintenance commands.
+
+Reference weed/shell: 30+ self-registered commands driving the cluster
+through the master + volume-server APIs. Commands register themselves into
+COMMANDS via the @command decorator.
+"""
+
+from .command_env import CommandEnv, COMMANDS, command  # noqa: F401
+from . import command_volume  # noqa: F401  (registers volume.* commands)
+from . import command_ec  # noqa: F401  (registers ec.* commands)
+from . import command_collection  # noqa: F401

@@ -1,0 +1,275 @@
+"""ec.* shell commands — the north-star orchestration.
+
+Reference weed/shell/command_ec_encode.go / _rebuild.go / _decode.go /
+_balance.go: freeze -> generate -> spread -> mount -> drop originals;
+rebuild lost shards on the freest node; decode back to normal volumes;
+balance shards across nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..ec.constants import DATA_SHARDS, TOTAL_SHARDS
+from .command_env import CommandEnv, command, parse_flags
+
+
+def _free_nodes(env: CommandEnv) -> List[dict]:
+    return sorted(env.cluster_nodes(), key=lambda n: -n.get("free", 0))
+
+
+def _volume_replicas(env: CommandEnv, vid: int) -> List[dict]:
+    return env.all_volumes().get(str(vid), [])
+
+
+def balanced_ec_distribution(nodes: List[dict]) -> List[str]:
+    """Assign 14 shards round-robin by free slots (reference
+    balancedEcDistribution command_ec_encode.go:237-253)."""
+    if not nodes:
+        raise ValueError("no volume servers")
+    # plain round-robin over servers that still have free EC slots (one
+    # volume slot = 10 shard slots)
+    picked: Dict[str, int] = {n["url"]: 0 for n in nodes}
+    free_slots = {n["url"]: max(n.get("free", 0), 0) * 10 for n in nodes}
+    urls = [n["url"] for n in nodes]
+    out: List[str] = []
+    i = 0
+    spins = 0
+    while len(out) < TOTAL_SHARDS:
+        url = urls[i % len(urls)]
+        i += 1
+        if free_slots[url] - picked[url] >= 1:
+            out.append(url)
+            picked[url] += 1
+            spins = 0
+        else:
+            spins += 1
+            if spins > len(urls):
+                raise ValueError("not enough free EC slots in the cluster")
+    return out
+
+
+def collect_volume_ids_for_ec_encode(env: CommandEnv, collection: str,
+                                     full_percent: float = 0.95,
+                                     quiet_seconds: float = 3600,
+                                     size_limit: int = None) -> List[int]:
+    """Quiet & nearly-full volumes (reference
+    collectVolumeIdsForEcEncode command_ec_encode.go:255-287)."""
+    import time
+    if size_limit is None:
+        status = env.master_get("/dir/status")
+        size_limit = 30 * 1024 * 1024 * 1024
+    out = []
+    for vid_s, replicas in env.all_volumes().items():
+        vi = replicas[0]
+        if vi.get("collection", "") != collection:
+            continue
+        if vi.get("size", 0) >= full_percent * size_limit:
+            out.append(int(vid_s))
+    return out
+
+
+@command("ec.encode",
+         "-volumeId <id> | -collection <name> [-fullPercent 0.95] : "
+         "erasure-code volumes and spread 14 shards across the cluster")
+def ec_encode(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    elif "collection" in flags:
+        vids = collect_volume_ids_for_ec_encode(
+            env, flags["collection"], float(flags.get("fullPercent", 0.95)))
+    else:
+        env.write("usage: ec.encode -volumeId <id> | -collection <name>")
+        return
+    for vid in vids:
+        do_ec_encode(env, vid)
+
+
+def do_ec_encode(env: CommandEnv, vid: int):
+    replicas = _volume_replicas(env, vid)
+    if not replicas:
+        env.write(f"volume {vid} not found")
+        return
+    collection = replicas[0].get("collection", "")
+    source = replicas[0]["url"]
+
+    # 1. freeze every replica
+    for r in replicas:
+        env.node_post(r["url"], f"/admin/volume/readonly?volume={vid}")
+    # 2. generate shards on the source
+    env.node_post(source, f"/admin/ec/generate?volume={vid}"
+                          f"&collection={collection}")
+    env.write(f"volume {vid}: generated 14 shards on {source}")
+    # 3. spread
+    assignment = balanced_ec_distribution(_free_nodes(env))
+    by_node: Dict[str, List[int]] = {}
+    for sid, url in enumerate(assignment):
+        by_node.setdefault(url, []).append(sid)
+    for url, shards in by_node.items():
+        s = ",".join(map(str, shards))
+        if url != source:
+            env.node_post(url, f"/admin/ec/copy?volume={vid}"
+                               f"&collection={collection}&source={source}"
+                               f"&shards={s}")
+        env.node_post(url, f"/admin/ec/mount?volume={vid}"
+                           f"&collection={collection}&shards={s}")
+        env.write(f"volume {vid}: shards {s} -> {url}")
+    # 4. delete source's unassigned shard files
+    source_keeps = set(by_node.get(source, []))
+    extra = [s for s in range(TOTAL_SHARDS) if s not in source_keeps]
+    if extra:
+        env.node_post(source, f"/admin/ec/delete_shards?volume={vid}"
+                              f"&collection={collection}"
+                              f"&shards={','.join(map(str, extra))}")
+    # 5. drop the original volume everywhere
+    for r in replicas:
+        env.node_post(r["url"], f"/admin/delete_volume?volume={vid}")
+    env.write(f"volume {vid}: ec encoded, original removed")
+
+
+@command("ec.rebuild", "[-collection <name>] : regenerate missing shards")
+def ec_rebuild(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    for vid_s, info in env.ec_volumes().items():
+        vid = int(vid_s)
+        collection = info.get("collection", "")
+        if "collection" in flags and collection != flags["collection"]:
+            continue
+        shards = {int(s): urls for s, urls in info["shards"].items()}
+        missing = [s for s in range(TOTAL_SHARDS) if s not in shards]
+        if not missing:
+            continue
+        if len(shards) < DATA_SHARDS:
+            env.write(f"volume {vid}: only {len(shards)} shards left, "
+                      f"cannot rebuild")
+            continue
+        do_ec_rebuild(env, vid, collection, shards, missing)
+
+
+def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
+                  shards: Dict[int, List[str]], missing: List[int]):
+    # pick the node with most free slots as rebuilder (reference
+    # command_ec_rebuild.go: pick by free slot count)
+    rebuilder = _free_nodes(env)[0]["url"]
+    local = {s for s, urls in shards.items() if rebuilder in urls}
+    # copy surviving shards the rebuilder lacks
+    copied = []
+    need_ecx = not local
+    for sid, urls in shards.items():
+        if sid in local:
+            continue
+        src = urls[0]
+        env.node_post(rebuilder,
+                      f"/admin/ec/copy?volume={vid}&collection={collection}"
+                      f"&source={src}&shards={sid}"
+                      f"&copy_ecx={'true' if need_ecx else 'false'}")
+        need_ecx = False
+        copied.append(sid)
+    # rebuild + mount only the previously-missing shards
+    out = env.node_post(rebuilder,
+                        f"/admin/ec/rebuild?volume={vid}"
+                        f"&collection={collection}")
+    rebuilt = out.get("rebuilt", [])
+    if rebuilt:
+        env.node_post(rebuilder,
+                      f"/admin/ec/mount?volume={vid}"
+                      f"&collection={collection}"
+                      f"&shards={','.join(map(str, rebuilt))}")
+    # clean up temp survivor copies (not mounted here)
+    if copied:
+        env.node_post(rebuilder,
+                      f"/admin/ec/delete_shards?volume={vid}"
+                      f"&collection={collection}"
+                      f"&shards={','.join(map(str, copied))}")
+    env.write(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}")
+
+
+@command("ec.decode",
+         "-volumeId <id> | -collection <name> : decode EC back to volumes")
+def ec_decode(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    for vid_s, info in env.ec_volumes().items():
+        vid = int(vid_s)
+        collection = info.get("collection", "")
+        if "volumeId" in flags and vid != int(flags["volumeId"]):
+            continue
+        if "collection" in flags and collection != flags["collection"]:
+            continue
+        shards = {int(s): urls for s, urls in info["shards"].items()}
+        data_shards = {s: u for s, u in shards.items() if s < DATA_SHARDS}
+        if len(data_shards) < DATA_SHARDS:
+            env.write(f"volume {vid}: missing data shards; run ec.rebuild "
+                      f"first")
+            continue
+        # pick the node holding the most data shards as the decode target
+        counts: Dict[str, int] = {}
+        for sid, urls in data_shards.items():
+            for u in urls:
+                counts[u] = counts.get(u, 0) + 1
+        target = max(counts, key=counts.get)
+        held = {s for s, urls in shards.items() if target in urls}
+        for sid, urls in data_shards.items():
+            if sid in held:
+                continue
+            env.node_post(target,
+                          f"/admin/ec/copy?volume={vid}"
+                          f"&collection={collection}&source={urls[0]}"
+                          f"&shards={sid}&copy_ecx=false")
+        env.node_post(target, f"/admin/ec/mount?volume={vid}"
+                              f"&collection={collection}"
+                              f"&shards="
+                              f"{','.join(str(s) for s in range(DATA_SHARDS))}")
+        env.node_post(target, f"/admin/ec/to_volume?volume={vid}"
+                              f"&collection={collection}")
+        # remove EC shards cluster-wide
+        all_shards = ",".join(map(str, range(TOTAL_SHARDS)))
+        holders = {u for urls in shards.values() for u in urls} | {target}
+        for u in holders:
+            env.node_post(u, f"/admin/ec/delete_shards?volume={vid}"
+                             f"&collection={collection}&shards={all_shards}")
+        env.write(f"volume {vid}: decoded back to a normal volume on "
+                  f"{target}")
+
+
+@command("ec.balance", "[-collection <name>] : even EC shards across nodes")
+def ec_balance(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    nodes = [n["url"] for n in env.cluster_nodes()]
+    if not nodes:
+        env.write("no volume servers")
+        return
+    moves = 0
+    for vid_s, info in env.ec_volumes().items():
+        vid = int(vid_s)
+        collection = info.get("collection", "")
+        if "collection" in flags and collection != flags["collection"]:
+            continue
+        shards = {int(s): urls for s, urls in info["shards"].items()}
+        counts = {u: 0 for u in nodes}
+        for sid, urls in shards.items():
+            for u in urls:
+                if u in counts:
+                    counts[u] += 1
+        # move shards from the most-loaded node to the least-loaded until
+        # the spread is <= 1 (rack-aware refinement comes with multi-rack
+        # topologies; reference command_ec_balance.go)
+        while True:
+            hi = max(counts, key=counts.get)
+            lo = min(counts, key=counts.get)
+            if counts[hi] - counts[lo] <= 1:
+                break
+            sid = next(s for s, urls in sorted(shards.items())
+                       if hi in urls and lo not in urls)
+            env.node_post(lo, f"/admin/ec/copy?volume={vid}"
+                              f"&collection={collection}&source={hi}"
+                              f"&shards={sid}")
+            env.node_post(lo, f"/admin/ec/mount?volume={vid}"
+                              f"&collection={collection}&shards={sid}")
+            env.node_post(hi, f"/admin/ec/delete_shards?volume={vid}"
+                              f"&collection={collection}&shards={sid}")
+            shards[sid] = [lo if u == hi else u for u in shards[sid]]
+            counts[hi] -= 1
+            counts[lo] += 1
+            moves += 1
+    env.write(f"ec.balance: {moves} shard moves")

@@ -1,0 +1,101 @@
+"""CommandEnv — shared state for shell commands (reference
+weed/shell/commands.go CommandEnv + MasterClient)."""
+
+from __future__ import annotations
+
+import shlex
+from typing import Callable, Dict, List
+
+from ..server.http_util import HttpError, get_json, http_call, post_json
+
+COMMANDS: Dict[str, Callable] = {}
+HELP: Dict[str, str] = {}
+
+
+def command(name: str, help_text: str = ""):
+    def deco(fn):
+        COMMANDS[name] = fn
+        HELP[name] = help_text or (fn.__doc__ or "").strip()
+        return fn
+    return deco
+
+
+class CommandEnv:
+    def __init__(self, master_url: str, out=None):
+        self.master_url = master_url
+        import sys
+        self.out = out or sys.stdout
+
+    def write(self, *args):
+        print(*args, file=self.out)
+
+    # -- cluster state helpers --------------------------------------------
+    def master_get(self, path: str) -> dict:
+        return get_json(f"http://{self.master_url}{path}")
+
+    def master_post(self, path: str) -> dict:
+        return post_json(f"http://{self.master_url}{path}")
+
+    def node_post(self, node: str, path: str, timeout: float = 600) -> dict:
+        return post_json(f"http://{node}{path}", timeout=timeout)
+
+    def node_get(self, node: str, path: str) -> dict:
+        return get_json(f"http://{node}{path}")
+
+    def cluster_nodes(self) -> List[dict]:
+        return self.master_get("/cluster/status").get("nodes", [])
+
+    def all_volumes(self) -> Dict[str, List[dict]]:
+        return self.master_get("/cluster/volumes").get("volumes", {})
+
+    def ec_volumes(self) -> Dict[str, dict]:
+        return self.master_get("/cluster/ec_status").get("volumes", {})
+
+
+def run_command(env: CommandEnv, line: str) -> bool:
+    """Execute one shell line. Returns False on 'exit'."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return True
+    if line in ("exit", "quit"):
+        return False
+    parts = shlex.split(line)
+    name, args = parts[0], parts[1:]
+    if name == "help":
+        if args and args[0] in HELP:
+            env.write(f"{args[0]}: {HELP[args[0]]}")
+        else:
+            for cmd in sorted(COMMANDS):
+                env.write(f"  {cmd:28s} {HELP.get(cmd, '').splitlines()[0] if HELP.get(cmd) else ''}")
+        return True
+    fn = COMMANDS.get(name)
+    if fn is None:
+        env.write(f"unknown command {name!r}; try 'help'")
+        return True
+    try:
+        fn(env, args)
+    except HttpError as e:
+        env.write(f"error: {e.status} {e.message or e}")
+    except (ValueError, KeyError) as e:
+        env.write(f"error: {type(e).__name__}: {e}")
+    return True
+
+
+def parse_flags(args: List[str]) -> Dict[str, str]:
+    """'-volumeId 3 -collection x -force' -> {volumeId: 3, ...}."""
+    out: Dict[str, str] = {}
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-"):
+            key = a.lstrip("-")
+            if "=" in key:
+                k, v = key.split("=", 1)
+                out[k] = v
+            elif i + 1 < len(args) and not args[i + 1].startswith("-"):
+                out[key] = args[i + 1]
+                i += 1
+            else:
+                out[key] = "true"
+        i += 1
+    return out

@@ -123,6 +123,7 @@ class Topology:
             for holders in per_shard:
                 if node in holders:
                     holders.remove(node)
+        self._drop_empty_ec_volumes()
         from ..ec.constants import TOTAL_SHARDS
         for vid, bits in node.ec_shards.items():
             per_shard = self.ec_shard_map.setdefault(
@@ -134,6 +135,12 @@ class Topology:
                 if node not in per_shard[sid]:
                     per_shard[sid].append(node)
 
+    def _drop_empty_ec_volumes(self):
+        for vid in [v for v, per_shard in self.ec_shard_map.items()
+                    if not any(per_shard)]:
+            del self.ec_shard_map[vid]
+            self.ec_collections.pop(vid, None)
+
     def unregister_node(self, node: DataNode):
         """Heartbeat stream broke: drop the node and its volumes."""
         with self.lock:
@@ -144,6 +151,7 @@ class Topology:
                 for holders in per_shard:
                     if node in holders:
                         holders.remove(node)
+            self._drop_empty_ec_volumes()
             if node.rack:
                 node.rack.nodes.pop(node.url, None)
 

@@ -1,0 +1,160 @@
+"""Shell command integration: ec.encode / ec.rebuild / ec.balance /
+volume.* driven against a live in-process cluster."""
+
+import io
+import os
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.ec.constants import TOTAL_SHARDS
+from seaweedfs_tpu.server.http_util import http_call
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    servers = [
+        VolumeServer(port=0, directories=[str(tmp_path / f"v{i}")],
+                     master_url=master.url, pulse_seconds=1,
+                     max_volume_counts=[30], ec_backend="numpy").start()
+        for i in range(3)]
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _env(master):
+    out = io.StringIO()
+    return CommandEnv(master.url, out=out), out
+
+
+def _fill_volume(master_url):
+    """Upload until one volume holds several needles; return (vid, payloads)."""
+    rng = np.random.default_rng(0)
+    payloads = {}
+    for i in range(12):
+        data = rng.integers(0, 256, 150_000).astype(np.uint8).tobytes()
+        fid = op.upload_data(master_url, data, filename=f"f{i}",
+                             collection="shelltest")
+        payloads[fid] = data
+    by_vid = {}
+    for fid in payloads:
+        by_vid.setdefault(fid.split(",")[0], []).append(fid)
+    vid = max(by_vid, key=lambda v: len(by_vid[v]))
+    return int(vid), {f: payloads[f] for f in by_vid[vid]}
+
+
+def test_ec_encode_rebuild_balance_roundtrip(cluster3):
+    master, servers = cluster3
+    vid, payloads = _fill_volume(master.url)
+    env, out = _env(master)
+
+    assert run_command(env, f"ec.encode -volumeId {vid}")
+    assert "ec encoded" in out.getvalue(), out.getvalue()
+
+    # reads still work through EC from any server
+    for fid, data in payloads.items():
+        got = http_call("GET", f"http://{servers[0].url}/{fid}")
+        assert got == data
+
+    # shards spread over the cluster
+    shards = env.ec_volumes()[str(vid)]["shards"]
+    assert len(shards) == TOTAL_SHARDS
+    holders = {u for urls in shards.values() for u in urls}
+    assert len(holders) == 3
+
+    # destroy up to 4 of one holder's shards (>=10 must survive for rebuild)
+    victim = servers[0]
+    held = victim.store.find_ec_volume(vid).shard_ids()
+    to_lose = held[:4]
+    assert to_lose, "victim held no shards?"
+    victim.store.unmount_ec_shards(vid, to_lose)
+    for loc in victim.store.locations:
+        from seaweedfs_tpu.ec.constants import to_ext
+        for sid in to_lose:
+            for f in os.listdir(loc.directory):
+                if f.endswith(to_ext(sid)):
+                    os.remove(os.path.join(loc.directory, f))
+    victim.heartbeat_once()
+
+    env2, out2 = _env(master)
+    assert run_command(env2, "ec.rebuild")
+    assert "rebuilt shards" in out2.getvalue(), out2.getvalue()
+    shards_after = env2.ec_volumes()[str(vid)]["shards"]
+    assert len(shards_after) == TOTAL_SHARDS
+
+    env3, out3 = _env(master)
+    assert run_command(env3, "ec.balance")
+    # all needles still readable after rebuild + balance
+    for fid, data in payloads.items():
+        got = http_call("GET", f"http://{servers[1].url}/{fid}")
+        assert got == data
+
+    # decode back to a normal volume
+    env4, out4 = _env(master)
+    assert run_command(env4, f"ec.decode -volumeId {vid}")
+    assert "decoded back" in out4.getvalue(), out4.getvalue()
+    time.sleep(0.2)
+    for fid, data in payloads.items():
+        got = op.read_file(master.url, fid)
+        assert got == data
+    assert not env4.ec_volumes().get(str(vid))
+
+
+def test_volume_list_and_fsck(cluster3):
+    master, servers = cluster3
+    vid, payloads = _fill_volume(master.url)
+    env, out = _env(master)
+    run_command(env, "volume.list")
+    assert f"volume {vid}" in out.getvalue()
+    env2, out2 = _env(master)
+    run_command(env2, "volume.fsck -deep")
+    assert "0 with errors" in out2.getvalue(), out2.getvalue()
+
+
+def test_volume_move_and_fix_replication(cluster3):
+    master, servers = cluster3
+    vid, payloads = _fill_volume(master.url)
+    env, out = _env(master)
+    replicas = env.all_volumes()[str(vid)]
+    source = replicas[0]["url"]
+    target = next(n["url"] for n in env.cluster_nodes()
+                  if n["url"] != source)
+    run_command(env, f"volume.move -volumeId {vid} -target {target}")
+    time.sleep(0.2)
+    for fid, data in payloads.items():
+        assert op.read_file(master.url, fid) == data
+    replicas2 = env.all_volumes()[str(vid)]
+    assert [r["url"] for r in replicas2] == [target]
+
+
+def test_collection_commands(cluster3):
+    master, servers = cluster3
+    _fill_volume(master.url)
+    env, out = _env(master)
+    run_command(env, "collection.list")
+    assert "shelltest" in out.getvalue()
+    env2, out2 = _env(master)
+    run_command(env2, "collection.delete -collection shelltest")
+    assert "deleted volumes" in out2.getvalue()
+    env3, _ = _env(master)
+    assert not any(r[0].get("collection") == "shelltest"
+                   for r in env3.all_volumes().values())
+
+
+def test_unknown_command_and_help(cluster3):
+    master, _ = cluster3
+    env, out = _env(master)
+    run_command(env, "no.such.command")
+    assert "unknown command" in out.getvalue()
+    env2, out2 = _env(master)
+    run_command(env2, "help")
+    assert "ec.encode" in out2.getvalue()
+    assert run_command(env2, "exit") is False
