@@ -64,10 +64,14 @@ def run_benchmark(master_url: str, num_files: int = 1024,
 
     if write:
         stats = Stats()
-        per_worker = num_files // concurrency
+
+        def worker_count(wid: int) -> int:
+            # distribute the remainder so exactly num_files are written
+            return num_files // concurrency + \
+                (1 if wid < num_files % concurrency else 0)
 
         def writer(wid: int):
-            for i in range(per_worker):
+            for i in range(worker_count(wid)):
                 t = time.perf_counter()
                 try:
                     a = op.assign(master_url, collection=collection)

@@ -62,6 +62,18 @@ def cmd_server(args):
     _wait()
 
 
+def cmd_filer(args):
+    from ..server.filer_server import FilerServer
+    store_options = {"path": args.db} if args.store == "sqlite" else {}
+    f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
+                    store=args.store, store_options=store_options,
+                    collection=args.collection,
+                    replication=args.defaultReplicaPlacement,
+                    chunk_size=args.maxMB << 20).start()
+    print(f"filer listening on {f.url}, master {args.master}")
+    _wait()
+
+
 def cmd_shell(args):
     from ..shell.command_env import CommandEnv, run_command
     env = CommandEnv(args.master)
@@ -159,6 +171,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
     s.set_defaults(fn=cmd_server)
+
+    f = sub.add_parser("filer", help="start a filer server")
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-ip", default="127.0.0.1")
+    f.add_argument("-master", default="127.0.0.1:9333")
+    f.add_argument("-store", default="sqlite",
+                   choices=["memory", "sqlite"])
+    f.add_argument("-db", default="./filer.db",
+                   help="sqlite metadata path")
+    f.add_argument("-collection", default="")
+    f.add_argument("-defaultReplicaPlacement", default="")
+    f.add_argument("-maxMB", type=int, default=32,
+                   help="autochunk split size")
+    f.set_defaults(fn=cmd_filer)
 
     sh = sub.add_parser("shell", help="admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")

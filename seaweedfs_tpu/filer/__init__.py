@@ -1,0 +1,23 @@
+"""Filer metadata layer: path -> entry (attrs + ordered chunk list).
+
+Reference weed/filer2/: Filer core (filer.go), pluggable FilerStore
+(filerstore.go:12-30), chunked-file model (filechunks.go), streaming
+reads (stream.go), buckets (filer_buckets.go) and background chunk
+deletion (filer_deletion.go).
+"""
+
+from .entry import Attr, Entry, FileChunk  # noqa: F401
+from .filechunks import (  # noqa: F401
+    ChunkView,
+    VisibleInterval,
+    compact_file_chunks,
+    etag,
+    minus_chunks,
+    non_overlapping_visible_intervals,
+    total_size,
+    view_from_chunks,
+)
+from .filer import Filer  # noqa: F401
+from .filerstore import FilerStore  # noqa: F401
+from .memory_store import MemoryStore  # noqa: F401
+from .sqlite_store import SqliteStore  # noqa: F401

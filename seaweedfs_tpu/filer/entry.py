@@ -1,0 +1,142 @@
+"""Filer entry model.
+
+Reference weed/filer2/entry.py analog: Entry = full path + Attr +
+ordered []FileChunk (entry.go:14-42), serialized for storage
+(entry_codec.go — we use JSON instead of protobuf).
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class FileChunk:
+    """One stored chunk of a file (reference filer.proto FileChunk)."""
+
+    fid: str            # "<vid>,<key><cookie>" on a volume server
+    offset: int         # logical offset within the file
+    size: int
+    mtime: int = 0      # ns timestamp; newer chunks overlay older ones
+    etag: str = ""
+    cipher_key: bytes = b""
+    is_compressed: bool = False
+
+    def to_dict(self) -> dict:
+        d = {"fid": self.fid, "offset": self.offset, "size": self.size,
+             "mtime": self.mtime}
+        if self.etag:
+            d["etag"] = self.etag
+        if self.cipher_key:
+            d["cipherKey"] = self.cipher_key.hex()
+        if self.is_compressed:
+            d["isCompressed"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileChunk":
+        return cls(fid=d["fid"], offset=d["offset"], size=d["size"],
+                   mtime=d.get("mtime", 0), etag=d.get("etag", ""),
+                   cipher_key=bytes.fromhex(d.get("cipherKey", "")),
+                   is_compressed=d.get("isCompressed", False))
+
+
+@dataclass
+class Attr:
+    """Entry attributes (reference entry.go:14-28)."""
+
+    mtime: float = 0.0
+    crtime: float = 0.0
+    mode: int = 0o660
+    uid: int = 0
+    gid: int = 0
+    mime: str = ""
+    replication: str = ""
+    collection: str = ""
+    ttl_sec: int = 0
+    user_name: str = ""
+    md5: str = ""
+    symlink_target: str = ""
+
+    @property
+    def is_directory(self) -> bool:
+        return (self.mode & 0o170000) == 0o040000
+
+    def set_directory(self):
+        self.mode = (self.mode & 0o777) | 0o040000
+
+
+@dataclass
+class Entry:
+    full_path: str
+    attr: Attr = field(default_factory=Attr)
+    chunks: List[FileChunk] = field(default_factory=list)
+    extended: Dict[str, bytes] = field(default_factory=dict)
+
+    @property
+    def is_directory(self) -> bool:
+        return self.attr.is_directory
+
+    @property
+    def name(self) -> str:
+        return posixpath.basename(self.full_path)
+
+    @property
+    def dir_name(self) -> str:
+        return posixpath.dirname(self.full_path) or "/"
+
+    def size(self) -> int:
+        from .filechunks import total_size
+        return total_size(self.chunks)
+
+    def timestamp(self) -> float:
+        return self.attr.crtime if self.is_directory else self.attr.mtime
+
+    # -- codec (reference entry_codec.go; JSON instead of protobuf) --------
+
+    def encode(self) -> bytes:
+        a = self.attr
+        d = {
+            "path": self.full_path,
+            "attr": {
+                "mtime": a.mtime, "crtime": a.crtime, "mode": a.mode,
+                "uid": a.uid, "gid": a.gid, "mime": a.mime,
+                "replication": a.replication, "collection": a.collection,
+                "ttlSec": a.ttl_sec, "userName": a.user_name, "md5": a.md5,
+                "symlinkTarget": a.symlink_target,
+            },
+            "chunks": [c.to_dict() for c in self.chunks],
+        }
+        if self.extended:
+            d["extended"] = {k: v.hex() for k, v in self.extended.items()}
+        return json.dumps(d).encode()
+
+    @classmethod
+    def decode(cls, full_path: str, data: bytes) -> "Entry":
+        d = json.loads(data)
+        a = d.get("attr", {})
+        attr = Attr(mtime=a.get("mtime", 0.0), crtime=a.get("crtime", 0.0),
+                    mode=a.get("mode", 0o660), uid=a.get("uid", 0),
+                    gid=a.get("gid", 0), mime=a.get("mime", ""),
+                    replication=a.get("replication", ""),
+                    collection=a.get("collection", ""),
+                    ttl_sec=a.get("ttlSec", 0),
+                    user_name=a.get("userName", ""),
+                    md5=a.get("md5", ""),
+                    symlink_target=a.get("symlinkTarget", ""))
+        chunks = [FileChunk.from_dict(c) for c in d.get("chunks", [])]
+        extended = {k: bytes.fromhex(v)
+                    for k, v in d.get("extended", {}).items()}
+        return cls(full_path=full_path, attr=attr, chunks=chunks,
+                   extended=extended)
+
+
+def new_dir_entry(path: str, now: Optional[float] = None) -> Entry:
+    now = time.time() if now is None else now
+    attr = Attr(mtime=now, crtime=now, mode=0o777)
+    attr.set_directory()
+    return Entry(full_path=path, attr=attr)

@@ -1,0 +1,135 @@
+"""Overlapping-write resolution for chunked files.
+
+Reference weed/filer2/filechunks.go: chunks written at overlapping offsets
+are resolved by mtime (newer wins) into non-overlapping VisibleIntervals
+(NonOverlappingVisibleIntervals filechunks.go:190), from which a read
+range is planned as ChunkViews (ViewFromChunks filechunks.go:93).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, replace
+from typing import List, Set, Tuple
+
+from .entry import FileChunk
+
+
+def total_size(chunks: List[FileChunk]) -> int:
+    size = 0
+    for c in chunks:
+        size = max(size, c.offset + c.size)
+    return size
+
+
+def etag(chunks: List[FileChunk]) -> str:
+    """ETag(chunks): single chunk -> its etag; else md5 over chunk etags
+    (reference filechunks.go:32-44)."""
+    if len(chunks) == 1:
+        return chunks[0].etag
+    h = hashlib.md5()
+    for c in chunks:
+        h.update(c.etag.encode())
+    return h.hexdigest()
+
+
+@dataclass(frozen=True)
+class VisibleInterval:
+    """A [start, stop) byte range of the logical file served by one chunk.
+    chunk_offset is where `start` falls inside that chunk's data."""
+
+    start: int
+    stop: int
+    fid: str
+    mtime: int
+    chunk_offset: int = 0
+    is_full_chunk: bool = True
+    cipher_key: bytes = b""
+    is_compressed: bool = False
+
+
+def non_overlapping_visible_intervals(
+        chunks: List[FileChunk]) -> List[VisibleInterval]:
+    """Overlay chunks in mtime order; later writes clip earlier ones
+    (reference MergeIntoVisibles / NonOverlappingVisibleIntervals
+    filechunks.go:147-208)."""
+    visibles: List[VisibleInterval] = []
+    for c in sorted(chunks, key=lambda c: (c.mtime, c.fid)):
+        new = VisibleInterval(start=c.offset, stop=c.offset + c.size,
+                              fid=c.fid, mtime=c.mtime, chunk_offset=0,
+                              is_full_chunk=True,
+                              cipher_key=c.cipher_key,
+                              is_compressed=c.is_compressed)
+        out: List[VisibleInterval] = []
+        for v in visibles:
+            if v.stop <= new.start or v.start >= new.stop:
+                out.append(v)
+                continue
+            if v.start < new.start:  # head survives
+                out.append(replace(v, stop=new.start, is_full_chunk=False))
+            if v.stop > new.stop:    # tail survives, shifted into the chunk
+                out.append(replace(
+                    v, start=new.stop,
+                    chunk_offset=v.chunk_offset + (new.stop - v.start),
+                    is_full_chunk=False))
+        out.append(new)
+        visibles = sorted(out, key=lambda v: v.start)
+    return visibles
+
+
+@dataclass(frozen=True)
+class ChunkView:
+    """One fetch needed to serve part of a read range
+    (reference filechunks.go:84-91)."""
+
+    fid: str
+    offset: int          # offset inside the chunk's stored data
+    size: int
+    logical_offset: int  # offset in the file
+    is_full_chunk: bool = False
+    cipher_key: bytes = b""
+    is_compressed: bool = False
+
+
+def view_from_visible_intervals(visibles: List[VisibleInterval],
+                                offset: int, size: int) -> List[ChunkView]:
+    if size < 0:  # whole file
+        size = max((v.stop for v in visibles), default=0) - offset
+    stop = offset + size
+    views: List[ChunkView] = []
+    for v in visibles:
+        if v.start >= stop or v.stop <= offset:
+            continue
+        lo = max(offset, v.start)
+        hi = min(stop, v.stop)
+        full = v.is_full_chunk and lo == v.start and hi == v.stop
+        views.append(ChunkView(
+            fid=v.fid, offset=v.chunk_offset + (lo - v.start),
+            size=hi - lo, logical_offset=lo, is_full_chunk=full,
+            cipher_key=v.cipher_key, is_compressed=v.is_compressed))
+    return views
+
+
+def view_from_chunks(chunks: List[FileChunk], offset: int,
+                     size: int) -> List[ChunkView]:
+    return view_from_visible_intervals(
+        non_overlapping_visible_intervals(chunks), offset, size)
+
+
+def compact_file_chunks(
+        chunks: List[FileChunk]) -> Tuple[List[FileChunk], List[FileChunk]]:
+    """Split chunks into (still visible, fully shadowed garbage)
+    (reference CompactFileChunks filechunks.go:46-62)."""
+    visible_fids: Set[str] = {
+        v.fid for v in non_overlapping_visible_intervals(chunks)}
+    compacted = [c for c in chunks if c.fid in visible_fids]
+    garbage = [c for c in chunks if c.fid not in visible_fids]
+    return compacted, garbage
+
+
+def minus_chunks(before: List[FileChunk],
+                 after: List[FileChunk]) -> List[FileChunk]:
+    """Chunks present in `before` but not in `after`
+    (reference MinusChunks filechunks.go:64-77)."""
+    keep = {(c.fid, c.offset, c.size) for c in after}
+    return [c for c in before if (c.fid, c.offset, c.size) not in keep]

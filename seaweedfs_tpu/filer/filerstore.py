@@ -1,0 +1,72 @@
+"""FilerStore plugin interface.
+
+Reference weed/filer2/filerstore.go:12-30 — Insert/Update/Find/Delete/
+DeleteFolderChildren/ListDirectoryEntries (+ transactions, no-ops here
+for the embedded stores). Stores register into STORES by name so the
+filer config can pick one (reference filer.toml sections).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from .entry import Entry
+
+
+class FilerStore:
+    name = "abstract"
+
+    def initialize(self, **options):
+        pass
+
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        raise NotImplementedError
+
+    def delete_entry(self, full_path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, full_path: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               inclusive: bool,
+                               limit: int) -> List[Entry]:
+        raise NotImplementedError
+
+    # transactions — embedded stores are synchronous; kept for interface
+    # parity with reference BeginTransaction/CommitTransaction/Rollback
+    def begin_transaction(self):
+        pass
+
+    def commit_transaction(self):
+        pass
+
+    def rollback_transaction(self):
+        pass
+
+    def close(self):
+        pass
+
+
+STORES: Dict[str, Type[FilerStore]] = {}
+
+
+def register_store(cls: Type[FilerStore]) -> Type[FilerStore]:
+    STORES[cls.name] = cls
+    return cls
+
+
+def make_store(name: str, **options) -> FilerStore:
+    cls = STORES.get(name)
+    if cls is None:
+        raise ValueError(f"unknown filer store {name!r}; "
+                         f"have {sorted(STORES)}")
+    store = cls()
+    store.initialize(**options)
+    return store

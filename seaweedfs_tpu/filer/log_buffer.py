@@ -1,0 +1,95 @@
+"""Metadata event log buffer.
+
+Reference weed/queue/log_buffer.go:20-200 + weed/filer2/filer_notify.go:
+every entry mutation becomes an event appended to an in-memory buffer
+that is flushed on an interval; subscribers replay from a timestamp and
+then follow live events (ListenForEvents / `weed watch`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+class LogBuffer:
+    """Time-ordered event buffer with bounded memory and flush callback."""
+
+    def __init__(self, flush_interval: float = 60.0,
+                 flush_fn: Optional[Callable[[List[dict]], None]] = None,
+                 max_events: int = 100_000):
+        self._events: List[Tuple[float, dict]] = []
+        self._lock = threading.Condition()
+        self._flush_fn = flush_fn
+        self._flush_interval = flush_interval
+        self._max_events = max_events
+        self._closed = False
+
+    def append(self, event: dict, ts: Optional[float] = None):
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            self._events.append((ts, event))
+            if len(self._events) > self._max_events:
+                self._flush_locked()
+            self._lock.notify_all()
+
+    def _flush_locked(self):
+        if self._flush_fn and self._events:
+            batch = [e for _, e in self._events]
+            self._flush_fn(batch)
+        # keep a tail for late subscribers even after flushing
+        self._events = self._events[-1000:]
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def read_since(self, ts: float, limit: int = 1024) -> List[Tuple[float, dict]]:
+        with self._lock:
+            return [(t, e) for t, e in self._events if t > ts][:limit]
+
+    def wait_since(self, ts: float, timeout: float = 10.0,
+                   limit: int = 1024) -> List[Tuple[float, dict]]:
+        """Blocking read: return events newer than ts, waiting up to
+        timeout for one to arrive (long-poll analog of the reference's
+        server-side stream loop)."""
+        deadline = time.time() + timeout
+        with self._lock:
+            while not self._closed:
+                got = [(t, e) for t, e in self._events if t > ts][:limit]
+                if got:
+                    return got
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return []
+                self._lock.wait(remaining)
+        return []
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+
+def event_notification(old, new, delete_chunks: bool) -> dict:
+    """Build the EventNotification payload
+    (reference filer_pb.EventNotification, filer_notify.go:16-60)."""
+
+    def enc(e):
+        if e is None:
+            return None
+        return {"path": e.full_path, "isDirectory": e.is_directory,
+                "chunks": [c.to_dict() for c in e.chunks]}
+
+    return {
+        "oldEntry": enc(old),
+        "newEntry": enc(new),
+        "deleteChunks": delete_chunks,
+        "tsNs": time.time_ns(),
+    }
+
+
+def encode_event_line(event: dict) -> bytes:
+    return json.dumps(event, separators=(",", ":")).encode() + b"\n"

@@ -1,0 +1,93 @@
+"""In-memory filer store (sorted dict per directory).
+
+The embedded-default analog of the reference's leveldb store
+(weed/filer2/leveldb/leveldb_store.go) for tests and single-process runs.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional
+
+from .entry import Entry
+from .filerstore import FilerStore, register_store
+
+
+@register_store
+class MemoryStore(FilerStore):
+    name = "memory"
+
+    def initialize(self, **options):
+        self._lock = threading.RLock()
+        self._entries: Dict[str, bytes] = {}
+        # dir -> sorted list of child names (listing index)
+        self._children: Dict[str, List[str]] = {}
+
+    def _index_add(self, entry: Entry):
+        names = self._children.setdefault(entry.dir_name, [])
+        i = bisect.bisect_left(names, entry.name)
+        if i >= len(names) or names[i] != entry.name:
+            names.insert(i, entry.name)
+
+    def _index_remove(self, full_path: str):
+        import posixpath
+        d, n = posixpath.dirname(full_path) or "/", \
+            posixpath.basename(full_path)
+        names = self._children.get(d)
+        if names:
+            i = bisect.bisect_left(names, n)
+            if i < len(names) and names[i] == n:
+                names.pop(i)
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._entries[entry.full_path] = entry.encode()
+            self._index_add(entry)
+
+    def update_entry(self, entry: Entry) -> None:
+        self.insert_entry(entry)
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        with self._lock:
+            data = self._entries.get(full_path)
+            if data is None:
+                return None
+            return Entry.decode(full_path, data)
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            self._entries.pop(full_path, None)
+            self._index_remove(full_path)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        with self._lock:
+            prefix = full_path.rstrip("/") + "/"
+            doomed = [p for p in self._entries if p.startswith(prefix)]
+            for p in doomed:
+                self._entries.pop(p, None)
+                self._index_remove(p)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               inclusive: bool,
+                               limit: int) -> List[Entry]:
+        with self._lock:
+            dir_path = dir_path.rstrip("/") or "/"
+            names = self._children.get(dir_path, [])
+            if start_file_name:
+                i = bisect.bisect_left(names, start_file_name)
+                if (i < len(names) and names[i] == start_file_name
+                        and not inclusive):
+                    i += 1
+            else:
+                i = 0
+            out: List[Entry] = []
+            base = dir_path.rstrip("/")
+            for name in names[i:]:
+                if len(out) >= limit:
+                    break
+                full = f"{base}/{name}"
+                data = self._entries.get(full)
+                if data is not None:
+                    out.append(Entry.decode(full, data))
+            return out

@@ -1,0 +1,51 @@
+"""Streaming reads of chunked files from volume servers.
+
+Reference weed/filer2/stream.go:15-145 (StreamContent) and reader_at.go
+(random access): plan ChunkViews for the range, fetch each chunk slice
+from a volume location, reassemble in order.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .entry import FileChunk
+from .filechunks import view_from_chunks
+
+
+def default_fetcher(master_url: str):
+    from ..client.operation import VidCache
+    from ..server.http_util import HttpError, http_call
+    from ..storage.types import parse_file_id
+    cache = VidCache(master_url)
+
+    def fetch(fid: str, offset: int, size: int) -> bytes:
+        vid, _, _ = parse_file_id(fid)
+        last: Optional[Exception] = None
+        for url in cache.lookup(vid):
+            try:
+                return http_call(
+                    "GET", f"http://{url}/{fid}",
+                    headers={"Range": f"bytes={offset}-{offset+size-1}"})
+            except HttpError as e:
+                last = e
+                cache.invalidate(vid)
+        raise last or HttpError(404, f"no locations for {fid}")
+
+    return fetch
+
+
+def read_chunked(chunks: List[FileChunk], offset: int, size: int,
+                 fetch: Callable[[str, int, int], bytes]) -> bytes:
+    """Assemble [offset, offset+size) of the logical file; gaps between
+    chunks read as zeros (sparse-file semantics, reference stream.go)."""
+    views = view_from_chunks(chunks, offset, size)
+    if size < 0:
+        from .filechunks import total_size
+        size = max(total_size(chunks) - offset, 0)
+    out = bytearray(size)
+    for v in views:
+        data = fetch(v.fid, v.offset, v.size)
+        start = v.logical_offset - offset
+        out[start:start + len(data)] = data
+    return bytes(out)

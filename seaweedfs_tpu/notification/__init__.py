@@ -1,0 +1,18 @@
+"""Pluggable metadata-event publishers.
+
+Reference weed/notification/: a MessageQueue interface with
+implementations selected by notification.toml (kafka, aws_sqs,
+google_pub_sub, gocdk_pub_sub, log). Here: `log` (stderr/file) and
+`memory` (in-process, for tests and the replicator) are real; the
+cloud publishers are registered stubs that raise on use so config
+errors surface the same way the reference's missing-broker errors do.
+"""
+
+from .queues import (  # noqa: F401
+    PUBLISHERS,
+    LogPublisher,
+    MemoryPublisher,
+    Publisher,
+    StubPublisher,
+    make_publisher,
+)

@@ -1,0 +1,90 @@
+"""Notification publisher implementations + registry."""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable, Dict, List, Type
+
+
+class Publisher:
+    name = "abstract"
+
+    def initialize(self, **options):
+        pass
+
+    def send(self, key: str, event: dict) -> None:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+PUBLISHERS: Dict[str, Type[Publisher]] = {}
+
+
+def register(cls: Type[Publisher]) -> Type[Publisher]:
+    PUBLISHERS[cls.name] = cls
+    return cls
+
+
+def make_publisher(name: str, **options) -> Publisher:
+    cls = PUBLISHERS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown notification backend {name!r}; "
+                         f"have {sorted(PUBLISHERS)}")
+    p = cls()
+    p.initialize(**options)
+    return p
+
+
+@register
+class LogPublisher(Publisher):
+    """Reference notification/log/log_queue.go — print each event."""
+
+    name = "log"
+
+    def initialize(self, stream=None, **options):
+        self._stream = stream or sys.stderr
+
+    def send(self, key: str, event: dict) -> None:
+        print(f"[notify] {key}: {event}", file=self._stream)
+
+
+@register
+class MemoryPublisher(Publisher):
+    """In-process pub-sub used by tests and the local replicator."""
+
+    name = "memory"
+
+    def initialize(self, **options):
+        self._subs: List[Callable[[str, dict], None]] = []
+        self._lock = threading.Lock()
+        self.events: List[tuple] = []
+
+    def subscribe(self, fn: Callable[[str, dict], None]):
+        with self._lock:
+            self._subs.append(fn)
+
+    def send(self, key: str, event: dict) -> None:
+        with self._lock:
+            self.events.append((key, event))
+            subs = list(self._subs)
+        for fn in subs:
+            fn(key, event)
+
+
+class StubPublisher(Publisher):
+    """Placeholder for cloud brokers not present in this environment
+    (kafka/aws_sqs/google_pub_sub/gocdk_pub_sub). Configuring one fails
+    at first send with an actionable error, mirroring how the reference
+    fails when the broker endpoint is unreachable."""
+
+    def send(self, key: str, event: dict) -> None:
+        raise RuntimeError(
+            f"notification backend {self.name!r} requires an external "
+            f"broker that is not available in this environment")
+
+
+for _name in ("kafka", "aws_sqs", "google_pub_sub", "gocdk_pub_sub"):
+    register(type(f"Stub_{_name}", (StubPublisher,), {"name": _name}))

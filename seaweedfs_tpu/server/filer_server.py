@@ -1,0 +1,272 @@
+"""FilerServer — HTTP file API over the filer metadata layer.
+
+Reference weed/server/filer_server*.go:
+- GET streams chunk views from volume servers (filer_server_handlers_read)
+- POST auto-chunks large uploads: per chunk assign fid from master ->
+  upload to a volume server -> CreateEntry
+  (filer_server_handlers_write_autochunk.go:23-186)
+- DELETE removes entries (recursive with ?recursive=true) and deletes
+  the chunks behind them (filer_server_handlers_write.go)
+- /filer/events long-poll = ListenForEvents / `weed watch`
+  (filer_grpc_server.go SubscribeMetadata analog)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import posixpath
+import threading
+import time
+from typing import Optional
+
+from ..client import operation
+from ..filer import Attr, Entry, FileChunk, Filer
+from ..filer.filer import FilerError, NotFoundError
+from ..filer.log_buffer import LogBuffer, event_notification
+from ..filer.filerstore import make_store
+from ..filer.stream import read_chunked
+from .http_util import (HttpError, HttpServer, Request, Response, Router,
+                        http_call)
+
+CHUNK_SIZE_DEFAULT = 32 << 20  # reference -maxMB=32 autochunk default
+
+
+class FilerServer:
+    def __init__(self, port: int = 8888, host: str = "127.0.0.1",
+                 master_url: str = "127.0.0.1:9333",
+                 store: str = "memory", store_options: Optional[dict] = None,
+                 collection: str = "", replication: str = "",
+                 chunk_size: int = CHUNK_SIZE_DEFAULT,
+                 notify_publisher=None):
+        router = Router()
+        router.add("GET", "/filer/events", self.events_handler)
+        router.add("GET", "/filer/status", self.status_handler)
+        router.set_fallback(self.data_handler)
+        self.server = HttpServer(port, router, host)
+        self.port = self.server.port
+        self.host = host
+        self.master_url = master_url
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        self.filer = Filer(make_store(store, **(store_options or {})))
+        self.log_buffer = LogBuffer()
+        self.notify_publisher = notify_publisher
+        self.filer.on_update(self._on_meta_update)
+        self.vid_cache = operation.VidCache(master_url)
+        self._fetch = None
+        self._stop = threading.Event()
+        self._deleter = threading.Thread(target=self._deletion_loop,
+                                         daemon=True)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        self.server.start()
+        self._deleter.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.log_buffer.close()
+        self.server.stop()
+        self.filer.store.close()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _on_meta_update(self, old, new, delete_chunks):
+        event = event_notification(old, new, delete_chunks)
+        self.log_buffer.append(event)
+        if self.notify_publisher is not None:
+            key = (new or old).full_path
+            self.notify_publisher.send(key, event)
+
+    def _deletion_loop(self):
+        """Drain the filer's chunk-deletion queue against the cluster
+        (reference filer_deletion.go loopProcessingDeletion)."""
+        while not self._stop.wait(1.0):
+            self.flush_deletions()
+
+    def flush_deletions(self):
+        for fid in self.filer.drain_deletion_queue():
+            try:
+                operation.delete_file(self.master_url, fid, self.vid_cache)
+            except HttpError:
+                pass
+
+    # -- handlers -----------------------------------------------------------
+
+    def status_handler(self, req: Request):
+        return {"version": "seaweedfs-tpu", "master": self.master_url}
+
+    def events_handler(self, req: Request):
+        since = float(req.query.get("since", 0) or 0)
+        timeout = min(float(req.query.get("timeout", 10) or 10), 55.0)
+        events = self.log_buffer.wait_since(since, timeout=timeout)
+        return {"events": [
+            {"ts": t, "event": e} for t, e in events]}
+
+    def data_handler(self, req: Request):
+        # normpath strips the trailing slash, which carries meaning for
+        # writes ("upload into this directory") — capture it first
+        is_dir_path = req.path.endswith("/") and req.path != "/"
+        path = posixpath.normpath(req.path)
+        if req.method in ("GET", "HEAD"):
+            return self.read_handler(req, path)
+        if req.method in ("POST", "PUT"):
+            if "mv.to" in req.query:
+                return self.move_handler(req, path)
+            return self.write_handler(req, path, is_dir_path)
+        if req.method == "DELETE":
+            return self.delete_handler(req, path)
+        raise HttpError(405, req.method)
+
+    def read_handler(self, req: Request, path: str):
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            raise HttpError(404, f"{path} not found") from None
+        if entry.is_directory:
+            return self.list_handler(req, path)
+        size = entry.size()
+        offset, length, status = 0, size, 200
+        headers = {"Accept-Ranges": "bytes"}
+        rng = req.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            spec = rng[6:].split(",")[0]
+            s, _, e = spec.partition("-")
+            try:
+                if s == "":
+                    offset = max(size - int(e), 0)
+                    length = size - offset
+                else:
+                    offset = int(s)
+                    end = min(int(e), size - 1) if e else size - 1
+                    length = end - offset + 1
+            except ValueError:
+                raise HttpError(416, f"bad range {rng}") from None
+            if length < 0 or (offset >= size and size > 0):
+                raise HttpError(416, f"unsatisfiable range {rng}")
+            headers["Content-Range"] = \
+                f"bytes {offset}-{offset+length-1}/{size}"
+            status = 206
+        if req.method == "HEAD":
+            body = b""
+            headers["Content-Length-Hint"] = str(size)
+        else:
+            body = read_chunked(entry.chunks, offset, length,
+                                self._chunk_fetcher())
+        mime = entry.attr.mime or "application/octet-stream"
+        if entry.attr.md5:
+            headers["Etag"] = f'"{entry.attr.md5}"'
+        headers["Last-Modified"] = time.strftime(
+            "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime))
+        return Response(body, status, mime, headers)
+
+    def _chunk_fetcher(self):
+        if self._fetch is None:
+            from ..filer.stream import default_fetcher
+            self._fetch = default_fetcher(self.master_url)
+        return self._fetch
+
+    def list_handler(self, req: Request, path: str):
+        limit = int(req.query.get("limit", 1000))
+        last = req.query.get("lastFileName", "")
+        entries = self.filer.list_entries(path, last, False, limit)
+        return {
+            "path": path,
+            "entries": [self._entry_json(e) for e in entries],
+            "lastFileName": entries[-1].name if entries else "",
+            "shouldDisplayLoadMore": len(entries) == limit,
+        }
+
+    @staticmethod
+    def _entry_json(e: Entry) -> dict:
+        return {
+            "FullPath": e.full_path,
+            "Mtime": e.attr.mtime,
+            "Crtime": e.attr.crtime,
+            "Mode": e.attr.mode,
+            "Uid": e.attr.uid,
+            "Gid": e.attr.gid,
+            "Mime": e.attr.mime,
+            "Replication": e.attr.replication,
+            "Collection": e.attr.collection,
+            "TtlSec": e.attr.ttl_sec,
+            "IsDirectory": e.is_directory,
+            "FileSize": e.size(),
+            "Md5": e.attr.md5,
+            "chunks": [c.to_dict() for c in e.chunks],
+        }
+
+    def write_handler(self, req: Request, path: str,
+                      is_dir_path: bool = False):
+        filename, ctype, data = req.upload_payload()
+        if is_dir_path and filename:
+            # POST /dir/ with a file: store as /dir/<filename>
+            path = posixpath.join(path, filename)
+        elif is_dir_path or req.query.get("op") == "mkdir":
+            from ..filer.entry import new_dir_entry
+            self.filer.create_entry(new_dir_entry(path))
+            return {"name": posixpath.basename(path)}
+        collection = req.query.get("collection", self.collection)
+        replication = req.query.get("replication", self.replication)
+        ttl = req.query.get("ttl", "")
+        now_ns = time.time_ns()
+        chunks = []
+        md5 = hashlib.md5()
+        for i in range(0, max(len(data), 1), self.chunk_size):
+            piece = data[i:i + self.chunk_size]
+            if not piece and i > 0:
+                break
+            md5.update(piece)
+            a = operation.assign(self.master_url, collection=collection,
+                                 replication=replication, ttl=ttl)
+            up = operation.upload(a["url"], a["fid"], piece,
+                                  filename=posixpath.basename(path),
+                                  content_type=ctype or
+                                  "application/octet-stream", ttl=ttl)
+            chunks.append(FileChunk(
+                fid=a["fid"], offset=i, size=len(piece),
+                mtime=now_ns + i, etag=up.get("eTag", "")))
+        now = time.time()
+        attr = Attr(mtime=now, crtime=now, mime=ctype,
+                    collection=collection, replication=replication,
+                    ttl_sec=_ttl_seconds(ttl), md5=md5.hexdigest())
+        entry = Entry(full_path=path, attr=attr, chunks=chunks)
+        try:
+            self.filer.create_entry(entry)
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return {"name": posixpath.basename(path), "size": len(data),
+                "fid": chunks[0].fid if chunks else ""}
+
+    def move_handler(self, req: Request, path: str):
+        dest = req.query["mv.to"]
+        try:
+            self.filer.rename_entry(path, dest)
+        except NotFoundError:
+            raise HttpError(404, f"{path} not found") from None
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return {"from": path, "to": dest}
+
+    def delete_handler(self, req: Request, path: str):
+        recursive = req.query.get("recursive", "") == "true"
+        ignore_err = req.query.get("ignoreRecursiveError", "") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive,
+                                    ignore_recursive_error=ignore_err)
+        except NotFoundError:
+            raise HttpError(404, f"{path} not found") from None
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return Response(b"", 204)
+
+
+def _ttl_seconds(ttl: str) -> int:
+    from ..storage.types import TTL
+    return TTL.parse(ttl).minutes * 60 if ttl else 0

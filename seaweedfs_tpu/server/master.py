@@ -206,6 +206,7 @@ class MasterServer:
 
     def dir_status(self, req: Request):
         return {"topology": self.topology.to_dict(),
+                "volumeSizeLimit": self.topology.volume_size_limit,
                 "version": "seaweedfs_tpu 0.1"}
 
     def cluster_status(self, req: Request):

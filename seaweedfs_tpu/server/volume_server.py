@@ -439,7 +439,7 @@ class VolumeServer:
         if v is None:
             ev = self.store.find_ec_volume(vid)
             if ev is not None:
-                return self._read_ec_needle(ev, vid, key, cookie)
+                return self._read_ec_needle(req, ev, vid, key, cookie)
             # not local: redirect to a replica (reference
             # volume_server_handlers_read.go:57-80)
             if self.read_redirect:
@@ -454,19 +454,42 @@ class VolumeServer:
             got = self.store.read_needle(vid, n)
         except NotFound as e:
             raise HttpError(404, str(e)) from None
-        return self._needle_response(got)
+        return self._needle_response(got, req)
 
-    def _needle_response(self, got: Needle) -> Response:
+    def _needle_response(self, got: Needle,
+                         req: Optional[Request] = None) -> Response:
         ctype = got.mime.decode() if got.has_mime() \
             else "application/octet-stream"
-        headers = {"Etag": f'"{got.etag}"'}
+        headers = {"Etag": f'"{got.etag}"',
+                   "Accept-Ranges": "bytes"}
         if got.has_name():
             headers["Content-Disposition"] = \
                 f'inline; filename="{got.name.decode("utf-8", "replace")}"'
-        return Response(got.data, 200, ctype, headers)
+        body = got.data
+        # single-range requests (reference volume_server_handlers_read.go
+        # processRangeRequest): the filer fetches chunk slices this way
+        rng = req.headers.get("Range") if req is not None else None
+        if rng and rng.startswith("bytes="):
+            spec = rng[len("bytes="):].split(",")[0]
+            start_s, _, end_s = spec.partition("-")
+            total = len(body)
+            try:
+                if start_s == "":  # suffix range: last N bytes
+                    start = max(total - int(end_s), 0)
+                    end = total - 1
+                else:
+                    start = int(start_s)
+                    end = min(int(end_s), total - 1) if end_s else total - 1
+            except ValueError:
+                raise HttpError(416, f"bad range {rng}") from None
+            if start > end or start >= total:
+                raise HttpError(416, f"unsatisfiable range {rng}")
+            headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+            return Response(body[start:end + 1], 206, ctype, headers)
+        return Response(body, 200, ctype, headers)
 
     # -- EC degraded read (reference store_ec.go:119-373) ------------------
-    def _read_ec_needle(self, ev, vid, key, cookie):
+    def _read_ec_needle(self, req: Request, ev, vid, key, cookie):
         from ..ec.ec_volume import EcShardNotFound
         try:
             blob = ev.read_needle_blob(
@@ -481,7 +504,7 @@ class VolumeServer:
         got = Needle.from_bytes(blob, ev.version)
         if got.cookie != cookie:
             raise HttpError(404, "cookie mismatch")
-        return self._needle_response(got)
+        return self._needle_response(got, req)
 
     def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
         try:

@@ -58,14 +58,20 @@ def collect_volume_ids_for_ec_encode(env: CommandEnv, collection: str,
     import time
     if size_limit is None:
         status = env.master_get("/dir/status")
-        size_limit = 30 * 1024 * 1024 * 1024
+        size_limit = status.get("volumeSizeLimit") \
+            or 30 * 1024 * 1024 * 1024
+    now = time.time()
     out = []
     for vid_s, replicas in env.all_volumes().items():
         vi = replicas[0]
         if vi.get("collection", "") != collection:
             continue
-        if vi.get("size", 0) >= full_percent * size_limit:
-            out.append(int(vid_s))
+        if vi.get("size", 0) < full_percent * size_limit:
+            continue
+        modified = vi.get("modified_at", 0)
+        if modified and now - modified < quiet_seconds:
+            continue
+        out.append(int(vid_s))
     return out
 
 
@@ -78,7 +84,8 @@ def ec_encode(env: CommandEnv, args: List[str]):
         vids = [int(flags["volumeId"])]
     elif "collection" in flags:
         vids = collect_volume_ids_for_ec_encode(
-            env, flags["collection"], float(flags.get("fullPercent", 0.95)))
+            env, flags["collection"], float(flags.get("fullPercent", 0.95)),
+            quiet_seconds=float(flags.get("quietFor", 3600)))
     else:
         env.write("usage: ec.encode -volumeId <id> | -collection <name>")
         return

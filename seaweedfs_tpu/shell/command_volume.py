@@ -43,10 +43,31 @@ def volume_move(env: CommandEnv, args: List[str]):
         return
     source = flags.get("source", replicas[0]["url"])
     collection = replicas[0].get("collection", "")
-    env.node_post(target, f"/admin/volume/copy?volume={vid}"
-                          f"&collection={collection}&source={source}")
-    env.node_post(source, f"/admin/delete_volume?volume={vid}")
+    _move_volume(env, vid, collection, source, target, replicas)
     env.write(f"volume {vid}: {source} -> {target}")
+
+
+def _move_volume(env: CommandEnv, vid: int, collection: str, source: str,
+                 target: str, replicas):
+    """Freeze -> copy -> delete source -> thaw survivors. Without the
+    freeze, writes landing after the .idx snapshot would be lost when the
+    source is deleted (the copy is .idx-then-.dat)."""
+    urls = [r["url"] for r in replicas]
+    for url in urls:
+        env.node_post(url, f"/admin/volume/readonly?volume={vid}")
+    try:
+        env.node_post(target, f"/admin/volume/copy?volume={vid}"
+                              f"&collection={collection}&source={source}")
+    except Exception:
+        for url in urls:
+            env.node_post(url, f"/admin/volume/readonly?volume={vid}"
+                               f"&readonly=false")
+        raise
+    env.node_post(source, f"/admin/delete_volume?volume={vid}")
+    for url in urls:
+        if url != source:
+            env.node_post(url, f"/admin/volume/readonly?volume={vid}"
+                               f"&readonly=false")
 
 
 @command("volume.balance", ": even out volume counts across servers")
@@ -66,14 +87,13 @@ def volume_balance(env: CommandEnv, args: List[str]):
         for vid_s, replicas in env.all_volumes().items():
             urls = [r["url"] for r in replicas]
             if hi in urls and lo not in urls:
-                movable = (int(vid_s), replicas[0].get("collection", ""))
+                movable = (int(vid_s), replicas[0].get("collection", ""),
+                           replicas)
                 break
         if movable is None:
             break
-        vid, collection = movable
-        env.node_post(lo, f"/admin/volume/copy?volume={vid}"
-                          f"&collection={collection}&source={hi}")
-        env.node_post(hi, f"/admin/delete_volume?volume={vid}")
+        vid, collection, replicas = movable
+        _move_volume(env, vid, collection, hi, lo, replicas)
         env.write(f"moved volume {vid}: {hi} -> {lo}")
         moves += 1
         if moves > 100:

@@ -203,6 +203,7 @@ class Store:
                     "ttl": v.super_block.ttl.to_uint32(),
                     "version": v.version,
                     "compact_revision": v.super_block.compaction_revision,
+                    "modified_at": v.last_modified,
                 })
             for vid, ev in loc.ec_volumes.items():
                 bits = 0

@@ -1,0 +1,267 @@
+"""Filer core tests.
+
+Mirrors reference weed/filer2/filechunks_test.go (interval overlay
+tables), leveldb_store_test.go (store round-trip), and
+filer_delete_entry.go behavior (recursive delete + chunk queue).
+"""
+
+import pytest
+
+from seaweedfs_tpu.filer import (
+    Attr,
+    Entry,
+    FileChunk,
+    Filer,
+    MemoryStore,
+    SqliteStore,
+    compact_file_chunks,
+    minus_chunks,
+    non_overlapping_visible_intervals,
+    total_size,
+    view_from_chunks,
+)
+from seaweedfs_tpu.filer.filer import FilerError, NotFoundError
+from seaweedfs_tpu.filer.stream import read_chunked
+
+
+def c(fid, offset, size, mtime):
+    return FileChunk(fid=fid, offset=offset, size=size, mtime=mtime)
+
+
+class TestVisibleIntervals:
+    # cases transcribed from reference filechunks_test.go:96-180
+    def test_non_overlapping(self):
+        vis = non_overlapping_visible_intervals(
+            [c("a", 0, 100, 100), c("b", 100, 100, 200)])
+        assert [(v.start, v.stop, v.fid) for v in vis] == [
+            (0, 100, "a"), (100, 200, "b")]
+
+    def test_full_overwrite(self):
+        vis = non_overlapping_visible_intervals(
+            [c("a", 0, 100, 100), c("b", 0, 100, 200)])
+        assert [(v.start, v.stop, v.fid) for v in vis] == [(0, 100, "b")]
+
+    def test_old_full_overwrite_loses(self):
+        # newer smaller write splits the older chunk
+        vis = non_overlapping_visible_intervals(
+            [c("a", 0, 100, 100), c("b", 25, 50, 200)])
+        assert [(v.start, v.stop, v.fid) for v in vis] == [
+            (0, 25, "a"), (25, 75, "b"), (75, 100, "a")]
+        # tail of "a" must read from inside the chunk
+        assert vis[2].chunk_offset == 75
+
+    def test_head_overwrite(self):
+        vis = non_overlapping_visible_intervals(
+            [c("a", 0, 100, 100), c("b", 0, 50, 200)])
+        assert [(v.start, v.stop, v.fid) for v in vis] == [
+            (0, 50, "b"), (50, 100, "a")]
+        assert vis[1].chunk_offset == 50
+
+    def test_tail_overwrite(self):
+        vis = non_overlapping_visible_intervals(
+            [c("a", 0, 100, 100), c("b", 50, 100, 200)])
+        assert [(v.start, v.stop, v.fid) for v in vis] == [
+            (0, 50, "a"), (50, 150, "b")]
+
+    def test_mtime_not_order_decides(self):
+        # older mtime listed later still loses
+        vis = non_overlapping_visible_intervals(
+            [c("b", 0, 100, 200), c("a", 0, 100, 100)])
+        assert [v.fid for v in vis] == ["b"]
+
+    def test_three_layers(self):
+        vis = non_overlapping_visible_intervals(
+            [c("a", 0, 300, 100), c("b", 100, 100, 200),
+             c("x", 150, 25, 300)])
+        assert [(v.start, v.stop, v.fid) for v in vis] == [
+            (0, 100, "a"), (100, 150, "b"), (150, 175, "x"),
+            (175, 200, "b"), (200, 300, "a")]
+
+
+class TestChunkViews:
+    def test_view_middle(self):
+        views = view_from_chunks(
+            [c("a", 0, 100, 100), c("b", 100, 100, 200)], 50, 100)
+        assert [(v.fid, v.offset, v.size, v.logical_offset)
+                for v in views] == [("a", 50, 50, 50), ("b", 0, 50, 100)]
+
+    def test_view_whole(self):
+        views = view_from_chunks([c("a", 0, 100, 100)], 0, -1)
+        assert views[0].is_full_chunk
+
+    def test_view_of_clipped_tail(self):
+        views = view_from_chunks(
+            [c("a", 0, 100, 100), c("b", 0, 50, 200)], 60, 20)
+        assert views == [views[0]]
+        v = views[0]
+        assert (v.fid, v.offset, v.size) == ("a", 60, 20)
+
+    def test_compact_and_minus(self):
+        chunks = [c("a", 0, 100, 100), c("b", 0, 100, 200),
+                  c("d", 200, 100, 250)]
+        compacted, garbage = compact_file_chunks(chunks)
+        assert {x.fid for x in compacted} == {"b", "d"}
+        assert {x.fid for x in garbage} == {"a"}
+        removed = minus_chunks(chunks, compacted)
+        assert {x.fid for x in removed} == {"a"}
+
+    def test_total_size(self):
+        assert total_size([c("a", 0, 100, 1), c("b", 50, 100, 2)]) == 150
+
+
+class TestReadChunked:
+    def test_reassembly_with_overlay(self):
+        blobs = {"a": bytes(range(100)), "b": bytes([255] * 50)}
+
+        def fetch(fid, offset, size):
+            return blobs[fid][offset:offset + size]
+
+        chunks = [c("a", 0, 100, 100), c("b", 25, 50, 200)]
+        out = read_chunked(chunks, 0, -1, fetch)
+        assert out == blobs["a"][:25] + blobs["b"] + blobs["a"][75:]
+
+    def test_sparse_gap_reads_zero(self):
+        blobs = {"a": b"x" * 10}
+
+        def fetch(fid, offset, size):
+            return blobs[fid][offset:offset + size]
+
+        out = read_chunked([c("a", 100, 10, 1)], 95, 20, fetch)
+        assert out == b"\0" * 5 + b"x" * 10 + b"\0" * 5
+
+
+@pytest.mark.parametrize("store_cls", [MemoryStore, SqliteStore])
+class TestStores:
+    def make(self, store_cls):
+        s = store_cls()
+        s.initialize()
+        return s
+
+    def test_round_trip(self, store_cls):
+        s = self.make(store_cls)
+        e = Entry(full_path="/home/file.txt",
+                  attr=Attr(mtime=123.0, mime="text/plain"),
+                  chunks=[c("3,01ab", 0, 10, 5)],
+                  extended={"user.k": b"\x01\x02"})
+        s.insert_entry(e)
+        got = s.find_entry("/home/file.txt")
+        assert got.attr.mime == "text/plain"
+        assert got.chunks[0].fid == "3,01ab"
+        assert got.extended["user.k"] == b"\x01\x02"
+        assert s.find_entry("/nope") is None
+
+    def test_listing_pagination(self, store_cls):
+        s = self.make(store_cls)
+        for name in ["a", "b", "c", "d"]:
+            s.insert_entry(Entry(full_path=f"/dir/{name}"))
+        page = s.list_directory_entries("/dir", "", False, 2)
+        assert [e.name for e in page] == ["a", "b"]
+        page = s.list_directory_entries("/dir", "b", False, 10)
+        assert [e.name for e in page] == ["c", "d"]
+        page = s.list_directory_entries("/dir", "b", True, 10)
+        assert [e.name for e in page] == ["b", "c", "d"]
+
+    def test_delete_folder_children(self, store_cls):
+        s = self.make(store_cls)
+        for p in ["/x/a", "/x/sub/b", "/y/c"]:
+            s.insert_entry(Entry(full_path=p))
+        s.delete_folder_children("/x")
+        assert s.find_entry("/x/a") is None
+        assert s.find_entry("/x/sub/b") is None
+        assert s.find_entry("/y/c") is not None
+
+
+class TestFiler:
+    def make(self):
+        store = MemoryStore()
+        store.initialize()
+        return Filer(store)
+
+    def test_create_makes_parents(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/a/b/c/file.txt"))
+        assert f.find_entry("/a/b/c").is_directory
+        assert f.find_entry("/a").is_directory
+        assert not f.find_entry("/a/b/c/file.txt").is_directory
+
+    def test_overwrite_queues_old_chunks(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/f", chunks=[c("1,aa", 0, 10, 1)]))
+        f.create_entry(Entry(full_path="/f", chunks=[c("2,bb", 0, 10, 2)]))
+        assert f.drain_deletion_queue() == ["1,aa"]
+
+    def test_delete_recursive(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/d/x", chunks=[c("1,aa", 0, 5, 1)]))
+        f.create_entry(Entry(full_path="/d/sub/y",
+                             chunks=[c("2,bb", 0, 5, 1)]))
+        with pytest.raises(FilerError):
+            f.delete_entry("/d")
+        f.delete_entry("/d", recursive=True)
+        assert not f.exists("/d")
+        assert set(f.drain_deletion_queue()) == {"1,aa", "2,bb"}
+
+    def test_rename_tree(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/old/a/f1"))
+        f.create_entry(Entry(full_path="/old/f2"))
+        f.rename_entry("/old", "/new")
+        assert f.exists("/new/a/f1")
+        assert f.exists("/new/f2")
+        assert not f.exists("/old")
+
+    def test_rename_file(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/f1", chunks=[c("1,aa", 0, 5, 1)]))
+        f.rename_entry("/f1", "/sub/f2")
+        assert f.find_entry("/sub/f2").chunks[0].fid == "1,aa"
+        assert not f.exists("/f1")
+
+    def test_rename_into_own_subtree_rejected(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/a/b/file"))
+        with pytest.raises(FilerError):
+            f.rename_entry("/a", "/a/b/c")
+        # no-op rename keeps the entry intact
+        f.rename_entry("/a", "/a")
+        assert f.exists("/a/b/file")
+
+    def test_rename_over_existing_file_reclaims_chunks(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/src", chunks=[c("1,aa", 0, 5, 1)]))
+        f.create_entry(Entry(full_path="/dst", chunks=[c("2,bb", 0, 5, 1)]))
+        f.rename_entry("/src", "/dst")
+        assert f.find_entry("/dst").chunks[0].fid == "1,aa"
+        assert "2,bb" in f.drain_deletion_queue()
+
+    def test_rename_onto_directory_rejected(self):
+        f = self.make()
+        f.create_entry(Entry(full_path="/afile"))
+        f.create_entry(Entry(full_path="/adir/child"))
+        with pytest.raises(FilerError):
+            f.rename_entry("/afile", "/adir")
+
+    def test_buckets(self):
+        f = self.make()
+        f.create_bucket("pics", replication="001")
+        assert [b.name for b in f.list_buckets()] == ["pics"]
+        assert f.find_entry("/buckets/pics").attr.collection == "pics"
+        f.delete_bucket("pics")
+        assert f.list_buckets() == []
+
+    def test_notify_events(self):
+        f = self.make()
+        events = []
+        f.on_update(lambda old, new, dc: events.append(
+            (old.full_path if old else None,
+             new.full_path if new else None)))
+        f.create_entry(Entry(full_path="/n/file"))
+        f.delete_entry("/n/file")
+        assert (None, "/n") in events          # implicit mkdir
+        assert (None, "/n/file") in events     # create
+        assert ("/n/file", None) in events     # delete
+
+    def test_not_found(self):
+        f = self.make()
+        with pytest.raises(NotFoundError):
+            f.find_entry("/missing")

@@ -1,0 +1,168 @@
+"""FilerServer integration: master + volume servers + filer over HTTP.
+
+Covers the reference's autoChunk write path
+(filer_server_handlers_write_autochunk.go), streaming reads, listing,
+recursive delete with chunk cleanup, rename, and the metadata event
+long-poll (`weed watch` analog).
+"""
+
+import json
+
+import pytest
+
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import (HttpError, get_json, http_call,
+                                            post_multipart)
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    servers = [VolumeServer(port=0, directories=[str(tmp_path / f"v{i}")],
+                            master_url=master.url, pulse_seconds=1,
+                            max_volume_counts=[20],
+                            ec_backend="numpy").start()
+               for i in range(2)]
+    filer = FilerServer(port=0, master_url=master.url,
+                        chunk_size=1024).start()
+    yield master, servers, filer
+    filer.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def furl(filer, path):
+    return f"http://{filer.url}{path}"
+
+
+def test_upload_read_small(cluster):
+    _, _, filer = cluster
+    data = b"hello filer world"
+    r = post_multipart(furl(filer, "/docs/hello.txt"), "hello.txt", data,
+                       "text/plain")
+    assert r["size"] == len(data)
+    got = http_call("GET", furl(filer, "/docs/hello.txt"))
+    assert got == data
+
+
+def test_chunked_upload_and_range(cluster):
+    _, _, filer = cluster
+    data = bytes(range(256)) * 20  # 5120 bytes -> 5 chunks of 1024
+    post_multipart(furl(filer, "/big.bin"), "big.bin", data)
+    entry = filer.filer.find_entry("/big.bin")
+    assert len(entry.chunks) == 5
+    assert http_call("GET", furl(filer, "/big.bin")) == data
+    # range crossing a chunk boundary
+    got = http_call("GET", furl(filer, "/big.bin"),
+                    headers={"Range": "bytes=1000-3000"})
+    assert got == data[1000:3001]
+    # suffix range
+    got = http_call("GET", furl(filer, "/big.bin"),
+                    headers={"Range": "bytes=-100"})
+    assert got == data[-100:]
+
+
+def test_listing_pagination(cluster):
+    _, _, filer = cluster
+    for name in ["a.txt", "b.txt", "c.txt"]:
+        post_multipart(furl(filer, f"/dir/{name}"), name, b"x")
+    out = get_json(furl(filer, "/dir/?limit=2"))
+    assert [e["FullPath"] for e in out["entries"]] == ["/dir/a.txt",
+                                                      "/dir/b.txt"]
+    assert out["shouldDisplayLoadMore"]
+    out = get_json(furl(filer, "/dir/?limit=2&lastFileName=b.txt"))
+    assert [e["FullPath"] for e in out["entries"]] == ["/dir/c.txt"]
+
+
+def test_overwrite_deletes_old_chunks(cluster):
+    master, _, filer = cluster
+    post_multipart(furl(filer, "/f.bin"), "f.bin", b"version-one")
+    old_fid = filer.filer.find_entry("/f.bin").chunks[0].fid
+    post_multipart(furl(filer, "/f.bin"), "f.bin", b"version-two!")
+    assert http_call("GET", furl(filer, "/f.bin")) == b"version-two!"
+    filer.flush_deletions()
+    with pytest.raises(HttpError):
+        op.read_file(master.url, old_fid)
+
+
+def test_delete_recursive_cleans_chunks(cluster):
+    master, _, filer = cluster
+    post_multipart(furl(filer, "/tree/x/1.bin"), "1.bin", b"one")
+    post_multipart(furl(filer, "/tree/2.bin"), "2.bin", b"two")
+    fid = filer.filer.find_entry("/tree/x/1.bin").chunks[0].fid
+    # non-recursive delete of non-empty dir -> 409
+    with pytest.raises(HttpError):
+        http_call("DELETE", furl(filer, "/tree"))
+    http_call("DELETE", furl(filer, "/tree?recursive=true"))
+    with pytest.raises(HttpError):
+        http_call("GET", furl(filer, "/tree/2.bin"))
+    filer.flush_deletions()
+    with pytest.raises(HttpError):
+        op.read_file(master.url, fid)
+
+
+def test_rename(cluster):
+    _, _, filer = cluster
+    post_multipart(furl(filer, "/old/name.txt"), "name.txt", b"data")
+    http_call("POST", furl(filer, "/old/name.txt?mv.to=/new/name2.txt"))
+    assert http_call("GET", furl(filer, "/new/name2.txt")) == b"data"
+    with pytest.raises(HttpError):
+        http_call("GET", furl(filer, "/old/name.txt"))
+
+
+def test_upload_into_directory_path(cluster):
+    # POST /dir/ with a multipart file stores /dir/<filename>
+    _, _, filer = cluster
+    post_multipart(furl(filer, "/incoming/"), "x.jpg", b"jpegbytes")
+    assert http_call("GET", furl(filer, "/incoming/x.jpg")) == b"jpegbytes"
+
+
+def test_bad_range_is_416_not_500(cluster):
+    _, _, filer = cluster
+    post_multipart(furl(filer, "/r.bin"), "r.bin", b"0123456789")
+    for bad in ("bytes=abc-", "bytes=5-2"):
+        with pytest.raises(HttpError) as e:
+            http_call("GET", furl(filer, "/r.bin"),
+                      headers={"Range": bad})
+        assert e.value.status == 416, bad
+
+
+def test_mkdir_and_head(cluster):
+    _, _, filer = cluster
+    http_call("POST", furl(filer, "/emptydir?op=mkdir"))
+    out = get_json(furl(filer, "/emptydir"))
+    assert out["entries"] == []
+    post_multipart(furl(filer, "/h.bin"), "h.bin", b"x" * 100)
+    # HEAD does not stream the body
+    assert http_call("HEAD", furl(filer, "/h.bin")) == b""
+
+
+def test_events_longpoll(cluster):
+    _, _, filer = cluster
+    post_multipart(furl(filer, "/ev.txt"), "ev.txt", b"x")
+    out = get_json(furl(filer, "/filer/events?since=0&timeout=2"))
+    paths = [e["event"]["newEntry"]["path"] for e in out["events"]
+             if e["event"]["newEntry"]]
+    assert "/ev.txt" in paths
+    # nothing new after the last ts -> empty after timeout
+    last = out["events"][-1]["ts"]
+    out2 = get_json(furl(filer, f"/filer/events?since={last}&timeout=0.2"))
+    assert out2["events"] == []
+
+
+def test_sqlite_store_persistence(cluster, tmp_path):
+    master, _, _ = cluster
+    db = str(tmp_path / "filer.db")
+    f1 = FilerServer(port=0, master_url=master.url, store="sqlite",
+                     store_options={"path": db}).start()
+    post_multipart(f"http://{f1.url}/persist.txt", "persist.txt", b"keep")
+    f1.stop()
+    f2 = FilerServer(port=0, master_url=master.url, store="sqlite",
+                     store_options={"path": db}).start()
+    assert http_call("GET", f"http://{f2.url}/persist.txt") == b"keep"
+    f2.stop()
