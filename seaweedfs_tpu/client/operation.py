@@ -30,11 +30,13 @@ def assign(master_url: str, count: int = 1, collection: str = "",
 
 def upload(url: str, fid: str, data: bytes, filename: str = "",
            content_type: str = "application/octet-stream",
-           ttl: str = "") -> dict:
+           ttl: str = "", jwt: str = "") -> dict:
     target = f"http://{url}/{fid}"
     if ttl:
         target += f"?ttl={ttl}"
-    return post_multipart(target, filename, data, content_type)
+    headers = {"Authorization": f"Bearer {jwt}"} if jwt else None
+    return post_multipart(target, filename, data, content_type,
+                          headers=headers)
 
 
 def upload_data(master_url: str, data: bytes, filename: str = "",
@@ -44,7 +46,8 @@ def upload_data(master_url: str, data: bytes, filename: str = "",
     """Assign + upload; returns the fid."""
     a = assign(master_url, collection=collection, replication=replication,
                ttl=ttl)
-    upload(a["url"], a["fid"], data, filename, content_type, ttl)
+    upload(a["url"], a["fid"], data, filename, content_type, ttl,
+           jwt=a.get("auth", ""))
     return a["fid"]
 
 
@@ -90,14 +93,16 @@ def read_file(master_url: str, fid: str,
 
 
 def delete_file(master_url: str, fid: str,
-                cache: Optional[VidCache] = None) -> bool:
+                cache: Optional[VidCache] = None,
+                jwt: str = "") -> bool:
     from ..storage.types import parse_file_id
     vid, _, _ = parse_file_id(fid)
     urls = cache.lookup(vid) if cache else lookup(master_url, vid)
+    headers = {"Authorization": f"Bearer {jwt}"} if jwt else None
     ok = False
     for u in urls:
         try:
-            http_call("DELETE", f"http://{u}/{fid}")
+            http_call("DELETE", f"http://{u}/{fid}", headers=headers)
             ok = True
             break  # server fans out to replicas itself
         except HttpError:

@@ -76,7 +76,8 @@ def run_benchmark(master_url: str, num_files: int = 1024,
                 try:
                     a = op.assign(master_url, collection=collection)
                     op.upload(a["url"], a["fid"], payload,
-                              filename=f"b{wid}_{i}")
+                              filename=f"b{wid}_{i}",
+                              jwt=a.get("auth", ""))
                     stats.add(time.perf_counter() - t, file_size)
                     with fid_lock:
                         fids.append(a["fid"])

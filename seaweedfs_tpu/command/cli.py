@@ -18,7 +18,8 @@ def cmd_master(args):
     m = MasterServer(port=args.port, host=args.ip,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
-                     pulse_seconds=args.pulseSeconds).start()
+                     pulse_seconds=args.pulseSeconds,
+                     jwt_signing_key=args.jwtKey).start()
     print(f"master listening on {m.url}")
     _wait()
 
@@ -33,7 +34,10 @@ def cmd_volume(args):
                       master_url=args.mserver, data_center=args.dataCenter,
                       rack=args.rack, max_volume_counts=maxes,
                       pulse_seconds=args.pulseSeconds,
-                      ec_backend=args.ec_backend).start()
+                      ec_backend=args.ec_backend,
+                      jwt_signing_key=args.jwtKey,
+                      whitelist=[w for w in args.whiteList.split(",")
+                                 if w]).start()
     print(f"volume server listening on {vs.url}, "
           f"heartbeating to {args.mserver}")
     _wait()
@@ -45,21 +49,38 @@ def cmd_server(args):
     from ..server.master import MasterServer
     from ..server.volume_server import VolumeServer
     m = MasterServer(port=args.masterPort, host=args.ip,
-                     default_replication=args.defaultReplication).start()
+                     default_replication=args.defaultReplication,
+                     jwt_signing_key=args.jwtKey).start()
     dirs = args.dir.split(",")
     maxes = [int(args.max)] * len(dirs)
     vs = VolumeServer(port=args.port, host=args.ip, directories=dirs,
                       master_url=m.url, data_center=args.dataCenter,
                       rack=args.rack, pulse_seconds=args.pulseSeconds,
                       max_volume_counts=maxes,
-                      ec_backend=args.ec_backend).start()
+                      ec_backend=args.ec_backend,
+                      jwt_signing_key=args.jwtKey).start()
     print(f"master on {m.url}, volume server on {vs.url}")
-    if args.filer:
+    if args.filer or args.s3:
         from ..server.filer_server import FilerServer
         f = FilerServer(port=args.filerPort, host=args.ip,
-                        master_url=m.url).start()
+                        master_url=m.url,
+                        jwt_signing_key=args.jwtKey).start()
         print(f"filer on {f.url}")
+        if args.s3:
+            s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
+            print(f"s3 gateway on {s3.url}")
     _wait()
+
+
+def _start_s3(filer_server, port: int, host: str, config_path: str):
+    import json as _json
+    from ..s3 import Iam, S3ApiServer
+    iam = Iam()
+    if config_path:
+        with open(config_path) as fh:
+            iam = Iam.from_config(_json.load(fh))
+    return S3ApiServer(filer_server.filer, filer_server.master_url,
+                       port=port, host=host, iam=iam).start()
 
 
 def cmd_filer(args):
@@ -69,8 +90,12 @@ def cmd_filer(args):
                     store=args.store, store_options=store_options,
                     collection=args.collection,
                     replication=args.defaultReplicaPlacement,
-                    chunk_size=args.maxMB << 20).start()
+                    chunk_size=args.maxMB << 20,
+                    jwt_signing_key=args.jwtKey).start()
     print(f"filer listening on {f.url}, master {args.master}")
+    if args.s3:
+        s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
+        print(f"s3 gateway on {s3.url}")
     _wait()
 
 
@@ -140,6 +165,8 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     m.add_argument("-defaultReplication", default="000")
     m.add_argument("-pulseSeconds", type=int, default=5)
+    m.add_argument("-jwtKey", default="",
+                   help="HS256 key for per-fid write tokens")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")
@@ -153,6 +180,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-pulseSeconds", type=int, default=5)
     v.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
+    v.add_argument("-jwtKey", default="")
+    v.add_argument("-whiteList", default="",
+                   help="comma-separated IPs/CIDRs allowed to call")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server", help="master + volume (+filer) combined")
@@ -168,8 +198,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-pulseSeconds", type=int, default=5)
     s.add_argument("-filer", action="store_true")
     s.add_argument("-filerPort", type=int, default=8888)
+    s.add_argument("-s3", action="store_true")
+    s.add_argument("-s3Port", type=int, default=8333)
+    s.add_argument("-s3Config", default="",
+                   help="IAM identities JSON (reference s3 config shape)")
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
+    s.add_argument("-jwtKey", default="")
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer", help="start a filer server")
@@ -184,6 +219,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,
                    help="autochunk split size")
+    f.add_argument("-s3", action="store_true")
+    f.add_argument("-s3Port", type=int, default=8333)
+    f.add_argument("-s3Config", default="")
+    f.add_argument("-jwtKey", default="")
     f.set_defaults(fn=cmd_filer)
 
     sh = sub.add_parser("shell", help="admin shell")

@@ -70,10 +70,15 @@ class SqliteStore(FilerStore):
 
     def delete_folder_children(self, full_path: str) -> None:
         prefix = full_path.rstrip("/") + "/"
+        # escape LIKE wildcards in the path itself, else "/a_b" would
+        # also delete children of "/axb"
+        escaped = prefix.replace("\\", "\\\\").replace("%", "\\%") \
+                        .replace("_", "\\_")
         with self._lock:
             self._db.execute(
-                "DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
-                (full_path.rstrip("/") or "/", prefix + "%"))
+                "DELETE FROM filemeta WHERE directory=? "
+                "OR directory LIKE ? ESCAPE '\\'",
+                (full_path.rstrip("/") or "/", escaped + "%"))
             self._db.commit()
 
     def list_directory_entries(self, dir_path: str, start_file_name: str,

@@ -1,0 +1,39 @@
+"""Shared auto-chunking upload: split data, assign fids, upload chunks.
+
+The write half of the reference's autoChunk
+(filer_server_handlers_write_autochunk.go) — used by both the filer HTTP
+server and the S3 gateway.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import List, Tuple
+
+from ..client import operation
+from .entry import FileChunk
+
+
+def split_and_upload(master_url: str, data: bytes, filename: str,
+                     chunk_size: int, collection: str = "",
+                     replication: str = "", ttl: str = "",
+                     content_type: str = "application/octet-stream",
+                     ) -> Tuple[List[FileChunk], str]:
+    """Upload `data` as one or more chunks; returns (chunks, md5hex)."""
+    now_ns = time.time_ns()
+    chunks: List[FileChunk] = []
+    md5 = hashlib.md5()
+    for i in range(0, max(len(data), 1), chunk_size):
+        piece = data[i:i + chunk_size]
+        if not piece and i > 0:
+            break
+        md5.update(piece)
+        a = operation.assign(master_url, collection=collection,
+                             replication=replication, ttl=ttl)
+        up = operation.upload(a["url"], a["fid"], piece, filename=filename,
+                              content_type=content_type, ttl=ttl,
+                              jwt=a.get("auth", ""))
+        chunks.append(FileChunk(fid=a["fid"], offset=i, size=len(piece),
+                                mtime=now_ns + i, etag=up.get("eTag", "")))
+    return chunks, md5.hexdigest()

@@ -1,0 +1,10 @@
+"""S3-compatible gateway over the filer.
+
+Reference weed/s3api/: REST router (s3api_server.go:35-100), AWS
+SigV4/V2 authentication incl. streaming chunked payloads
+(auth_signature_v4.go, chunked_reader_v4.go), bucket/object/multipart
+handlers (filer_multipart.go), IAM credentials (auth_credentials.go).
+"""
+
+from .auth import Iam, Identity, S3AuthError, sign_request_v4  # noqa: F401
+from .s3_server import S3ApiServer  # noqa: F401

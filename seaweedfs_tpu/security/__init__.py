@@ -1,0 +1,10 @@
+"""Security: JWT write tokens + request guard.
+
+Reference weed/security/: jwt.go (per-fid HS256 write tokens minted by
+the master, verified by volume servers), guard.go (IP whitelist + jwt
+enforcement wrapper). gRPC mTLS has no analog here (stdlib HTTP);
+transport security is deployment-level.
+"""
+
+from .jwt import GenJwt, VerifyError, decode_jwt, encode_jwt  # noqa: F401
+from .guard import Guard  # noqa: F401

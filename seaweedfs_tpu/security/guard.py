@@ -1,0 +1,44 @@
+"""Request guard: IP whitelist (reference weed/security/guard.go:13-45).
+
+Wraps handlers; a non-empty whitelist restricts callers by source IP
+(exact match or prefix like "10.0." — the reference also accepts CIDRs,
+which we support via ipaddress networks).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Iterable, List
+
+
+class Guard:
+    def __init__(self, whitelist: Iterable[str] = ()):
+        self.exact: List[str] = []
+        self.networks = []
+        for item in whitelist:
+            item = item.strip()
+            if not item:
+                continue
+            if "/" in item:
+                self.networks.append(ipaddress.ip_network(item,
+                                                         strict=False))
+            else:
+                self.exact.append(item)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.exact or self.networks)
+
+    def allows(self, ip: str) -> bool:
+        if not self.enabled:
+            return True
+        if ip in self.exact:
+            return True
+        for e in self.exact:  # prefix form "10.0."
+            if e.endswith(".") and ip.startswith(e):
+                return True
+        try:
+            addr = ipaddress.ip_address(ip)
+        except ValueError:
+            return False
+        return any(addr in net for net in self.networks)

@@ -1,0 +1,75 @@
+"""HS256 JWT for per-fid write authorization.
+
+Reference weed/security/jwt.go:21-58: the master mints a short-lived
+token bound to the file id when handing out an assignment; volume
+servers verify it before accepting writes/deletes. Standard JWT wire
+format (base64url header.payload.signature) so external tooling can
+inspect tokens.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Optional
+
+
+class VerifyError(Exception):
+    pass
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def encode_jwt(key: str, claims: dict) -> str:
+    header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"},
+                             separators=(",", ":")).encode())
+    payload = _b64(json.dumps(claims, separators=(",", ":")).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = hmac.new(key.encode(), signing_input, hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64(sig)}"
+
+
+def decode_jwt(key: str, token: str) -> dict:
+    try:
+        header, payload, sig = token.split(".")
+    except ValueError:
+        raise VerifyError("malformed token") from None
+    signing_input = f"{header}.{payload}".encode()
+    want = hmac.new(key.encode(), signing_input, hashlib.sha256).digest()
+    if not hmac.compare_digest(want, _unb64(sig)):
+        raise VerifyError("bad signature")
+    claims = json.loads(_unb64(payload))
+    exp = claims.get("exp")
+    if exp is not None and time.time() > exp:
+        raise VerifyError("token expired")
+    return claims
+
+
+def GenJwt(key: str, fid: str, expires_seconds: int = 10) -> str:
+    """Mint a write token bound to one fid (reference GenJwt)."""
+    return encode_jwt(key, {"fid": fid,
+                            "exp": int(time.time()) + expires_seconds})
+
+
+def verify_fid_jwt(key: str, token: str, fid: str) -> None:
+    claims = decode_jwt(key, token)
+    if claims.get("fid") != fid:
+        raise VerifyError(f"token not valid for {fid}")
+
+
+def jwt_from_request(headers, query: dict) -> Optional[str]:
+    """Authorization: Bearer <t> header, or ?jwt=<t> (reference
+    GetJwt request parsing order)."""
+    auth = headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer "):].strip()
+    return query.get("jwt") or None

@@ -13,15 +13,13 @@ Reference weed/server/filer_server*.go:
 
 from __future__ import annotations
 
-import hashlib
-import json
 import posixpath
 import threading
 import time
 from typing import Optional
 
 from ..client import operation
-from ..filer import Attr, Entry, FileChunk, Filer
+from ..filer import Attr, Entry, Filer
 from ..filer.filer import FilerError, NotFoundError
 from ..filer.log_buffer import LogBuffer, event_notification
 from ..filer.filerstore import make_store
@@ -38,7 +36,7 @@ class FilerServer:
                  store: str = "memory", store_options: Optional[dict] = None,
                  collection: str = "", replication: str = "",
                  chunk_size: int = CHUNK_SIZE_DEFAULT,
-                 notify_publisher=None):
+                 notify_publisher=None, jwt_signing_key: str = ""):
         router = Router()
         router.add("GET", "/filer/events", self.events_handler)
         router.add("GET", "/filer/status", self.status_handler)
@@ -50,6 +48,7 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        self.jwt_signing_key = jwt_signing_key
         self.filer = Filer(make_store(store, **(store_options or {})))
         self.log_buffer = LogBuffer()
         self.notify_publisher = notify_publisher
@@ -93,7 +92,12 @@ class FilerServer:
     def flush_deletions(self):
         for fid in self.filer.drain_deletion_queue():
             try:
-                operation.delete_file(self.master_url, fid, self.vid_cache)
+                jwt = ""
+                if self.jwt_signing_key:
+                    from ..security.jwt import GenJwt
+                    jwt = GenJwt(self.jwt_signing_key, fid)
+                operation.delete_file(self.master_url, fid,
+                                      self.vid_cache, jwt=jwt)
             except HttpError:
                 pass
 
@@ -153,18 +157,16 @@ class FilerServer:
             headers["Content-Range"] = \
                 f"bytes {offset}-{offset+length-1}/{size}"
             status = 206
-        if req.method == "HEAD":
-            body = b""
-            headers["Content-Length-Hint"] = str(size)
-        else:
-            body = read_chunked(entry.chunks, offset, length,
-                                self._chunk_fetcher())
+        head = req.method == "HEAD"
+        body = b"" if head else read_chunked(entry.chunks, offset, length,
+                                             self._chunk_fetcher())
         mime = entry.attr.mime or "application/octet-stream"
         if entry.attr.md5:
             headers["Etag"] = f'"{entry.attr.md5}"'
         headers["Last-Modified"] = time.strftime(
             "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime))
-        return Response(body, status, mime, headers)
+        return Response(body, status, mime, headers,
+                        content_length=length if head else None)
 
     def _chunk_fetcher(self):
         if self._fetch is None:
@@ -215,27 +217,16 @@ class FilerServer:
         collection = req.query.get("collection", self.collection)
         replication = req.query.get("replication", self.replication)
         ttl = req.query.get("ttl", "")
-        now_ns = time.time_ns()
-        chunks = []
-        md5 = hashlib.md5()
-        for i in range(0, max(len(data), 1), self.chunk_size):
-            piece = data[i:i + self.chunk_size]
-            if not piece and i > 0:
-                break
-            md5.update(piece)
-            a = operation.assign(self.master_url, collection=collection,
-                                 replication=replication, ttl=ttl)
-            up = operation.upload(a["url"], a["fid"], piece,
-                                  filename=posixpath.basename(path),
-                                  content_type=ctype or
-                                  "application/octet-stream", ttl=ttl)
-            chunks.append(FileChunk(
-                fid=a["fid"], offset=i, size=len(piece),
-                mtime=now_ns + i, etag=up.get("eTag", "")))
+        from ..filer.upload import split_and_upload
+        chunks, md5_hex = split_and_upload(
+            self.master_url, data, posixpath.basename(path),
+            self.chunk_size, collection=collection,
+            replication=replication, ttl=ttl,
+            content_type=ctype or "application/octet-stream")
         now = time.time()
         attr = Attr(mtime=now, crtime=now, mime=ctype,
                     collection=collection, replication=replication,
-                    ttl_sec=_ttl_seconds(ttl), md5=md5.hexdigest())
+                    ttl_sec=_ttl_seconds(ttl), md5=md5_hex)
         entry = Entry(full_path=path, attr=attr, chunks=chunks)
         try:
             self.filer.create_entry(entry)

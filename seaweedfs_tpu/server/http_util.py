@@ -91,6 +91,8 @@ class Router:
     def __init__(self):
         self.routes: List[Route] = []
         self.fallback: Optional[Callable] = None
+        # runs before every handler (guard checks); may raise HttpError
+        self.before: Optional[Callable] = None
 
     def add(self, method: str, path: str, fn: Callable,
             prefix: bool = False):
@@ -100,6 +102,8 @@ class Router:
         self.fallback = fn
 
     def dispatch(self, req: Request):
+        if self.before is not None:
+            self.before(req)
         for method, path, prefix, fn in self.routes:
             if method != "*" and method != req.method:
                 continue
@@ -153,21 +157,29 @@ def _make_handler(router: Router):
 
 
 class Response:
-    """Non-JSON response (bytes, custom status/headers)."""
+    """Non-JSON response (bytes, custom status/headers).
+
+    content_length overrides the advertised Content-Length — a HEAD
+    response must advertise the size a GET would return while sending no
+    body (HTTP/1.1 semantics; boto3 and rclone size objects this way)."""
 
     def __init__(self, body: bytes = b"", status: int = 200,
                  content_type: str = "application/octet-stream",
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 content_length: Optional[int] = None):
         self.body = body
         self.status = status
         self.content_type = content_type
         self.headers = headers or {}
+        self.content_length = content_length
 
     def send(self, handler: BaseHTTPRequestHandler):
+        length = self.content_length if self.content_length is not None \
+            else len(self.body)
         try:
             handler.send_response(self.status)
             handler.send_header("Content-Type", self.content_type)
-            handler.send_header("Content-Length", str(len(self.body)))
+            handler.send_header("Content-Length", str(length))
             for k, v in self.headers.items():
                 handler.send_header(k, v)
             handler.end_headers()
@@ -233,14 +245,16 @@ def post_json(url: str, obj=None, timeout: float = 30.0) -> dict:
 
 def post_multipart(url: str, filename: str, data: bytes,
                    content_type: str = "application/octet-stream",
-                   timeout: float = 60.0) -> dict:
+                   timeout: float = 60.0,
+                   headers: dict = None) -> dict:
     boundary = uuid.uuid4().hex
     body = (f"--{boundary}\r\n"
             f'Content-Disposition: form-data; name="file"; '
             f'filename="{filename or "file"}"\r\n'
             f"Content-Type: {content_type}\r\n\r\n").encode() \
         + data + f"\r\n--{boundary}--\r\n".encode()
-    out = http_call("POST", url, body,
-                    {"Content-Type":
-                     f"multipart/form-data; boundary={boundary}"}, timeout)
+    all_headers = {"Content-Type":
+                   f"multipart/form-data; boundary={boundary}"}
+    all_headers.update(headers or {})
+    out = http_call("POST", url, body, all_headers, timeout)
     return json.loads(out or b"{}")

@@ -25,12 +25,14 @@ class MasterServer:
                  volume_size_limit_mb: int = 30 * 1024,
                  default_replication: str = "000",
                  pulse_seconds: int = 5,
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 jwt_signing_key: str = ""):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        self.jwt_signing_key = jwt_signing_key
         self.vg_lock = threading.Lock()
         self.host = host
 
@@ -117,8 +119,14 @@ class MasterServer:
         if picked is None:
             raise HttpError(406, "no writable volumes")
         fid, cnt, node, _ = picked
-        return {"fid": fid, "url": node.url, "publicUrl": node.public_url,
-                "count": cnt}
+        out = {"fid": fid, "url": node.url,
+               "publicUrl": node.public_url, "count": cnt}
+        if self.jwt_signing_key:
+            # hand out a write token bound to this fid (reference
+            # master_server_handlers.go + security/jwt.go GenJwt)
+            from ..security.jwt import GenJwt
+            out["auth"] = GenJwt(self.jwt_signing_key, fid)
+        return out
 
     def _grow_volumes(self, collection: str, replication: str, ttl: TTL,
                       preferred_dc: str = "", count: int = None):

@@ -33,7 +33,8 @@ class VolumeServer:
                  data_center: str = "", rack: str = "",
                  max_volume_counts=None, pulse_seconds: int = 5,
                  public_url: str = "", read_redirect: bool = True,
-                 ec_backend: str = "auto"):
+                 ec_backend: str = "auto", jwt_signing_key: str = "",
+                 whitelist=()):
         router = Router()
         router.add("*", "/status", self.status)
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
@@ -55,6 +56,7 @@ class VolumeServer:
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
         router.add("GET", "/admin/file", self.admin_file)
         router.set_fallback(self.data_handler)
+        router.before = self._guard_check
 
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
@@ -71,6 +73,9 @@ class VolumeServer:
             public_url=public_url or f"{host}:{self.port}",
             data_center=data_center, rack=rack, codec=codec)
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        self.jwt_signing_key = jwt_signing_key
+        from ..security.guard import Guard
+        self.guard = Guard(whitelist)
         self._lookup_cache: Dict[int, tuple] = {}
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
@@ -367,6 +372,13 @@ class VolumeServer:
                     return Response(f.read(size))
         raise HttpError(404, f"{name} not found")
 
+    def _guard_check(self, req: Request):
+        """Whitelist applies to every route, admin included (reference
+        wraps all handlers in guard.WhiteList)."""
+        if self.guard.enabled and \
+                not self.guard.allows(req.handler.client_address[0]):
+            raise HttpError(403, "ip not in whitelist")
+
     # -- data path ---------------------------------------------------------
     def data_handler(self, req: Request):
         if req.path == "/":
@@ -378,10 +390,28 @@ class VolumeServer:
         if req.method in ("GET", "HEAD"):
             return self.read_needle(req, vid, key, cookie)
         if req.method in ("POST", "PUT"):
+            self._check_write_jwt(req)
             return self.write_needle(req, vid, key, cookie)
         if req.method == "DELETE":
+            self._check_write_jwt(req)
             return self.delete_needle(req, vid, key, cookie)
         raise HttpError(405, req.method)
+
+    def _check_write_jwt(self, req: Request):
+        """Per-fid write token check (reference
+        volume_server_handlers_write.go maybeCheckJwtAuthorization)."""
+        if not self.jwt_signing_key:
+            return
+        from ..security.jwt import (VerifyError, jwt_from_request,
+                                    verify_fid_jwt)
+        token = jwt_from_request(req.headers, req.query)
+        if not token:
+            raise HttpError(401, "missing write jwt")
+        fid = req.path.lstrip("/")
+        try:
+            verify_fid_jwt(self.jwt_signing_key, token, fid)
+        except VerifyError as e:
+            raise HttpError(401, f"jwt rejected: {e}") from None
 
     def write_needle(self, req: Request, vid, key, cookie):
         filename, ctype, data = req.upload_payload()
@@ -405,12 +435,17 @@ class VolumeServer:
         # request if any write is missing so the client knows the needle is
         # under-replicated
         if req.query.get("type") != "replicate":
+            from ..security.jwt import jwt_from_request
+            token = jwt_from_request(req.headers, req.query) \
+                if self.jwt_signing_key else None
+            jwt_q = f"&jwt={token}" if token else ""
             failed = []
             for node_url in self._other_replicas(vid):
                 from .http_util import post_multipart
                 try:
                     post_multipart(
-                        f"http://{node_url}{req.path}?type=replicate",
+                        f"http://{node_url}{req.path}?type=replicate"
+                        f"{jwt_q}",
                         filename, data, ctype or "application/octet-stream")
                 except HttpError as e:
                     failed.append(f"{node_url}: {e.message or e.status}")
@@ -602,10 +637,16 @@ class VolumeServer:
         except VolumeError as e:
             raise HttpError(500, str(e)) from None
         if req.query.get("type") != "replicate":
+            from ..security.jwt import jwt_from_request
+            token = jwt_from_request(req.headers, req.query) \
+                if self.jwt_signing_key else None
+            jwt_q = f"&jwt={token}" if token else ""
             for node_url in self._other_replicas(vid):
                 try:
-                    http_call("DELETE",
-                              f"http://{node_url}{req.path}?type=replicate")
+                    http_call(
+                        "DELETE",
+                        f"http://{node_url}{req.path}?type=replicate"
+                        f"{jwt_q}")
                 except HttpError:
                     pass
         return {"size": freed}

@@ -55,19 +55,23 @@ def _move_volume(env: CommandEnv, vid: int, collection: str, source: str,
     urls = [r["url"] for r in replicas]
     for url in urls:
         env.node_post(url, f"/admin/volume/readonly?volume={vid}")
+    deleted = False
     try:
         env.node_post(target, f"/admin/volume/copy?volume={vid}"
                               f"&collection={collection}&source={source}")
-    except Exception:
+        env.node_post(source, f"/admin/delete_volume?volume={vid}")
+        deleted = True
+    finally:
+        # always thaw whatever replicas still hold the volume, even when
+        # the copy or delete blew up mid-way
         for url in urls:
-            env.node_post(url, f"/admin/volume/readonly?volume={vid}"
-                               f"&readonly=false")
-        raise
-    env.node_post(source, f"/admin/delete_volume?volume={vid}")
-    for url in urls:
-        if url != source:
-            env.node_post(url, f"/admin/volume/readonly?volume={vid}"
-                               f"&readonly=false")
+            if deleted and url == source:
+                continue
+            try:
+                env.node_post(url, f"/admin/volume/readonly?volume={vid}"
+                                   f"&readonly=false")
+            except Exception:
+                pass
 
 
 @command("volume.balance", ": even out volume counts across servers")

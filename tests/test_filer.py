@@ -170,6 +170,15 @@ class TestStores:
         assert s.find_entry("/x/sub/b") is None
         assert s.find_entry("/y/c") is not None
 
+    def test_delete_folder_children_wildcard_paths(self, store_cls):
+        # "_" and "%" in path names must not act as LIKE wildcards
+        s = self.make(store_cls)
+        s.insert_entry(Entry(full_path="/a_b/keepme-not"))
+        s.insert_entry(Entry(full_path="/axb/keep"))
+        s.delete_folder_children("/a_b")
+        assert s.find_entry("/a_b/keepme-not") is None
+        assert s.find_entry("/axb/keep") is not None
+
 
 class TestFiler:
     def make(self):
