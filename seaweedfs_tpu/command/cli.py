@@ -60,7 +60,7 @@ def cmd_server(args):
                       ec_backend=args.ec_backend,
                       jwt_signing_key=args.jwtKey).start()
     print(f"master on {m.url}, volume server on {vs.url}")
-    if args.filer or args.s3:
+    if args.filer or args.s3 or args.webdav:
         from ..server.filer_server import FilerServer
         f = FilerServer(port=args.filerPort, host=args.ip,
                         master_url=m.url,
@@ -69,6 +69,11 @@ def cmd_server(args):
         if args.s3:
             s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
             print(f"s3 gateway on {s3.url}")
+        if args.webdav:
+            from ..server.webdav_server import WebDavServer
+            w = WebDavServer(f.filer, m.url, port=args.webdavPort,
+                             host=args.ip).start()
+            print(f"webdav on {w.url}")
     _wait()
 
 
@@ -97,6 +102,45 @@ def cmd_filer(args):
         s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
         print(f"s3 gateway on {s3.url}")
     _wait()
+
+
+def cmd_s3(args):
+    """Standalone S3 gateway against a remote filer
+    (reference weed/command/s3.go)."""
+    import json as _json
+    from ..filer.filer_client import FilerClient
+    from ..s3 import Iam, S3ApiServer
+    iam = Iam()
+    if args.config:
+        with open(args.config) as fh:
+            iam = Iam.from_config(_json.load(fh))
+    client = FilerClient(args.filer)
+    master = args.master or _filer_master(args.filer)
+    s3 = S3ApiServer(client, master, port=args.port, host=args.ip,
+                     iam=iam).start()
+    print(f"s3 gateway on {s3.url}, filer {args.filer}")
+    _wait()
+
+
+def cmd_webdav(args):
+    """WebDAV gateway (reference weed/command/webdav.go)."""
+    from ..filer.filer_client import FilerClient
+    from ..server.webdav_server import WebDavServer
+    client = FilerClient(args.filer)
+    master = args.master or _filer_master(args.filer)
+    w = WebDavServer(client, master, port=args.port, host=args.ip,
+                     collection=args.collection,
+                     chunk_size=args.maxMB << 20).start()
+    print(f"webdav on {w.url}, filer {args.filer}")
+    _wait()
+
+
+def _filer_master(filer_url: str) -> str:
+    """Discover the master from the filer's status endpoint."""
+    from ..server.http_util import get_json
+    url = filer_url if filer_url.startswith("http") \
+        else "http://" + filer_url
+    return get_json(f"{url}/filer/status").get("master", "")
 
 
 def cmd_shell(args):
@@ -202,6 +246,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-s3Port", type=int, default=8333)
     s.add_argument("-s3Config", default="",
                    help="IAM identities JSON (reference s3 config shape)")
+    s.add_argument("-webdav", action="store_true")
+    s.add_argument("-webdavPort", type=int, default=7333)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
     s.add_argument("-jwtKey", default="")
@@ -224,6 +270,25 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-s3Config", default="")
     f.add_argument("-jwtKey", default="")
     f.set_defaults(fn=cmd_filer)
+
+    s3 = sub.add_parser("s3", help="standalone S3 gateway over a filer")
+    s3.add_argument("-port", type=int, default=8333)
+    s3.add_argument("-ip", default="127.0.0.1")
+    s3.add_argument("-filer", default="127.0.0.1:8888")
+    s3.add_argument("-master", default="",
+                    help="master url (default: ask the filer)")
+    s3.add_argument("-config", default="",
+                    help="IAM identities JSON")
+    s3.set_defaults(fn=cmd_s3)
+
+    w = sub.add_parser("webdav", help="WebDAV gateway over a filer")
+    w.add_argument("-port", type=int, default=7333)
+    w.add_argument("-ip", default="127.0.0.1")
+    w.add_argument("-filer", default="127.0.0.1:8888")
+    w.add_argument("-master", default="")
+    w.add_argument("-collection", default="")
+    w.add_argument("-maxMB", type=int, default=8)
+    w.set_defaults(fn=cmd_webdav)
 
     sh = sub.add_parser("shell", help="admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")

@@ -135,6 +135,43 @@ class Entry:
                    extended=extended)
 
 
+def entry_to_wire(e: Entry) -> dict:
+    """Metadata-API wire shape (shared by FilerServer and FilerClient so
+    the in-process and remote gateways cannot diverge)."""
+    return {
+        "FullPath": e.full_path,
+        "Mtime": e.attr.mtime,
+        "Crtime": e.attr.crtime,
+        "Mode": e.attr.mode,
+        "Uid": e.attr.uid,
+        "Gid": e.attr.gid,
+        "Mime": e.attr.mime,
+        "Replication": e.attr.replication,
+        "Collection": e.attr.collection,
+        "TtlSec": e.attr.ttl_sec,
+        "IsDirectory": e.is_directory,
+        "Md5": e.attr.md5,
+        "chunks": [c.to_dict() for c in e.chunks],
+    }
+
+
+def entry_from_wire(d: dict) -> Entry:
+    import posixpath
+    attr = Attr(mtime=d.get("Mtime", 0.0), crtime=d.get("Crtime", 0.0),
+                mode=d.get("Mode", 0o660), uid=d.get("Uid", 0),
+                gid=d.get("Gid", 0), mime=d.get("Mime", ""),
+                replication=d.get("Replication", ""),
+                collection=d.get("Collection", ""),
+                ttl_sec=d.get("TtlSec", 0), md5=d.get("Md5", ""))
+    if d.get("IsDirectory"):
+        attr.set_directory()
+    chunks = [FileChunk.from_dict(c) for c in d.get("chunks", [])]
+    # normalize on ingest: lookups normpath their paths, so an entry
+    # created with an un-normalized path would be unreachable
+    return Entry(full_path=posixpath.normpath(d["FullPath"]),
+                 attr=attr, chunks=chunks)
+
+
 def new_dir_entry(path: str, now: Optional[float] = None) -> Entry:
     now = time.time() if now is None else now
     attr = Attr(mtime=now, crtime=now, mode=0o777)

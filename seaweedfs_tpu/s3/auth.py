@@ -158,6 +158,24 @@ def _parse_auth_header(auth: str) -> Tuple[str, str, str, List[str], str]:
     return access_key, date, region, signed, sig
 
 
+def _check_date_window(amz_date: str, window_s: int = 15 * 60):
+    """Reject requests signed outside the ±15-minute skew window
+    (AWS RequestTimeTooSkewed; the reference enforces it in
+    auth_signature_v4.go). Presigned requests expire via
+    X-Amz-Expires instead."""
+    import calendar
+    import time as _time
+    try:
+        ts = calendar.timegm(_time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    except ValueError:
+        raise S3AuthError(403, "AccessDenied",
+                          f"bad x-amz-date {amz_date!r}") from None
+    if abs(_time.time() - ts) > window_s:
+        raise S3AuthError(403, "RequestTimeTooSkewed",
+                          "request signature timestamp outside the "
+                          "allowed window")
+
+
 def verify_v4(iam: Iam, method: str, path: str,
               query_pairs: List[Tuple[str, str]], headers: Dict[str, str],
               body: bytes) -> Identity:
@@ -175,6 +193,7 @@ def verify_v4(iam: Iam, method: str, path: str,
         if actual != payload_hash:
             raise S3AuthError(403, "XAmzContentSHA256Mismatch")
     amz_date = lower.get("x-amz-date", "")
+    _check_date_window(amz_date)
     scope = f"{date}/{region}/s3/aws4_request"
     canon = canonical_request(method, path, query_pairs, lower, signed,
                               payload_hash)
@@ -278,6 +297,12 @@ def decode_aws_chunked(body: bytes, *, secret_key: str = "",
     <hex-size>;chunk-signature=<sig>\r\n<data>\r\n ... 0;chunk-signature=...
     With verify=True, each chunk signature is checked against the rolling
     chunk string-to-sign chain."""
+    if verify and scope.count("/") < 3:
+        # sigv2 / presigned auth cannot carry a chunk-signature chain —
+        # AWS requires header-based SigV4 for streaming payloads
+        raise S3AuthError(403, "AccessDenied",
+                          "streaming chunked payload requires "
+                          "header-based SigV4 authentication")
     out = bytearray()
     pos = 0
     prev_sig = seed_signature

@@ -100,6 +100,15 @@ class S3ApiServer:
         query_pairs = urllib.parse.parse_qsl(parsed.query,
                                              keep_blank_values=True)
         path = urllib.parse.unquote(parsed.path)
+        # normalize before extracting bucket/key: auth is bucket-scoped,
+        # so '..' segments must not let a key escape into another bucket
+        # (the filer normpaths server-side; match it here)
+        if path != "/":
+            trail = "/" if path.endswith("/") else ""
+            path = posixpath.normpath(path)
+            if path == "/":
+                trail = ""
+            path += trail
         body = req.body
         try:
             ident = authenticate(self.iam, req.method, parsed.path,
@@ -187,6 +196,9 @@ class S3ApiServer:
                 return self.upload_part(bucket, key, q, body)
             src = req.headers.get("x-amz-copy-source", "")
             if src:
+                src_bucket = urllib.parse.unquote(src).lstrip("/") \
+                    .partition("/")[0]
+                self._check(ident, ACTION_READ, src_bucket)
                 return self.copy_object(bucket, key, src)
             return self.put_object(req, bucket, key, body)
         if m == "POST":

@@ -40,6 +40,19 @@ class FilerServer:
         router = Router()
         router.add("GET", "/filer/events", self.events_handler)
         router.add("GET", "/filer/status", self.status_handler)
+        # metadata API — the analog of the reference's SeaweedFiler gRPC
+        # service (weed/pb/filer.proto:10-45: LookupDirectoryEntry,
+        # ListEntries, CreateEntry, UpdateEntry, DeleteEntry,
+        # AtomicRenameEntry); lets gateways (s3/webdav/mount) run in a
+        # separate process against this filer
+        router.add("GET", "/filer/meta/lookup", self.meta_lookup)
+        router.add("GET", "/filer/meta/list", self.meta_list)
+        router.add("POST", "/filer/meta/create", self.meta_create)
+        router.add("POST", "/filer/meta/update", self.meta_update)
+        router.add("POST", "/filer/meta/delete", self.meta_delete)
+        router.add("POST", "/filer/meta/rename", self.meta_rename)
+        router.add("POST", "/filer/meta/delete_chunks",
+                   self.meta_delete_chunks)
         router.set_fallback(self.data_handler)
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
@@ -187,22 +200,10 @@ class FilerServer:
 
     @staticmethod
     def _entry_json(e: Entry) -> dict:
-        return {
-            "FullPath": e.full_path,
-            "Mtime": e.attr.mtime,
-            "Crtime": e.attr.crtime,
-            "Mode": e.attr.mode,
-            "Uid": e.attr.uid,
-            "Gid": e.attr.gid,
-            "Mime": e.attr.mime,
-            "Replication": e.attr.replication,
-            "Collection": e.attr.collection,
-            "TtlSec": e.attr.ttl_sec,
-            "IsDirectory": e.is_directory,
-            "FileSize": e.size(),
-            "Md5": e.attr.md5,
-            "chunks": [c.to_dict() for c in e.chunks],
-        }
+        from ..filer.entry import entry_to_wire
+        d = entry_to_wire(e)
+        d["FileSize"] = e.size()
+        return d
 
     def write_handler(self, req: Request, path: str,
                       is_dir_path: bool = False):
@@ -244,6 +245,76 @@ class FilerServer:
         except FilerError as e:
             raise HttpError(409, str(e)) from None
         return {"from": path, "to": dest}
+
+    # -- metadata API (gateway-facing; see routes above) --------------------
+
+    @staticmethod
+    def _entry_from_json(d: dict) -> Entry:
+        from ..filer.entry import entry_from_wire
+        return entry_from_wire(d)
+
+    def meta_lookup(self, req: Request):
+        path = posixpath.normpath(req.query.get("path", "/"))
+        try:
+            return {"entry": self._entry_json(self.filer.find_entry(path))}
+        except NotFoundError:
+            raise HttpError(404, f"{path} not found") from None
+
+    def meta_list(self, req: Request):
+        path = posixpath.normpath(req.query.get("path", "/"))
+        limit = int(req.query.get("limit", 1000))
+        last = req.query.get("lastFileName", "")
+        inclusive = req.query.get("inclusive", "") == "true"
+        entries = self.filer.list_entries(path, last, inclusive, limit)
+        return {"entries": [self._entry_json(e) for e in entries]}
+
+    def meta_create(self, req: Request):
+        entry = self._entry_from_json(req.json()["entry"])
+        try:
+            self.filer.create_entry(entry)
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return {"name": entry.name}
+
+    def meta_update(self, req: Request):
+        entry = self._entry_from_json(req.json()["entry"])
+        try:
+            self.filer.update_entry(entry)
+        except NotFoundError:
+            raise HttpError(404, f"{entry.full_path} not found") from None
+        return {"name": entry.name}
+
+    def meta_delete(self, req: Request):
+        body = req.json()
+        try:
+            self.filer.delete_entry(
+                posixpath.normpath(body["path"]),
+                recursive=body.get("recursive", False),
+                ignore_recursive_error=body.get("ignoreRecursiveError",
+                                                False))
+        except NotFoundError:
+            raise HttpError(404, f"{body['path']} not found") from None
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return {}
+
+    def meta_rename(self, req: Request):
+        body = req.json()
+        try:
+            self.filer.rename_entry(posixpath.normpath(body["old"]),
+                                    posixpath.normpath(body["new"]))
+        except NotFoundError:
+            raise HttpError(404, f"{body['old']} not found") from None
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return {}
+
+    def meta_delete_chunks(self, req: Request):
+        from ..filer.entry import FileChunk
+        chunks = [FileChunk.from_dict(c)
+                  for c in req.json().get("chunks", [])]
+        self.filer.queue_chunk_deletion(chunks)
+        return {}
 
     def delete_handler(self, req: Request, path: str):
         recursive = req.query.get("recursive", "") == "true"

@@ -152,6 +152,10 @@ def _make_handler(router: Router):
                 pass
 
         do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _run
+        # WebDAV verbs (reference weed/server/webdav_server.go uses
+        # golang.org/x/net/webdav which handles the same set)
+        do_OPTIONS = do_PROPFIND = do_PROPPATCH = do_MKCOL = _run
+        do_MOVE = do_COPY = do_LOCK = do_UNLOCK = _run
 
     return Handler
 

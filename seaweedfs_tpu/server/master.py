@@ -269,9 +269,12 @@ class MasterServer:
         """Convenience upload: assign + forward (reference /submit)."""
         filename, ctype, data = req.upload_payload()
         assign = self.dir_assign(req)
+        headers = {}
+        if assign.get("auth"):
+            headers["Authorization"] = f"Bearer {assign['auth']}"
         result = post_multipart(
             f"http://{assign['url']}/{assign['fid']}", filename, data,
-            ctype or "application/octet-stream")
+            ctype or "application/octet-stream", headers=headers)
         return {"fid": assign["fid"], "fileUrl":
                 f"{assign['publicUrl']}/{assign['fid']}",
                 "size": result.get("size", len(data))}

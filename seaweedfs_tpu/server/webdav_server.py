@@ -1,0 +1,310 @@
+"""WebDAV gateway over the filer.
+
+Reference weed/server/webdav_server.go + weed/command/webdav.go (the
+reference adapts golang.org/x/net/webdav's FileSystem interface onto
+filer gRPC; here the DAV protocol is handled directly: OPTIONS,
+PROPFIND depth 0/1, GET/HEAD with ranges, PUT, MKCOL, DELETE, MOVE,
+COPY, and class-2 LOCK/UNLOCK stubs so macOS/Windows clients mount
+read-write).
+
+Works over an in-process `Filer` or a remote `FilerClient`.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from ..filer import Attr, Entry
+from ..filer.entry import new_dir_entry
+from ..filer.filer import FilerError, NotFoundError
+from ..filer.stream import read_chunked
+from ..filer.upload import split_and_upload
+from .http_util import (HttpError, HttpServer, Request, Response, Router)
+
+DAV_NS = "DAV:"
+
+
+def _rfc1123(ts: float) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+def _iso8601(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+class WebDavServer:
+    def __init__(self, filer, master_url: str,
+                 port: int = 7333, host: str = "127.0.0.1",
+                 chunk_size: int = 8 << 20,
+                 collection: str = "", replication: str = "",
+                 fetcher=None):
+        self.filer = filer
+        self.master_url = master_url
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        self._fetch = fetcher
+        router = Router()
+        router.set_fallback(self.dispatch)
+        self.server = HttpServer(port, router, host)
+        self.port = self.server.port
+        self.host = host
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def stop(self):
+        self.server.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- dispatch -----------------------------------------------------------
+
+    def dispatch(self, req: Request):
+        path = urllib.parse.unquote(req.path)
+        if path != "/":
+            path = posixpath.normpath(path)
+        method = req.method
+        if method == "OPTIONS":
+            return Response(b"", 200, "text/plain", {
+                "DAV": "1, 2",
+                "MS-Author-Via": "DAV",
+                "Allow": ("OPTIONS, GET, HEAD, PUT, DELETE, PROPFIND, "
+                          "PROPPATCH, MKCOL, MOVE, COPY, LOCK, UNLOCK")})
+        if method == "PROPFIND":
+            return self.propfind(req, path)
+        if method in ("GET", "HEAD"):
+            return self.get(req, path)
+        if method == "PUT":
+            return self.put(req, path)
+        if method == "MKCOL":
+            return self.mkcol(req, path)
+        if method == "DELETE":
+            return self.delete(req, path)
+        if method in ("MOVE", "COPY"):
+            return self.move_copy(req, path, copy=(method == "COPY"))
+        if method == "PROPPATCH":
+            return self._multistatus([self._prop_response(
+                path, None, ok_props_only=True)])
+        if method == "LOCK":
+            return self.lock(req, path)
+        if method == "UNLOCK":
+            return Response(b"", 204)
+        raise HttpError(405, method)
+
+    # -- handlers -----------------------------------------------------------
+
+    def propfind(self, req: Request, path: str):
+        depth = req.headers.get("Depth", "1")
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            raise HttpError(404, path) from None
+        responses = [self._prop_response(path, entry)]
+        if depth != "0" and entry.is_directory:
+            for child in self.filer.list_entries(path, limit=10000):
+                responses.append(
+                    self._prop_response(child.full_path, child))
+        return self._multistatus(responses)
+
+    def get(self, req: Request, path: str):
+        try:
+            entry = self.filer.find_entry(path)
+        except NotFoundError:
+            raise HttpError(404, path) from None
+        if entry.is_directory:
+            names = [e.name + ("/" if e.is_directory else "")
+                     for e in self.filer.list_entries(path, limit=10000)]
+            body = ("\n".join(names) + "\n").encode()
+            return Response(body, 200, "text/plain")
+        size = entry.size()
+        offset, length, status = 0, size, 200
+        headers = {"Accept-Ranges": "bytes",
+                   "Last-Modified": _rfc1123(entry.attr.mtime)}
+        rng = req.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            spec = rng[6:].split(",")[0]
+            s, _, e = spec.partition("-")
+            try:
+                if s == "":
+                    offset = max(size - int(e), 0)
+                    length = size - offset
+                else:
+                    offset = int(s)
+                    end = min(int(e), size - 1) if e else size - 1
+                    length = end - offset + 1
+            except ValueError:
+                raise HttpError(416, rng) from None
+            if length < 0 or (offset >= size and size > 0):
+                raise HttpError(416, rng)
+            headers["Content-Range"] = \
+                f"bytes {offset}-{offset + length - 1}/{size}"
+            status = 206
+        head = req.method == "HEAD"
+        body = b"" if head else read_chunked(
+            entry.chunks, offset, length, self._chunk_fetcher())
+        return Response(body, status,
+                        entry.attr.mime or "application/octet-stream",
+                        headers, content_length=length if head else None)
+
+    def put(self, req: Request, path: str):
+        data = req.body
+        existed = self.filer.exists(path)
+        chunks, md5_hex = split_and_upload(
+            self.master_url, data, posixpath.basename(path),
+            self.chunk_size, collection=self.collection,
+            replication=self.replication,
+            content_type=req.headers.get("Content-Type",
+                                         "application/octet-stream"))
+        now = time.time()
+        attr = Attr(mtime=now, crtime=now,
+                    mime=req.headers.get("Content-Type", ""),
+                    collection=self.collection,
+                    replication=self.replication, md5=md5_hex)
+        self.filer.create_entry(Entry(full_path=path, attr=attr,
+                                      chunks=chunks))
+        return Response(b"", 201 if not existed else 204)
+
+    def mkcol(self, req: Request, path: str):
+        if self.filer.exists(path):
+            raise HttpError(405, f"{path} exists")
+        self.filer.create_entry(new_dir_entry(path))
+        return Response(b"", 201)
+
+    def delete(self, req: Request, path: str):
+        try:
+            self.filer.delete_entry(path, recursive=True,
+                                    ignore_recursive_error=True)
+        except NotFoundError:
+            raise HttpError(404, path) from None
+        return Response(b"", 204)
+
+    def move_copy(self, req: Request, path: str, copy: bool):
+        dest_header = req.headers.get("Destination", "")
+        if not dest_header:
+            raise HttpError(400, "missing Destination header")
+        dest = urllib.parse.unquote(urllib.parse.urlparse(
+            dest_header).path)
+        dest = posixpath.normpath(dest)
+        overwrite = req.headers.get("Overwrite", "T").upper() != "F"
+        dest_existed = self.filer.exists(dest)
+        if dest_existed and not overwrite:
+            raise HttpError(412, f"{dest} exists")
+        try:
+            if copy:
+                self._copy_tree(path, dest)
+            else:
+                if dest_existed:
+                    self.filer.delete_entry(dest, recursive=True,
+                                            ignore_recursive_error=True)
+                self.filer.rename_entry(path, dest)
+        except NotFoundError:
+            raise HttpError(404, path) from None
+        except FilerError as e:
+            raise HttpError(409, str(e)) from None
+        return Response(b"", 204 if dest_existed else 201)
+
+    def lock(self, req: Request, path: str):
+        token = f"opaquelocktoken:{uuid.uuid4()}"
+        ns = "{%s}" % DAV_NS
+        root = ET.Element(ns + "prop")
+        disc = ET.SubElement(root, ns + "lockdiscovery")
+        active = ET.SubElement(disc, ns + "activelock")
+        ET.SubElement(ET.SubElement(active, ns + "locktype"),
+                      ns + "write")
+        ET.SubElement(ET.SubElement(active, ns + "lockscope"),
+                      ns + "exclusive")
+        ET.SubElement(active, ns + "depth").text = "infinity"
+        ET.SubElement(active, ns + "timeout").text = "Second-3600"
+        ET.SubElement(ET.SubElement(active, ns + "locktoken"),
+                      ns + "href").text = token
+        body = b'<?xml version="1.0" encoding="utf-8"?>' + \
+            ET.tostring(root)
+        return Response(body, 200, "application/xml",
+                        {"Lock-Token": f"<{token}>"})
+
+    # -- helpers ------------------------------------------------------------
+
+    def _copy_tree(self, src: str, dest: str):
+        """COPY re-uploads file bytes (chunks are owned by exactly one
+        entry — sharing them would double-free on delete; the reference
+        webdav does a read/write copy too)."""
+        entry = self.filer.find_entry(src)
+        if entry.is_directory:
+            if not self.filer.exists(dest):
+                self.filer.create_entry(new_dir_entry(dest))
+            for child in self.filer.list_entries(src, limit=10000):
+                self._copy_tree(child.full_path,
+                                posixpath.join(dest, child.name))
+            return
+        data = read_chunked(entry.chunks, 0, entry.size(),
+                            self._chunk_fetcher())
+        chunks, md5_hex = split_and_upload(
+            self.master_url, data, posixpath.basename(dest),
+            self.chunk_size, collection=self.collection,
+            replication=self.replication,
+            content_type=entry.attr.mime or "application/octet-stream")
+        now = time.time()
+        attr = Attr(mtime=now, crtime=now, mime=entry.attr.mime,
+                    collection=self.collection,
+                    replication=self.replication, md5=md5_hex)
+        if self.filer.exists(dest):
+            self.filer.delete_entry(dest)
+        self.filer.create_entry(Entry(full_path=dest, attr=attr,
+                                      chunks=chunks))
+
+    def _chunk_fetcher(self):
+        if self._fetch is None:
+            from ..filer.stream import default_fetcher
+            self._fetch = default_fetcher(self.master_url)
+        return self._fetch
+
+    def _prop_response(self, path: str, entry: Optional[Entry],
+                       ok_props_only: bool = False) -> ET.Element:
+        ns = "{%s}" % DAV_NS
+        resp = ET.Element(ns + "response")
+        href = urllib.parse.quote(path)
+        if entry is not None and entry.is_directory and path != "/":
+            href += "/"
+        ET.SubElement(resp, ns + "href").text = href
+        propstat = ET.SubElement(resp, ns + "propstat")
+        prop = ET.SubElement(propstat, ns + "prop")
+        if entry is not None:
+            ET.SubElement(prop, ns + "displayname").text = \
+                entry.name or "/"
+            rt = ET.SubElement(prop, ns + "resourcetype")
+            if entry.is_directory:
+                ET.SubElement(rt, ns + "collection")
+            else:
+                ET.SubElement(prop, ns + "getcontentlength").text = \
+                    str(entry.size())
+                ET.SubElement(prop, ns + "getcontenttype").text = \
+                    entry.attr.mime or "application/octet-stream"
+                if entry.attr.md5:
+                    ET.SubElement(prop, ns + "getetag").text = \
+                        f'"{entry.attr.md5}"'
+            ET.SubElement(prop, ns + "getlastmodified").text = \
+                _rfc1123(entry.attr.mtime)
+            ET.SubElement(prop, ns + "creationdate").text = \
+                _iso8601(entry.attr.crtime)
+        ET.SubElement(propstat, ns + "status").text = \
+            "HTTP/1.1 200 OK"
+        return resp
+
+    def _multistatus(self, responses) -> Response:
+        ns = "{%s}" % DAV_NS
+        ET.register_namespace("D", DAV_NS)
+        root = ET.Element(ns + "multistatus")
+        for r in responses:
+            root.append(r)
+        body = b'<?xml version="1.0" encoding="utf-8"?>' + \
+            ET.tostring(root)
+        return Response(body, 207, 'application/xml; charset="utf-8"')

@@ -347,3 +347,91 @@ def test_presigned_get(stack):
     url = presign_url_v4("GET", f"http://{s3.url}/pg/o.txt", AK, SK)
     with urllib.request.urlopen(url, timeout=10) as r:
         assert r.read() == b"presigned!"
+
+
+def test_standalone_gateway_over_filer_client(stack):
+    """`weed s3 -filer=...` mode: the gateway runs in its own process and
+    reaches the filer through the metadata API (FilerClient)."""
+    master, _, filer, _, _ = stack
+    from seaweedfs_tpu.filer.filer_client import FilerClient
+    s3b = S3ApiServer(FilerClient(filer.url), master.url, port=0,
+                      iam=make_iam(), chunk_size=1024).start()
+    try:
+        client = S3Client(s3b.url)
+        assert client.call("PUT", "/remote-b")[0] == 200
+        data = b"standalone gateway" * 100
+        assert client.call("PUT", "/remote-b/k.bin", data)[0] == 200
+        status, body, _ = client.call("GET", "/remote-b/k.bin")
+        assert status == 200 and body == data
+        status, body, _ = client.call("GET", "/remote-b?list-type=2")
+        assert status == 200 and b"k.bin" in body
+    finally:
+        s3b.stop()
+
+
+def test_key_traversal_cannot_escape_bucket(stack):
+    """'..' segments in a key must not reach another bucket
+    (bucket-scoped auth is checked on the extracted bucket name)."""
+    *_, s3, admin = stack
+    admin.call("PUT", "/priv")
+    admin.call("PUT", "/priv/secret.txt", b"classified")
+    admin.call("PUT", "/pub")
+    scoped = S3Client(s3.url)
+    scoped.ak, scoped.sk = AK, SK
+    # identity in the fixture is admin on everything, so instead verify
+    # routing: a traversal key resolves to the *other* bucket and is
+    # auth-checked as that bucket (here: allowed, but returns the same
+    # object as the direct path — no phantom path under /pub)
+    status, body, _ = admin.call("GET", "/pub/%2e%2e/priv/secret.txt")
+    st2, body2, _ = admin.call("GET", "/priv/secret.txt")
+    assert (status, body) == (st2, body2)
+    # and with a read-only-on-pub identity the traversal is denied
+    iam = Iam([Identity("ro", "AK2", "SK2", ["Read:pub", "List:pub"])])
+    s3.iam, old = iam, s3.iam
+    try:
+        ro = S3Client(s3.url, ak="AK2", sk="SK2")
+        status, body, _ = ro.call("GET", "/pub/%2e%2e/priv/secret.txt")
+        assert status == 403 and b"classified" not in body
+    finally:
+        s3.iam = old
+
+
+def test_copy_requires_source_read(stack):
+    *_, s3, admin = stack
+    admin.call("PUT", "/srcb")
+    admin.call("PUT", "/srcb/data.txt", b"source bytes")
+    admin.call("PUT", "/dstb")
+    iam = Iam([Identity("w", "AK3", "SK3",
+                        ["Read:dstb", "Write:dstb", "List:dstb"])])
+    s3.iam, old = iam, s3.iam
+    try:
+        w = S3Client(s3.url, ak="AK3", sk="SK3")
+        status, body, _ = w.call(
+            "PUT", "/dstb/stolen.txt",
+            headers={"x-amz-copy-source": "/srcb/data.txt"})
+        assert status == 403
+    finally:
+        s3.iam = old
+    # with read on the source it succeeds
+    status, _, _ = admin.call(
+        "PUT", "/dstb/ok.txt",
+        headers={"x-amz-copy-source": "/srcb/data.txt"})
+    assert status == 200
+    assert admin.call("GET", "/dstb/ok.txt")[1] == b"source bytes"
+
+
+def test_stale_signature_rejected(stack):
+    *_, s3, _ = stack
+    import time as _t
+    url = f"http://{s3.url}/"
+    headers = sign_request_v4("GET", url, {}, b"", AK, SK,
+                              amz_time=_t.time() - 3600)
+    req = urllib.request.Request(url, method="GET", headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            status, body = r.status, r.read()
+    except urllib.error.HTTPError as e:
+        status, body = e.code, e.read()
+    assert status == 403
+    assert (b"RequestTimeTooSkewed" in body
+            or b"SignatureDoesNotMatch" in body)
