@@ -96,7 +96,9 @@ def cmd_filer(args):
                     collection=args.collection,
                     replication=args.defaultReplicaPlacement,
                     chunk_size=args.maxMB << 20,
-                    jwt_signing_key=args.jwtKey).start()
+                    jwt_signing_key=args.jwtKey,
+                    cipher=args.encryptVolumeData,
+                    compress=args.compress).start()
     print(f"filer listening on {f.url}, master {args.master}")
     if args.s3:
         s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
@@ -269,6 +271,12 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-s3Port", type=int, default=8333)
     f.add_argument("-s3Config", default="")
     f.add_argument("-jwtKey", default="")
+    f.add_argument("-encryptVolumeData", action="store_true",
+                   help="AES-256-GCM encrypt chunk data; volume servers "
+                        "only see ciphertext (reference filer.toml "
+                        "cipher)")
+    f.add_argument("-compress", action="store_true",
+                   help="gzip compressible chunks before storing")
     f.set_defaults(fn=cmd_filer)
 
     s3 = sub.add_parser("s3", help="standalone S3 gateway over a filer")

@@ -151,7 +151,10 @@ def entry_to_wire(e: Entry) -> dict:
         "TtlSec": e.attr.ttl_sec,
         "IsDirectory": e.is_directory,
         "Md5": e.attr.md5,
+        "UserName": e.attr.user_name,
+        "SymlinkTarget": e.attr.symlink_target,
         "chunks": [c.to_dict() for c in e.chunks],
+        "extended": {k: v.hex() for k, v in (e.extended or {}).items()},
     }
 
 
@@ -162,14 +165,18 @@ def entry_from_wire(d: dict) -> Entry:
                 gid=d.get("Gid", 0), mime=d.get("Mime", ""),
                 replication=d.get("Replication", ""),
                 collection=d.get("Collection", ""),
-                ttl_sec=d.get("TtlSec", 0), md5=d.get("Md5", ""))
+                ttl_sec=d.get("TtlSec", 0), md5=d.get("Md5", ""),
+                user_name=d.get("UserName", ""),
+                symlink_target=d.get("SymlinkTarget", ""))
     if d.get("IsDirectory"):
         attr.set_directory()
     chunks = [FileChunk.from_dict(c) for c in d.get("chunks", [])]
+    extended = {k: bytes.fromhex(v)
+                for k, v in d.get("extended", {}).items()}
     # normalize on ingest: lookups normpath their paths, so an entry
     # created with an un-normalized path would be unreachable
     return Entry(full_path=posixpath.normpath(d["FullPath"]),
-                 attr=attr, chunks=chunks)
+                 attr=attr, chunks=chunks, extended=extended)
 
 
 def new_dir_entry(path: str, now: Optional[float] = None) -> Entry:

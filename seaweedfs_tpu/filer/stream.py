@@ -21,12 +21,14 @@ def default_fetcher(master_url: str):
 
     def fetch(fid: str, offset: int, size: int) -> bytes:
         vid, _, _ = parse_file_id(fid)
+        headers = {}
+        if size >= 0:
+            headers["Range"] = f"bytes={offset}-{offset + size - 1}"
         last: Optional[Exception] = None
         for url in cache.lookup(vid):
             try:
-                return http_call(
-                    "GET", f"http://{url}/{fid}",
-                    headers={"Range": f"bytes={offset}-{offset+size-1}"})
+                return http_call("GET", f"http://{url}/{fid}",
+                                 headers=headers)
             except HttpError as e:
                 last = e
                 cache.invalidate(vid)
@@ -45,7 +47,20 @@ def read_chunked(chunks: List[FileChunk], offset: int, size: int,
         size = max(total_size(chunks) - offset, 0)
     out = bytearray(size)
     for v in views:
-        data = fetch(v.fid, v.offset, v.size)
+        if v.cipher_key or v.is_compressed:
+            # encrypted/gzipped blobs can't be range-read on the volume
+            # server: fetch whole, transform, then slice the view window
+            # (reference stream.go fetchChunk + DecryptData/UnGzipData)
+            blob = fetch(v.fid, 0, -1)
+            if v.cipher_key:
+                from ..util import decrypt
+                blob = decrypt(blob, v.cipher_key)
+            if v.is_compressed:
+                from ..util import gunzip_data
+                blob = gunzip_data(blob)
+            data = blob[v.offset:v.offset + v.size]
+        else:
+            data = fetch(v.fid, v.offset, v.size)
         start = v.logical_offset - offset
         out[start:start + len(data)] = data
     return bytes(out)

@@ -2,7 +2,12 @@
 
 The write half of the reference's autoChunk
 (filer_server_handlers_write_autochunk.go) — used by both the filer HTTP
-server and the S3 gateway.
+server and the S3 gateway. With cipher=True each chunk is AES-256-GCM
+encrypted under a fresh key before it leaves the filer (reference
+filer_server_handlers_write_cipher.go); with compress=True text-ish
+content is gzipped first (reference autoChunk's IsGzippable path).
+Chunk `size` is always the logical plaintext size — the stored blob may
+be smaller (gzip) or larger (nonce+tag).
 """
 
 from __future__ import annotations
@@ -12,6 +17,7 @@ import time
 from typing import List, Tuple
 
 from ..client import operation
+from ..util import encrypt, gzip_data, is_compressible
 from .entry import FileChunk
 
 
@@ -19,21 +25,31 @@ def split_and_upload(master_url: str, data: bytes, filename: str,
                      chunk_size: int, collection: str = "",
                      replication: str = "", ttl: str = "",
                      content_type: str = "application/octet-stream",
+                     cipher: bool = False, compress: bool = False,
                      ) -> Tuple[List[FileChunk], str]:
     """Upload `data` as one or more chunks; returns (chunks, md5hex)."""
     now_ns = time.time_ns()
     chunks: List[FileChunk] = []
     md5 = hashlib.md5()
+    want_gzip = compress and is_compressible(filename, content_type)
     for i in range(0, max(len(data), 1), chunk_size):
         piece = data[i:i + chunk_size]
         if not piece and i > 0:
             break
         md5.update(piece)
+        blob, is_gzipped, key = piece, False, b""
+        if want_gzip and len(piece) > 128:
+            gz = gzip_data(piece)
+            if len(gz) < len(piece):
+                blob, is_gzipped = gz, True
+        if cipher:
+            blob, key = encrypt(blob)
         a = operation.assign(master_url, collection=collection,
                              replication=replication, ttl=ttl)
-        up = operation.upload(a["url"], a["fid"], piece, filename=filename,
+        up = operation.upload(a["url"], a["fid"], blob, filename=filename,
                               content_type=content_type, ttl=ttl,
                               jwt=a.get("auth", ""))
         chunks.append(FileChunk(fid=a["fid"], offset=i, size=len(piece),
-                                mtime=now_ns + i, etag=up.get("eTag", "")))
+                                mtime=now_ns + i, etag=up.get("eTag", ""),
+                                cipher_key=key, is_compressed=is_gzipped))
     return chunks, md5.hexdigest()

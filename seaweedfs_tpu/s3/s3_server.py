@@ -196,8 +196,11 @@ class S3ApiServer:
                 return self.upload_part(bucket, key, q, body)
             src = req.headers.get("x-amz-copy-source", "")
             if src:
-                src_bucket = urllib.parse.unquote(src).lstrip("/") \
-                    .partition("/")[0]
+                # normalize before extracting the source bucket so '..'
+                # segments can't smuggle a read from another bucket
+                src = posixpath.normpath(
+                    "/" + urllib.parse.unquote(src).lstrip("/"))
+                src_bucket = src.lstrip("/").partition("/")[0]
                 self._check(ident, ACTION_READ, src_bucket)
                 return self.copy_object(bucket, key, src)
             return self.put_object(req, bucket, key, body)
@@ -284,21 +287,14 @@ class S3ApiServer:
         headers = {"ETag": f'"{entry.attr.md5}"',
                    "Last-Modified": _http_date(entry.attr.mtime),
                    "Accept-Ranges": "bytes"}
+        from ..server.http_util import parse_range
         rng = req.headers.get("Range", "")
-        if rng.startswith("bytes="):
-            s, _, e = rng[6:].split(",")[0].partition("-")
-            try:
-                if s == "":
-                    offset = max(size - int(e), 0)
-                    length = size - offset
-                else:
-                    offset = int(s)
-                    end = min(int(e), size - 1) if e else size - 1
-                    length = end - offset + 1
-            except ValueError:
-                return _err(416, "InvalidRange", rng)
-            if length < 0 or (offset >= size and size > 0):
-                return _err(416, "InvalidRange", rng)
+        try:
+            parsed = parse_range(rng, size)
+        except HttpError:
+            return _err(416, "InvalidRange", rng)
+        if parsed is not None:
+            offset, length = parsed
             headers["Content-Range"] = \
                 f"bytes {offset}-{offset+length-1}/{size}"
             status = 206
@@ -319,8 +315,8 @@ class S3ApiServer:
         return Response(b"", 204)
 
     def copy_object(self, bucket: str, key: str, src: str):
-        src = urllib.parse.unquote(src).lstrip("/")
-        src_bucket, _, src_key = src.partition("/")
+        # src arrives unquoted + normalized from dispatch
+        src_bucket, _, src_key = src.lstrip("/").partition("/")
         entry = self.filer.find_entry(self._object_path(src_bucket,
                                                         src_key))
         data = read_chunked(entry.chunks, 0, entry.size(),

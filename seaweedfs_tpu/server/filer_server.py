@@ -36,7 +36,8 @@ class FilerServer:
                  store: str = "memory", store_options: Optional[dict] = None,
                  collection: str = "", replication: str = "",
                  chunk_size: int = CHUNK_SIZE_DEFAULT,
-                 notify_publisher=None, jwt_signing_key: str = ""):
+                 notify_publisher=None, jwt_signing_key: str = "",
+                 cipher: bool = False, compress: bool = False):
         router = Router()
         router.add("GET", "/filer/events", self.events_handler)
         router.add("GET", "/filer/status", self.status_handler)
@@ -61,6 +62,8 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        self.cipher = cipher
+        self.compress = compress
         self.jwt_signing_key = jwt_signing_key
         self.filer = Filer(make_store(store, **(store_options or {})))
         self.log_buffer = LogBuffer()
@@ -151,22 +154,10 @@ class FilerServer:
         size = entry.size()
         offset, length, status = 0, size, 200
         headers = {"Accept-Ranges": "bytes"}
-        rng = req.headers.get("Range", "")
-        if rng.startswith("bytes="):
-            spec = rng[6:].split(",")[0]
-            s, _, e = spec.partition("-")
-            try:
-                if s == "":
-                    offset = max(size - int(e), 0)
-                    length = size - offset
-                else:
-                    offset = int(s)
-                    end = min(int(e), size - 1) if e else size - 1
-                    length = end - offset + 1
-            except ValueError:
-                raise HttpError(416, f"bad range {rng}") from None
-            if length < 0 or (offset >= size and size > 0):
-                raise HttpError(416, f"unsatisfiable range {rng}")
+        from .http_util import parse_range
+        parsed = parse_range(req.headers.get("Range", ""), size)
+        if parsed is not None:
+            offset, length = parsed
             headers["Content-Range"] = \
                 f"bytes {offset}-{offset+length-1}/{size}"
             status = 206
@@ -223,7 +214,8 @@ class FilerServer:
             self.master_url, data, posixpath.basename(path),
             self.chunk_size, collection=collection,
             replication=replication, ttl=ttl,
-            content_type=ctype or "application/octet-stream")
+            content_type=ctype or "application/octet-stream",
+            cipher=self.cipher, compress=self.compress)
         now = time.time()
         attr = Attr(mtime=now, crtime=now, mime=ctype,
                     collection=collection, replication=replication,

@@ -62,8 +62,14 @@ class Request:
         boundary = m.group(1).encode()
         parts = self.body.split(b"--" + boundary)
         for part in parts:
-            part = part.strip(b"\r\n")
-            if not part or part == b"--":
+            # each inner part is b"\r\n<headers>\r\n\r\n<data>\r\n";
+            # strip exactly one CRLF per side — data may itself begin or
+            # end with newline bytes that must survive
+            if part.startswith(b"\r\n"):
+                part = part[2:]
+            if part.endswith(b"\r\n"):
+                part = part[:-2]
+            if not part or part in (b"--", b"--\r\n"):
                 continue
             if b"\r\n\r\n" not in part:
                 continue
@@ -218,6 +224,31 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def parse_range(rng: str, size: int) -> Optional[Tuple[int, int]]:
+    """Parse a `bytes=a-b` Range header against a `size`-byte resource.
+
+    Returns (offset, length), or None when the header is absent or not
+    a bytes range. Raises HttpError(416) for malformed or unsatisfiable
+    ranges. Only the first range of a multi-range spec is honored."""
+    if not rng or not rng.startswith("bytes="):
+        return None
+    spec = rng[6:].split(",")[0]
+    s, _, e = spec.partition("-")
+    try:
+        if s == "":
+            offset = max(size - int(e), 0)
+            length = size - offset
+        else:
+            offset = int(s)
+            end = min(int(e), size - 1) if e else size - 1
+            length = end - offset + 1
+    except ValueError:
+        raise HttpError(416, f"bad range {rng}") from None
+    if length < 0 or (offset >= size and size > 0):
+        raise HttpError(416, f"unsatisfiable range {rng}")
+    return offset, length
 
 
 # -- client helpers ---------------------------------------------------------

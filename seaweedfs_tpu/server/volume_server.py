@@ -503,24 +503,16 @@ class VolumeServer:
         body = got.data
         # single-range requests (reference volume_server_handlers_read.go
         # processRangeRequest): the filer fetches chunk slices this way
+        from .http_util import parse_range
         rng = req.headers.get("Range") if req is not None else None
-        if rng and rng.startswith("bytes="):
-            spec = rng[len("bytes="):].split(",")[0]
-            start_s, _, end_s = spec.partition("-")
-            total = len(body)
-            try:
-                if start_s == "":  # suffix range: last N bytes
-                    start = max(total - int(end_s), 0)
-                    end = total - 1
-                else:
-                    start = int(start_s)
-                    end = min(int(end_s), total - 1) if end_s else total - 1
-            except ValueError:
-                raise HttpError(416, f"bad range {rng}") from None
-            if start > end or start >= total:
-                raise HttpError(416, f"unsatisfiable range {rng}")
-            headers["Content-Range"] = f"bytes {start}-{end}/{total}"
-            return Response(body[start:end + 1], 206, ctype, headers)
+        total = len(body)
+        parsed = parse_range(rng or "", total)
+        if parsed is not None:
+            start, length = parsed
+            headers["Content-Range"] = \
+                f"bytes {start}-{start + length - 1}/{total}"
+            return Response(body[start:start + length], 206, ctype,
+                            headers)
         return Response(body, 200, ctype, headers)
 
     # -- EC degraded read (reference store_ec.go:119-373) ------------------

@@ -129,22 +129,10 @@ class WebDavServer:
         offset, length, status = 0, size, 200
         headers = {"Accept-Ranges": "bytes",
                    "Last-Modified": _rfc1123(entry.attr.mtime)}
-        rng = req.headers.get("Range", "")
-        if rng.startswith("bytes="):
-            spec = rng[6:].split(",")[0]
-            s, _, e = spec.partition("-")
-            try:
-                if s == "":
-                    offset = max(size - int(e), 0)
-                    length = size - offset
-                else:
-                    offset = int(s)
-                    end = min(int(e), size - 1) if e else size - 1
-                    length = end - offset + 1
-            except ValueError:
-                raise HttpError(416, rng) from None
-            if length < 0 or (offset >= size and size > 0):
-                raise HttpError(416, rng)
+        from .http_util import parse_range
+        parsed = parse_range(req.headers.get("Range", ""), size)
+        if parsed is not None:
+            offset, length = parsed
             headers["Content-Range"] = \
                 f"bytes {offset}-{offset + length - 1}/{size}"
             status = 206
@@ -195,6 +183,12 @@ class WebDavServer:
             dest_header).path)
         dest = posixpath.normpath(dest)
         overwrite = req.headers.get("Overwrite", "T").upper() != "F"
+        try:
+            self.filer.find_entry(path)  # 404 before touching the dest
+        except NotFoundError:
+            raise HttpError(404, path) from None
+        if dest == path or dest.startswith(path + "/"):
+            raise HttpError(409, "destination inside source")
         dest_existed = self.filer.exists(dest)
         if dest_existed and not overwrite:
             raise HttpError(412, f"{dest} exists")

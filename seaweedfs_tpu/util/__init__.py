@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (reference weed/util/)."""
+
+from .cipher import CipherError, decrypt, encrypt, gen_key  # noqa: F401
+from .compression import (gunzip_data, gzip_data,  # noqa: F401
+                          is_compressible)

@@ -1,0 +1,52 @@
+"""AES-256-GCM chunk encryption.
+
+Reference weed/util/cipher.go (Encrypt/Decrypt: AES-GCM with a random
+per-chunk 256-bit key, random nonce prepended to the ciphertext) —
+used by the filer write path so volume servers only ever see
+ciphertext; the per-chunk key lives in filer metadata
+(FileChunk.cipher_key, reference filer.proto FileChunk.cipher_key).
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+class CipherError(Exception):
+    pass
+
+
+def gen_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(plain: bytes, key: bytes = None) -> tuple:
+    """Returns (nonce || ciphertext || tag, key). A fresh random key is
+    generated when none is given (one key per chunk, like the
+    reference)."""
+    if key is None:
+        key = gen_key()
+    if len(key) != KEY_SIZE:
+        raise CipherError(f"key must be {KEY_SIZE} bytes, got {len(key)}")
+    nonce = os.urandom(NONCE_SIZE)
+    sealed = AESGCM(key).encrypt(nonce, plain, None)
+    return nonce + sealed, key
+
+
+def decrypt(blob: bytes, key: bytes) -> bytes:
+    if len(key) != KEY_SIZE:
+        raise CipherError(f"key must be {KEY_SIZE} bytes, got {len(key)}")
+    if len(blob) < NONCE_SIZE + 16:
+        raise CipherError("ciphertext too short")
+    nonce, sealed = blob[:NONCE_SIZE], blob[NONCE_SIZE:]
+    try:
+        return AESGCM(key).decrypt(nonce, sealed, None)
+    except InvalidTag:
+        raise CipherError("decryption failed (wrong key or corrupt "
+                          "ciphertext)") from None

@@ -166,3 +166,15 @@ def test_sqlite_store_persistence(cluster, tmp_path):
                      store_options={"path": db}).start()
     assert http_call("GET", f"http://{f2.url}/persist.txt") == b"keep"
     f2.stop()
+
+
+def test_multipart_preserves_trailing_newlines(cluster):
+    """Regression: the multipart parser must strip exactly one CRLF per
+    boundary side — payloads ending in newline bytes arrive intact."""
+    _, _, filer = cluster
+    data = b"line one\nline two\n\r\n"
+    post_multipart(furl(filer, "/nl.txt"), "nl.txt", data, "text/plain")
+    assert http_call("GET", furl(filer, "/nl.txt")) == data
+    data2 = b"\r\nstarts and ends with crlf\r\n"
+    post_multipart(furl(filer, "/nl2.bin"), "nl2.bin", data2)
+    assert http_call("GET", furl(filer, "/nl2.bin")) == data2

@@ -173,3 +173,49 @@ def test_webdav_over_remote_filer_client(stack):
         assert status == 207 and b"f.bin" in body
     finally:
         dav2.stop()
+
+
+def test_move_missing_source_keeps_destination(stack):
+    """Regression: MOVE of a nonexistent source must not delete the
+    existing destination first."""
+    _, _, _, dav = stack
+    dav_call(dav, "PUT", "/mv/keep.txt", b"precious")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "MOVE", "/mv/ghost.txt",
+                 headers={"Destination": f"{dav.url}/mv/keep.txt"})
+    assert ei.value.code == 404
+    assert dav_call(dav, "GET", "/mv/keep.txt")[2] == b"precious"
+
+
+def test_copy_into_own_subtree_rejected(stack):
+    """Regression: COPY /d -> /d/sub must not recurse forever."""
+    _, _, _, dav = stack
+    dav_call(dav, "MKCOL", "/ct")
+    dav_call(dav, "PUT", "/ct/f.txt", b"x")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "COPY", "/ct",
+                 headers={"Destination": f"{dav.url}/ct/sub"})
+    assert ei.value.code == 409
+    # MOVE onto itself is likewise rejected, not destructive
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "MOVE", "/ct/f.txt",
+                 headers={"Destination": f"{dav.url}/ct/f.txt"})
+    assert ei.value.code == 409
+    assert dav_call(dav, "GET", "/ct/f.txt")[2] == b"x"
+
+
+def test_filer_client_preserves_extended(stack):
+    """Regression: extended attrs survive the metadata-API round-trip
+    (the remote S3 gateway stores multipart keys there)."""
+    _, _, filer, _ = stack
+    from seaweedfs_tpu.filer.entry import Attr, Entry
+    import time as _t
+    client = FilerClient(filer.url)
+    now = _t.time()
+    e = Entry(full_path="/xt/meta.bin",
+              attr=Attr(mtime=now, crtime=now, user_name="alice"),
+              extended={"key": b"real/object/name.bin"})
+    client.create_entry(e)
+    got = client.find_entry("/xt/meta.bin")
+    assert got.extended.get("key") == b"real/object/name.bin"
+    assert got.attr.user_name == "alice"
