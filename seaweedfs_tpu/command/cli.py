@@ -188,6 +188,38 @@ def cmd_download(args):
         print(f"{fid} -> {out} ({len(data)} bytes)")
 
 
+def cmd_backup(args):
+    from .volume_tools import backup_volume
+    out = backup_volume(args.server, args.volumeId, args.dir,
+                        collection=args.collection)
+    print(f"volume {out['volume']}: {out['mode']} sync, "
+          f"{out['applied']} records, {out['size']} bytes")
+
+
+def cmd_export(args):
+    from .volume_tools import export_volume
+    listed = export_volume(args.dir, args.volumeId,
+                           collection=args.collection,
+                           tar_path=args.o or None)
+    for fid, name, size in listed:
+        print(f"{fid}\t{name}\t{size}")
+    print(f"exported {len(listed)} files")
+
+
+def cmd_fix(args):
+    from .volume_tools import fix_volume
+    n = fix_volume(args.dir, args.volumeId, collection=args.collection)
+    print(f"walked {n} records")
+
+
+def cmd_compact(args):
+    from .volume_tools import compact_volume
+    out = compact_volume(args.dir, args.volumeId,
+                         collection=args.collection)
+    print(f"volume {out['volume']}: {out['before']} -> "
+          f"{out['after']} bytes")
+
+
 def cmd_version(args):
     from .. import VERSION
     print(f"seaweedfs_tpu {VERSION}")
@@ -323,6 +355,34 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("-master", default="127.0.0.1:9333")
     d.add_argument("fids", nargs="+")
     d.set_defaults(fn=cmd_download)
+
+    bk = sub.add_parser("backup",
+                        help="incremental local copy of a live volume")
+    bk.add_argument("-server", default="127.0.0.1:9333",
+                    help="master url")
+    bk.add_argument("-dir", default=".")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.add_argument("-collection", default="")
+    bk.set_defaults(fn=cmd_backup)
+
+    ex = sub.add_parser("export", help="export volume needles to tar")
+    ex.add_argument("-dir", default=".")
+    ex.add_argument("-volumeId", type=int, required=True)
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-o", default="", help="tar output path")
+    ex.set_defaults(fn=cmd_export)
+
+    fx = sub.add_parser("fix", help="rebuild .idx from .dat")
+    fx.add_argument("-dir", default=".")
+    fx.add_argument("-volumeId", type=int, required=True)
+    fx.add_argument("-collection", default="")
+    fx.set_defaults(fn=cmd_fix)
+
+    cp = sub.add_parser("compact", help="force-vacuum a local volume")
+    cp.add_argument("-dir", default=".")
+    cp.add_argument("-volumeId", type=int, required=True)
+    cp.add_argument("-collection", default="")
+    cp.set_defaults(fn=cmd_compact)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=cmd_version)

@@ -1,0 +1,126 @@
+"""Offline volume tools — backup, export, fix, compact.
+
+Reference weed/command/{backup,export,fix,compact}.go: `backup` keeps an
+incremental local copy of a live volume (full pull on first run or after
+a remote compaction, raw record tail afterwards); `export` dumps live
+needles to a tar; `fix` rebuilds the .idx from a .dat scan; `compact`
+force-vacuums a local volume.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from typing import Optional
+
+from ..client import operation as op
+from ..server.http_util import get_json, http_call, http_download
+from ..storage import volume_backup
+from ..storage.types import TOMBSTONE_FILE_SIZE
+from ..storage.volume import Volume, VolumeError, volume_file_prefix
+
+TAIL_PAGE_BYTES = 64 << 20     # per-request cap while following a tail
+
+
+def backup_volume(master_url: str, vid: int, dirname: str,
+                  collection: str = "") -> dict:
+    """Pull/refresh a local copy of volume vid from its live server."""
+    locations = op.lookup(master_url, vid)
+    if not locations:
+        raise VolumeError(f"volume {vid} has no locations")
+    src = locations[0]
+    status = get_json(f"http://{src}/admin/volume/sync_status?volume={vid}")
+    prefix = volume_file_prefix(dirname, collection, vid)
+    dat_path, idx_path = prefix + ".dat", prefix + ".idx"
+    os.makedirs(dirname, exist_ok=True)
+    basename = os.path.basename(dat_path)
+
+    mode = "incremental"
+    if os.path.exists(dat_path) and os.path.exists(idx_path):
+        local = Volume(dirname, collection, vid)
+        try:
+            revision = local.super_block.compaction_revision
+            if revision != status["compact_revision"] or \
+                    local.size() > status["tail_offset"]:
+                mode = "full"          # remote was compacted: resync
+            else:
+                applied = 0
+                since = volume_backup.last_append_at_ns(local)
+                while True:            # record-aligned pages until dry
+                    blob = http_call(
+                        "GET",
+                        f"http://{src}/admin/volume/tail?volume={vid}"
+                        f"&since_ns={since}"
+                        f"&max_bytes={TAIL_PAGE_BYTES}")
+                    got, since = volume_backup.append_raw_records(
+                        local, blob, since)
+                    applied += got
+                    if len(blob) < TAIL_PAGE_BYTES:
+                        break
+                return {"volume": vid, "mode": mode, "applied": applied,
+                        "size": local.size()}
+        finally:
+            local.close()
+    else:
+        mode = "full"
+
+    if mode == "full":
+        http_download(f"http://{src}/admin/file?name={basename}",
+                      dat_path)
+        volume_backup.rebuild_index(dat_path, idx_path)
+    local = Volume(dirname, collection, vid)
+    try:
+        return {"volume": vid, "mode": mode,
+                "applied": local.file_count(), "size": local.size()}
+    finally:
+        local.close()
+
+
+def export_volume(dirname: str, vid: int, collection: str = "",
+                  tar_path: Optional[str] = None) -> list:
+    """Dump live needles; returns [(fid, name, size)] and optionally
+    writes a tar whose members carry needle names (fid fallback)."""
+    v = Volume(dirname, collection, vid)
+    listed = []
+    tar = tarfile.open(tar_path, "w") if tar_path else None
+    try:
+        for nid, nv in sorted(v.nm.items(), key=lambda kv: kv[1].offset):
+            if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
+                continue
+            from ..storage.needle import Needle
+            blob = v._read_blob(nv.offset, nv.size)
+            n = Needle.from_bytes(blob, v.version, expected_size=nv.size)
+            fid = f"{vid},{n.fid_suffix()}"
+            name = n.name.decode("utf-8", "replace") if n.has_name() \
+                else fid.replace(",", "_")
+            listed.append((fid, name, len(n.data)))
+            if tar is not None:
+                info = tarfile.TarInfo(name=name)
+                info.size = len(n.data)
+                if n.has_last_modified():
+                    info.mtime = n.last_modified
+                tar.addfile(info, io.BytesIO(n.data))
+    finally:
+        if tar is not None:
+            tar.close()
+        v.close()
+    return listed
+
+
+def fix_volume(dirname: str, vid: int, collection: str = "") -> int:
+    """Rebuild the .idx from the .dat (reference weed/command/fix.go)."""
+    prefix = volume_file_prefix(dirname, collection, vid)
+    return volume_backup.rebuild_index(prefix + ".dat", prefix + ".idx")
+
+
+def compact_volume(dirname: str, vid: int, collection: str = "") -> dict:
+    """Force-vacuum a local volume in place."""
+    v = Volume(dirname, collection, vid)
+    try:
+        before = v.size()
+        v.compact()
+        v.commit_compact()
+        return {"volume": vid, "before": before, "after": v.size()}
+    finally:
+        v.close()

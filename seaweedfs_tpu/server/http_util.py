@@ -8,6 +8,7 @@ reference's upload format), and urllib-based client calls.
 from __future__ import annotations
 
 import json
+import os
 import re
 import socket
 import threading
@@ -176,16 +177,27 @@ class Response:
     def __init__(self, body: bytes = b"", status: int = 200,
                  content_type: str = "application/octet-stream",
                  headers: Optional[dict] = None,
-                 content_length: Optional[int] = None):
+                 content_length: Optional[int] = None,
+                 body_path: Optional[str] = None,
+                 body_range: Optional[tuple] = None):
         self.body = body
         self.status = status
         self.content_type = content_type
         self.headers = headers or {}
         self.content_length = content_length
+        # streaming variant: serve (offset, size) of a file without
+        # buffering it — bulk pulls (.dat tier/backup) are volume-sized
+        self.body_path = body_path
+        self.body_range = body_range
 
     def send(self, handler: BaseHTTPRequestHandler):
-        length = self.content_length if self.content_length is not None \
-            else len(self.body)
+        if self.body_path is not None:
+            off, size = self.body_range or (0, os.path.getsize(
+                self.body_path))
+            length = size
+        else:
+            length = self.content_length if self.content_length is not None \
+                else len(self.body)
         try:
             handler.send_response(self.status)
             handler.send_header("Content-Type", self.content_type)
@@ -193,7 +205,19 @@ class Response:
             for k, v in self.headers.items():
                 handler.send_header(k, v)
             handler.end_headers()
-            if handler.command != "HEAD":
+            if handler.command == "HEAD":
+                return
+            if self.body_path is not None:
+                with open(self.body_path, "rb") as f:
+                    f.seek(off)
+                    left = size
+                    while left > 0:
+                        chunk = f.read(min(1 << 20, left))
+                        if not chunk:
+                            break
+                        handler.wfile.write(chunk)
+                        left -= len(chunk)
+            else:
                 handler.wfile.write(self.body)
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -265,6 +289,27 @@ def http_call(method: str, url: str, body: bytes = None,
         raise HttpError(e.code, f"{method} {url}: {detail}") from None
     except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
         raise HttpError(503, f"{method} {url}: {e}") from None
+
+
+def http_download(url: str, path: str, timeout: float = 600.0) -> int:
+    """Stream a GET response straight to a file (volume-sized pulls must
+    not transit RAM). Returns bytes written."""
+    req = urllib.request.Request(url, method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp, \
+                open(path, "wb") as out:
+            total = 0
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    return total
+                out.write(chunk)
+                total += len(chunk)
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode("utf-8", "replace")[:500]
+        raise HttpError(e.code, f"GET {url}: {detail}") from None
+    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+        raise HttpError(503, f"GET {url}: {e}") from None
 
 
 def get_json(url: str, timeout: float = 30.0) -> dict:

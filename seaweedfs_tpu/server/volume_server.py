@@ -55,6 +55,11 @@ class VolumeServer:
         router.add("POST", "/admin/ec/to_volume", self.admin_ec_to_volume)
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
         router.add("GET", "/admin/file", self.admin_file)
+        router.add("GET", "/admin/volume/sync_status",
+                   self.admin_volume_sync_status)
+        router.add("GET", "/admin/volume/tail", self.admin_volume_tail)
+        router.add("POST", "/admin/volume/tail_receive",
+                   self.admin_volume_tail_receive)
         router.set_fallback(self.data_handler)
         router.before = self._guard_check
 
@@ -351,6 +356,61 @@ class VolumeServer:
             raise HttpError(404, f"shard {vid}.{sid} not here")
         return Response(ev.shards[sid].read_at(offset, size))
 
+    def admin_volume_sync_status(self, req: Request):
+        """Sync metadata for incremental copy (reference
+        volume_server.proto VolumeSyncStatus)."""
+        from ..storage import volume_backup
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        try:
+            last_ns = volume_backup.last_append_at_ns(v)
+        except VolumeError as e:
+            raise HttpError(400, str(e))
+        return {
+            "volume": vid,
+            "collection": v.collection,
+            "tail_offset": v.size(),
+            "compact_revision": v.super_block.compaction_revision,
+            "replication": str(v.super_block.replica_placement),
+            "ttl": str(v.super_block.ttl),
+            "version": v.version,
+            "last_append_at_ns": last_ns,
+        }
+
+    def admin_volume_tail(self, req: Request):
+        """Raw record bytes appended after since_ns (reference
+        VolumeIncrementalCopy / VolumeTailSender)."""
+        from ..storage import volume_backup
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        since_ns = int(req.query.get("since_ns", 0))
+        max_bytes = int(req.query.get("max_bytes", 0))
+        try:
+            return Response(volume_backup.read_incremental(v, since_ns,
+                                                           max_bytes))
+        except VolumeError as e:
+            raise HttpError(400, str(e))
+
+    def admin_volume_tail_receive(self, req: Request):
+        """Apply raw record bytes shipped by a tail sender (reference
+        VolumeTailReceiver): follower-side of volume.tail replication."""
+        from ..storage import volume_backup
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        since = req.query.get("since_ns")
+        try:
+            applied, cursor = volume_backup.append_raw_records(
+                v, req.body, int(since) if since is not None else None)
+        except VolumeError as e:
+            raise HttpError(400, str(e))
+        return {"applied": applied, "cursor_ns": cursor}
+
     def admin_file(self, req: Request):
         """Serve a raw storage file (EC copy pull path). Restricted to the
         store's own directories and known extensions."""
@@ -367,9 +427,7 @@ class VolumeServer:
                 offset = int(req.query.get("offset", 0))
                 size = int(req.query.get("size", 0)) \
                     or os.path.getsize(path) - offset
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    return Response(f.read(size))
+                return Response(body_path=path, body_range=(offset, size))
         raise HttpError(404, f"{name} not found")
 
     def _guard_check(self, req: Request):

@@ -1,0 +1,230 @@
+"""Volume backup/tail + offline tools (reference weed/command/{backup,
+export,fix,compact}.go, weed/storage/volume_backup.go)."""
+
+import os
+import tarfile
+
+import pytest
+
+from seaweedfs_tpu.command.volume_tools import (backup_volume,
+                                                compact_volume,
+                                                export_volume, fix_volume)
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage import volume_backup
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NotFound, Volume
+
+
+def make_volume(dirname, vid=7, count=20):
+    os.makedirs(str(dirname), exist_ok=True)
+    v = Volume(str(dirname), "", vid, create=True)
+    for i in range(count):
+        n = Needle(cookie=0x100 + i, id=i + 1,
+                   data=bytes([i % 251]) * (100 + i))
+        n.set_name(f"file-{i}.bin".encode())
+        v.write_needle(n)
+    return v
+
+
+def test_last_append_and_binary_search(tmp_path):
+    v = make_volume(tmp_path)
+    stamps = []
+    for nid, nv in sorted(v.nm.items(), key=lambda kv: kv[1].offset):
+        stamps.append(volume_backup._read_append_at_ns(v, nv.offset))
+    assert stamps == sorted(stamps)
+    assert volume_backup.last_append_at_ns(v) == stamps[-1]
+    # searching strictly-before the k-th stamp ships from the k-th record
+    offsets = sorted(nv.offset for _, nv in v.nm.items())
+    for k in (0, 5, 19):
+        got = volume_backup.binary_search_append_at_ns(v, stamps[k] - 1)
+        assert got == offsets[k]
+    # nothing newer than the last stamp -> EOF
+    assert volume_backup.binary_search_append_at_ns(
+        v, stamps[-1]) == v.size()
+    v.close()
+
+
+def test_last_append_sees_trailing_tombstones(tmp_path):
+    v = make_volume(tmp_path, count=10)
+    before = volume_backup.last_append_at_ns(v)
+    for nid in (8, 9, 10):
+        v.delete_needle(Needle(cookie=0x100 + nid - 1, id=nid))
+    # cursor advances past the tombstone-only tail
+    assert volume_backup.last_append_at_ns(v) > before
+    v.close()
+
+
+def test_tail_ships_tombstone_runs(tmp_path):
+    """A delete recorded after the follower's sync point must reach the
+    follower even with no live write after it."""
+    src = make_volume(tmp_path / "src", count=4)
+    os.makedirs(str(tmp_path / "dst"))
+    dst = Volume(str(tmp_path / "dst"), "", 7, create=True)
+    applied, cursor = volume_backup.append_raw_records(
+        dst, volume_backup.read_incremental(src, 0))
+    assert applied == 4
+    src.delete_needle(Needle(cookie=0x100 + 1, id=2))
+    delta = volume_backup.read_incremental(src, cursor)
+    applied, cursor2 = volume_backup.append_raw_records(dst, delta, cursor)
+    assert applied == 1 and cursor2 > cursor
+    with pytest.raises(NotFound):
+        dst.read_needle(Needle(cookie=0x100 + 1, id=2))
+    # re-shipping the same window is a no-op (idempotent cursor filter)
+    applied, _ = volume_backup.append_raw_records(
+        dst, volume_backup.read_incremental(src, cursor), cursor2)
+    assert applied == 0
+    src.close()
+    dst.close()
+
+
+def test_read_incremental_max_bytes_record_aligned(tmp_path):
+    v = make_volume(tmp_path, count=6)
+    full = volume_backup.read_incremental(v, 0)
+    page = volume_backup.read_incremental(v, 0, max_bytes=len(full) // 2)
+    assert 0 < len(page) < len(full)
+    os.makedirs(str(tmp_path / "dst"))
+    dst = Volume(str(tmp_path / "dst"), "", 7, create=True)
+    applied, cursor = volume_backup.append_raw_records(dst, page, 0)
+    assert applied > 0           # a page is always fully applicable
+    rest = volume_backup.read_incremental(v, cursor)
+    applied2, _ = volume_backup.append_raw_records(dst, rest, cursor)
+    assert applied + applied2 == 6
+    v.close()
+    dst.close()
+
+
+def test_incremental_roundtrip(tmp_path):
+    src = make_volume(tmp_path / "src", count=5)
+    os.makedirs(str(tmp_path / "dst"))
+    dst = Volume(str(tmp_path / "dst"), "", 7, create=True)
+    blob = volume_backup.read_incremental(src, 0)
+    assert volume_backup.append_raw_records(dst, blob)[0] == 5
+    for i in range(5):
+        got = dst.read_needle(Needle(cookie=0x100 + i, id=i + 1))
+        assert got.data == bytes([i % 251]) * (100 + i)
+    # follow-on: new write + delete replicate over
+    since = volume_backup.last_append_at_ns(dst)
+    n = Needle(cookie=0xAB, id=99, data=b"late-arrival")
+    src.write_needle(n)
+    src.delete_needle(Needle(cookie=0x100, id=1))
+    delta = volume_backup.read_incremental(src, since)
+    assert volume_backup.append_raw_records(dst, delta, since)[0] == 2
+    assert dst.read_needle(Needle(cookie=0xAB, id=99)).data == \
+        b"late-arrival"
+    with pytest.raises(NotFound):
+        dst.read_needle(Needle(cookie=0x100, id=1))
+    src.close()
+    dst.close()
+
+
+def test_append_raw_rejects_garbage(tmp_path):
+    v = make_volume(tmp_path, count=2)
+    before = v.size()
+    blob = volume_backup.read_incremental(v, 0)
+    with pytest.raises(Exception):
+        volume_backup.append_raw_records(v, blob[:-3])
+    assert v.size() == before
+    v.close()
+
+
+def test_fix_rebuilds_idx(tmp_path):
+    v = make_volume(tmp_path, count=12)
+    v.delete_needle(Needle(cookie=0x100 + 3, id=4))
+    want = {nid: (nv.offset, nv.size) for nid, nv in v.nm.items()}
+    v.close()
+    os.remove(tmp_path / "7.idx")
+    fix_volume(str(tmp_path), 7)
+    v2 = Volume(str(tmp_path), "", 7)
+    got = {nid: (nv.offset, nv.size) for nid, nv in v2.nm.items()}
+    assert got == want
+    v2.close()
+
+
+def test_export_tar(tmp_path):
+    v = make_volume(tmp_path, count=6)
+    v.delete_needle(Needle(cookie=0x100 + 2, id=3))
+    v.close()
+    tar_path = str(tmp_path / "out.tar")
+    listed = export_volume(str(tmp_path), 7, tar_path=tar_path)
+    assert len(listed) == 5
+    with tarfile.open(tar_path) as tf:
+        names = tf.getnames()
+        assert "file-0.bin" in names and "file-2.bin" not in names
+        data = tf.extractfile("file-4.bin").read()
+        assert data == bytes([4]) * 104
+
+
+def test_compact_tool(tmp_path):
+    v = make_volume(tmp_path, count=10)
+    for i in range(5):
+        v.delete_needle(Needle(cookie=0x100 + i, id=i + 1))
+    v.close()
+    out = compact_volume(str(tmp_path), 7)
+    assert out["after"] < out["before"]
+    v2 = Volume(str(tmp_path), "", 7)
+    assert v2.file_count() == 5
+    assert v2.read_needle(
+        Needle(cookie=0x100 + 7, id=8)).data == bytes([7]) * 107
+    v2.close()
+
+
+@pytest.fixture
+def live(tmp_path):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "srv")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[10], ec_backend="numpy").start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_backup_command_full_then_incremental(tmp_path, live):
+    master, vs = live
+    from seaweedfs_tpu.client import operation as op
+    fids = [op.upload_data(master.url, f"payload-{i}".encode() * 50,
+                           filename=f"f{i}") for i in range(8)]
+    vid = int(fids[0].split(",")[0])
+    bdir = str(tmp_path / "backup")
+
+    out = backup_volume(master.url, vid, bdir)
+    assert out["mode"] == "full"
+    local = Volume(bdir, "", vid)
+    count0 = local.file_count()
+    assert count0 >= 1
+    local.close()
+
+    # more uploads land on some volume; tail the same vid incrementally
+    more = [op.upload_data(master.url, b"x" * 100, filename="late")
+            for _ in range(6)]
+    out2 = backup_volume(master.url, vid, bdir)
+    assert out2["mode"] == "incremental"
+    v_remote = vs.store.find_volume(vid)
+    local = Volume(bdir, "", vid)
+    assert local.size() == v_remote.size()
+    assert local.file_count() == v_remote.file_count()
+    local.close()
+
+
+def test_backup_full_resync_after_compaction(tmp_path, live):
+    master, vs = live
+    from seaweedfs_tpu.client import operation as op
+    fid = op.upload_data(master.url, b"will-survive" * 10, filename="a")
+    vid = int(fid.split(",")[0])
+    bdir = str(tmp_path / "backup")
+    backup_volume(master.url, vid, bdir)
+
+    fid2 = op.upload_data(master.url, b"doomed" * 10, filename="b")
+    if int(fid2.split(",")[0]) == vid:
+        op.delete_file(master.url, fid2)
+    v = vs.store.find_volume(vid)
+    v.compact()
+    v.commit_compact()
+    out = backup_volume(master.url, vid, bdir)
+    assert out["mode"] == "full"
+    local = Volume(bdir, "", vid)
+    assert local.super_block.compaction_revision == \
+        v.super_block.compaction_revision
+    local.close()
