@@ -24,8 +24,18 @@ def cmd_master(args):
     _wait()
 
 
+def _load_tier_config(path: str):
+    if not path:
+        return
+    import json
+    from ..storage.backend import configure_backends
+    with open(path) as f:
+        configure_backends(json.load(f))
+
+
 def cmd_volume(args):
     from ..server.volume_server import VolumeServer
+    _load_tier_config(args.tierConfig)
     dirs = args.dir.split(",")
     maxes = [int(x) for x in args.max.split(",")] if args.max else None
     if maxes and len(maxes) == 1:
@@ -48,6 +58,7 @@ def cmd_server(args):
     (reference `weed server`)."""
     from ..server.master import MasterServer
     from ..server.volume_server import VolumeServer
+    _load_tier_config(getattr(args, "tierConfig", ""))
     m = MasterServer(port=args.masterPort, host=args.ip,
                      default_replication=args.defaultReplication,
                      jwt_signing_key=args.jwtKey).start()
@@ -261,6 +272,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-jwtKey", default="")
     v.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs allowed to call")
+    v.add_argument("-tierConfig", default="",
+                   help="JSON file of remote tier backends, e.g. "
+                        '{"s3": {"default": {"endpoint": ..., '
+                        '"bucket": ...}}}')
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server", help="master + volume (+filer) combined")
@@ -285,6 +300,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
     s.add_argument("-jwtKey", default="")
+    s.add_argument("-tierConfig", default="")
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer", help="start a filer server")

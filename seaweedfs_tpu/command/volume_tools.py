@@ -20,7 +20,7 @@ from ..storage import volume_backup
 from ..storage.types import TOMBSTONE_FILE_SIZE
 from ..storage.volume import Volume, VolumeError, volume_file_prefix
 
-TAIL_PAGE_BYTES = 64 << 20     # per-request cap while following a tail
+TAIL_PAGE_BYTES = volume_backup.DEFAULT_TAIL_PAGE_BYTES
 
 
 def backup_volume(master_url: str, vid: int, dirname: str,
@@ -53,11 +53,16 @@ def backup_volume(master_url: str, vid: int, dirname: str,
                         f"http://{src}/admin/volume/tail?volume={vid}"
                         f"&since_ns={since}"
                         f"&max_bytes={TAIL_PAGE_BYTES}")
-                    got, since = volume_backup.append_raw_records(
+                    got, new_since = volume_backup.append_raw_records(
                         local, blob, since)
                     applied += got
-                    if len(blob) < TAIL_PAGE_BYTES:
+                    # done only when the cursor stops moving — pages are
+                    # record-aligned so they are almost never exactly
+                    # TAIL_PAGE_BYTES long and a length test would stop
+                    # after one page
+                    if not blob or new_since == since:
                         break
+                    since = new_since
                 return {"volume": vid, "mode": mode, "applied": applied,
                         "size": local.size()}
         finally:

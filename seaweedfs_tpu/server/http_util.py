@@ -191,9 +191,23 @@ class Response:
         self.body_range = body_range
 
     def send(self, handler: BaseHTTPRequestHandler):
+        src = None
         if self.body_path is not None:
-            off, size = self.body_range or (0, os.path.getsize(
-                self.body_path))
+            # open + stat BEFORE any header goes out: a vanished or
+            # shrunken file (compaction / tier-upload race) must become
+            # a clean error response, and the advertised Content-Length
+            # must be bytes the stream can actually deliver
+            try:
+                src = open(self.body_path, "rb")
+                file_size = os.fstat(src.fileno()).st_size
+            except OSError as e:
+                if src is not None:
+                    src.close()
+                handler.send_error(404, str(e))
+                return
+            off, size = self.body_range or (0, file_size)
+            off = min(off, file_size)
+            size = min(size, file_size - off)
             length = size
         else:
             length = self.content_length if self.content_length is not None \
@@ -207,20 +221,22 @@ class Response:
             handler.end_headers()
             if handler.command == "HEAD":
                 return
-            if self.body_path is not None:
-                with open(self.body_path, "rb") as f:
-                    f.seek(off)
-                    left = size
-                    while left > 0:
-                        chunk = f.read(min(1 << 20, left))
-                        if not chunk:
-                            break
-                        handler.wfile.write(chunk)
-                        left -= len(chunk)
+            if src is not None:
+                src.seek(off)
+                left = size
+                while left > 0:
+                    chunk = src.read(min(1 << 20, left))
+                    if not chunk:
+                        break
+                    handler.wfile.write(chunk)
+                    left -= len(chunk)
             else:
                 handler.wfile.write(self.body)
-        except (BrokenPipeError, ConnectionResetError):
+        except (BrokenPipeError, ConnectionResetError, OSError):
             pass
+        finally:
+            if src is not None:
+                src.close()
 
 
 class HttpServer:

@@ -55,6 +55,10 @@ class VolumeServer:
         router.add("POST", "/admin/ec/to_volume", self.admin_ec_to_volume)
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
         router.add("GET", "/admin/file", self.admin_file)
+        router.add("POST", "/admin/volume/tier_upload",
+                   self.admin_tier_upload)
+        router.add("POST", "/admin/volume/tier_download",
+                   self.admin_tier_download)
         router.add("GET", "/admin/volume/sync_status",
                    self.admin_volume_sync_status)
         router.add("GET", "/admin/volume/tail", self.admin_volume_tail)
@@ -356,6 +360,41 @@ class VolumeServer:
             raise HttpError(404, f"shard {vid}.{sid} not here")
         return Response(ev.shards[sid].read_at(offset, size))
 
+    def admin_tier_upload(self, req: Request):
+        """Ship a readonly volume's .dat to a configured backend
+        (reference VolumeTierMoveDatToRemote)."""
+        from ..storage import volume_tier
+        from ..storage.backend import BackendError
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        try:
+            info = volume_tier.upload_dat(
+                v, req.query["dest"],
+                keep_local=req.query.get("keep_local") == "true")
+        except (VolumeError, BackendError) as e:
+            raise HttpError(400, str(e))
+        self.heartbeat_once()
+        return info
+
+    def admin_tier_download(self, req: Request):
+        """Bring a remote .dat back to local disk (reference
+        VolumeTierMoveDatFromRemote)."""
+        from ..storage import volume_tier
+        from ..storage.backend import BackendError
+        vid = int(req.query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        try:
+            out = volume_tier.download_dat(
+                v, delete_remote=req.query.get("delete_remote") == "true")
+        except (VolumeError, BackendError) as e:
+            raise HttpError(400, str(e))
+        self.heartbeat_once()
+        return out
+
     def admin_volume_sync_status(self, req: Request):
         """Sync metadata for incremental copy (reference
         volume_server.proto VolumeSyncStatus)."""
@@ -388,7 +427,10 @@ class VolumeServer:
         if v is None:
             raise HttpError(404, f"volume {vid} not found")
         since_ns = int(req.query.get("since_ns", 0))
-        max_bytes = int(req.query.get("max_bytes", 0))
+        # always page-capped: a whole-volume delta must not be buffered
+        # into one Response body
+        max_bytes = int(req.query.get("max_bytes", 0)) \
+            or volume_backup.DEFAULT_TAIL_PAGE_BYTES
         try:
             return Response(volume_backup.read_incremental(v, since_ns,
                                                            max_bytes))

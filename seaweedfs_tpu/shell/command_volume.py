@@ -167,3 +167,59 @@ def volume_delete(env: CommandEnv, args: List[str]):
     for r in env.all_volumes().get(str(vid), []):
         env.node_post(r["url"], f"/admin/delete_volume?volume={vid}")
         env.write(f"volume {vid}: deleted on {r['url']}")
+
+
+@command("volume.tier.upload",
+         "-volumeId <id> -dest <kind.id> [-keepLocalDatFile] : move a "
+         "volume's .dat to a remote tier backend")
+def volume_tier_upload(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    dest = flags["dest"]
+    replicas = env.all_volumes().get(str(vid), [])
+    if not replicas:
+        env.write(f"volume {vid} not found")
+        return
+    # freeze every replica, then ship from ONE location (reference
+    # doVolumeTierUpload): replica .dat files are not byte-identical in
+    # general, so two uploaders racing on one backend key would corrupt
+    # the tier for whichever .idx loses
+    for r in replicas:
+        env.node_post(r["url"], f"/admin/volume/readonly?volume={vid}")
+    keep = "true" if flags.get("keepLocalDatFile") else "false"
+    r = replicas[0]
+    info = env.node_post(
+        r["url"], f"/admin/volume/tier_upload?volume={vid}"
+                  f"&dest={dest}&keep_local={keep}")
+    env.write(f"volume {vid} @ {r['url']}: .dat -> "
+              f"{info['remote']['backend']}/{info['remote']['key']} "
+              f"({info['remote']['file_size']} bytes)")
+
+
+@command("volume.tier.download",
+         "-volumeId <id> [-deleteRemote] : bring a tiered volume's .dat "
+         "back to local disk")
+def volume_tier_download(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    delete = "true" if flags.get("deleteRemote") else "false"
+    replicas = env.all_volumes().get(str(vid), [])
+    if not replicas:
+        env.write(f"volume {vid} not found")
+        return
+    from ..server.http_util import HttpError
+    brought = 0
+    for r in replicas:
+        try:
+            out = env.node_post(
+                r["url"], f"/admin/volume/tier_download?volume={vid}"
+                          f"&delete_remote={delete}")
+        except HttpError as e:
+            if "no remote tier" in str(e):
+                continue       # this replica kept its local .dat
+            raise
+        brought += 1
+        env.write(f"volume {vid} @ {r['url']}: .dat local again "
+                  f"({out['size']} bytes)")
+    if not brought:
+        env.write(f"volume {vid}: no replica is tiered")

@@ -39,10 +39,11 @@ class DiskLocation:
                     continue
                 vid = int(m.group("vid"))
                 collection = m.group("collection") or ""
-                dat = os.path.join(
-                    self.directory,
-                    fname[: -len(".idx")] + ".dat")
-                if not os.path.exists(dat):
+                base = os.path.join(self.directory, fname[: -len(".idx")])
+                # .dat may be absent for a tiered volume whose .vif
+                # points at a remote backend
+                if not os.path.exists(base + ".dat") and \
+                        not os.path.exists(base + ".vif"):
                     continue
                 if vid not in self.volumes:
                     try:

@@ -51,6 +51,30 @@ class Volume:
         self.dat_path = prefix + ".dat"
         self.idx_path = prefix + ".idx"
 
+        # a .vif sidecar marks a tiered volume: the .dat lives on a
+        # remote backend and reads are range requests — but only when
+        # the local .dat is actually gone (a keep-local tier upload
+        # leaves both, and the local copy must win or every read pays a
+        # pointless network round trip)
+        remote_info = None
+        if not os.path.exists(self.dat_path):
+            from .volume_tier import load_volume_info
+            info = load_volume_info(prefix + ".vif")
+            if info and "remote" in info:
+                remote_info = info["remote"]
+
+        if remote_info is not None:
+            from .backend import RemoteFile, get_backend
+            self.dat = RemoteFile(get_backend(remote_info["backend"]),
+                                  remote_info["key"],
+                                  remote_info["file_size"])
+            self.super_block = SuperBlock.from_bytes(
+                self.dat.read(SUPER_BLOCK_SIZE))
+            self.readonly = True
+            self.nm = NeedleMap.load(self.idx_path)
+            self.last_modified = remote_info.get("modified_at", 0)
+            return
+
         if create and not os.path.exists(self.dat_path):
             sb = SuperBlock(
                 replica_placement=replica_placement or ReplicaPlacement(),
@@ -70,6 +94,14 @@ class Volume:
         self.check_integrity()
         self.nm = NeedleMap.load(self.idx_path)
         self.last_modified = int(os.path.getmtime(self.dat_path))
+        # a keep-local tier upload leaves .dat + .vif side by side; the
+        # volume serves locally but must stay frozen or the parked
+        # remote copy silently diverges
+        if not create and os.path.exists(prefix + ".vif"):
+            from .volume_tier import load_volume_info
+            info = load_volume_info(prefix + ".vif")
+            if info and "remote" in info:
+                self.readonly = True
 
     # -- properties --------------------------------------------------------
     @property

@@ -26,6 +26,30 @@ from .volume import Volume, VolumeError
 
 IDX_ENTRY_SIZE = 16
 
+# server-side default page cap for /admin/volume/tail: an uncapped tail
+# of a 30GB volume must not transit RAM in one Response body
+DEFAULT_TAIL_PAGE_BYTES = 64 << 20
+
+
+def walk_records(pread, version: int, start: int, end: int):
+    """Yield (header_needle, offset, actual_size) for each raw record in
+    [start, end). `pread(offset, size) -> bytes` is the only I/O needed,
+    so the same walk serves a live Volume, a bare .dat file, and an
+    in-memory blob — the record framing lives in exactly one place.
+    Stops at a short tail."""
+    offset = start
+    while offset + 16 <= end:
+        header = pread(offset, 16)
+        if len(header) < 16:
+            return
+        n = Needle.parse_header(header)
+        size = 0 if n.size == TOMBSTONE_FILE_SIZE else n.size
+        actual = get_actual_size(size, version)
+        if offset + actual > end:
+            return
+        yield n, offset, actual
+        offset += actual
+
 
 def _read_append_at_ns(volume: Volume, dat_offset: int) -> int:
     """append_at_ns of the needle record starting at dat_offset."""
@@ -97,16 +121,10 @@ def last_append_at_ns(volume: Volume) -> int:
                 break
     finally:
         idx.close()
-    end = volume.size()
-    while scan_from + 16 <= end:
-        header = _pread(volume, scan_from, 16)
-        n = Needle.parse_header(header)
-        size = 0 if n.size == TOMBSTONE_FILE_SIZE else n.size
-        nxt = scan_from + get_actual_size(size, volume.version)
-        if nxt > end:
-            break
-        last_ns = max(last_ns, _read_append_at_ns(volume, scan_from))
-        scan_from = nxt
+    pread = lambda off, size: _pread(volume, off, size)  # noqa: E731
+    for n, offset, actual in walk_records(pread, volume.version,
+                                          scan_from, volume.size()):
+        last_ns = max(last_ns, _read_append_at_ns(volume, offset))
     return last_ns
 
 
@@ -149,17 +167,14 @@ def read_incremental(volume: Volume, since_ns: int,
     start = binary_search_append_at_ns(volume, since_ns)
     end = volume.size()
     if max_bytes and end - start > max_bytes:
-        end = start
-        while True:
-            header = _pread(volume, end, 16)
-            if len(header) < 16:
+        pread = lambda off, size: _pread(volume, off, size)  # noqa: E731
+        cap = start
+        for n, offset, actual in walk_records(pread, volume.version,
+                                              start, end):
+            if offset + actual - start > max_bytes:
                 break
-            n = Needle.parse_header(header)
-            size = 0 if n.size == TOMBSTONE_FILE_SIZE else n.size
-            nxt = end + get_actual_size(size, volume.version)
-            if nxt - start > max_bytes:
-                break
-            end = nxt
+            cap = offset + actual
+        end = cap
     return _pread(volume, start, end - start)
 
 
@@ -182,18 +197,16 @@ def append_raw_records(volume: Volume, blob: bytes,
     # parse first so a corrupt stream can't leave a torn tail
     records = []
     pos = 0
-    while pos + 16 <= len(blob):
-        n = Needle.parse_header(blob[pos:pos + 16])
-        size = 0 if n.size == TOMBSTONE_FILE_SIZE else n.size
-        actual = get_actual_size(size, volume.version)
-        if pos + actual > len(blob):
-            raise VolumeError("truncated incremental record stream")
+    pread = lambda off, size: blob[off:off + size]  # noqa: E731
+    for n, offset, actual in walk_records(pread, volume.version,
+                                          0, len(blob)):
         records.append(
-            (Needle.from_bytes(blob[pos:pos + actual], volume.version),
-             pos, actual))
-        pos += actual
+            (Needle.from_bytes(blob[offset:offset + actual],
+                               volume.version), offset, actual))
+        pos = offset + actual
     if pos != len(blob):
-        raise VolumeError("trailing garbage in incremental record stream")
+        raise VolumeError(
+            "truncated or garbled incremental record stream")
     cursor = max([local_last] + [n.append_at_ns for n, _, _ in records])
     fresh = [(n, rel, actual) for n, rel, actual in records
              if n.append_at_ns > local_last]
@@ -228,22 +241,20 @@ def rebuild_index(dat_path: str, idx_path: str) -> int:
         version = sb.version
         f.seek(0, os.SEEK_END)
         end = f.tell()
+
+        def pread(off, size):
+            f.seek(off)
+            return f.read(size)
+
         count = 0
         tmp = idx_path + ".tmp"
         with open(tmp, "wb") as idx:
-            offset = SUPER_BLOCK_SIZE
-            while offset + 16 <= end:
-                f.seek(offset)
-                n = Needle.parse_header(f.read(16))
-                size = 0 if n.size == TOMBSTONE_FILE_SIZE else n.size
-                actual = get_actual_size(size, version)
-                if offset + actual > end:
-                    break
+            for n, offset, actual in walk_records(pread, version,
+                                                  SUPER_BLOCK_SIZE, end):
                 if n.size > 0:
                     idx.write(entry_to_bytes(n.id, offset, n.size))
                 else:
                     idx.write(entry_to_bytes(n.id, 0, TOMBSTONE_FILE_SIZE))
-                offset += actual
                 count += 1
     os.replace(tmp, idx_path)
     return count

@@ -1,0 +1,110 @@
+"""Tier move — ship a readonly volume's .dat to a remote backend.
+
+Reference weed/storage/volume_tier.go + server/volume_grpc_tier_upload.go
+/ _download.go: the .vif sidecar (reference: protobuf VolumeInfo; here:
+JSON) records where the .dat lives; reads become range requests through
+storage.backend.RemoteFile while the .idx and needle map stay local.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .backend import RemoteFile, get_backend
+from .volume import Volume, VolumeError
+
+
+def vif_path(volume: Volume) -> str:
+    return volume.file_name() + ".vif"
+
+
+def save_volume_info(path: str, info: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(info, f, indent=1)
+    os.replace(tmp, path)
+
+
+def load_volume_info(path: str):
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (ValueError, OSError):
+        return None
+
+
+def upload_dat(volume: Volume, spec: str, keep_local: bool = False) -> dict:
+    """Copy the .dat to backend `spec`. The volume must already be
+    readonly (the reference's tier.upload freezes it first — same
+    discipline here). With keep_local the volume KEEPS serving reads
+    from the local .dat and the remote copy is a parked duplicate;
+    without it the local .dat is dropped and reads become range
+    requests. The transfer itself runs outside volume.lock — the .dat
+    is immutable while readonly, and holding the lock for a multi-GB
+    WAN upload would stall every read and the heartbeat thread (which
+    takes the same lock in size())."""
+    with volume.lock:
+        if not volume.readonly:
+            raise VolumeError(
+                f"volume {volume.id} must be readonly before tier upload")
+        if isinstance(volume.dat, RemoteFile):
+            raise VolumeError(f"volume {volume.id} is already remote")
+        backend = get_backend(spec)
+        volume.dat.flush()
+        size = volume.size()
+        key = os.path.basename(volume.dat_path)
+
+    backend.upload_file(volume.dat_path, key)
+
+    with volume.lock:
+        if not volume.readonly:
+            backend.delete(key)    # un-frozen mid-upload: abandon
+            raise VolumeError(
+                f"volume {volume.id} became writable during tier upload")
+        # same .vif JSON shape the EC module writes ("version" = needle
+        # version), plus the remote-tier pointer
+        info = {
+            "version": volume.version,
+            "remote": {
+                "backend": spec,
+                "key": key,
+                "file_size": size,
+                "modified_at": int(time.time()),
+            },
+        }
+        save_volume_info(vif_path(volume), info)
+        if not keep_local:
+            volume.dat.close()
+            volume.dat = RemoteFile(backend, key, size)
+            os.remove(volume.dat_path)
+        return info
+
+
+def download_dat(volume: Volume, delete_remote: bool = False) -> dict:
+    """Bring a remote .dat back to local disk and drop the .vif. The
+    network pull lands in a temp file outside volume.lock; only the
+    swap is locked."""
+    info = load_volume_info(vif_path(volume))
+    if not info or "remote" not in info:
+        raise VolumeError(f"volume {volume.id} has no remote tier")
+    remote = info["remote"]
+    backend = get_backend(remote["backend"])
+    tmp = volume.dat_path + ".tierdl"
+    got = backend.download_file(remote["key"], tmp)
+    if got != remote["file_size"]:
+        os.remove(tmp)
+        raise VolumeError(
+            f"tier download size mismatch: {got} != "
+            f"{remote['file_size']}")
+    with volume.lock:
+        os.replace(tmp, volume.dat_path)
+        volume.dat.close()
+        volume.dat = open(volume.dat_path, "r+b")
+        os.remove(vif_path(volume))
+    if delete_remote:
+        backend.delete(remote["key"])
+    return {"volume": volume.id, "size": got}
