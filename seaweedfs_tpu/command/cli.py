@@ -231,6 +231,62 @@ def cmd_compact(args):
           f"{out['after']} bytes")
 
 
+def cmd_watch(args):
+    from ..replication.sub import EventSubscriber, format_event
+    sub = EventSubscriber(args.filer, since=args.since)
+    try:
+        for ts, event in sub.follow():
+            print(format_event(ts, event), flush=True)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_filer_replicate(args):
+    import json
+    from ..replication import (EventSubscriber, FilerSource, Replicator,
+                               make_sink)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    src_cfg = cfg["source"]
+    source = FilerSource(src_cfg["filer"], src_cfg["master"],
+                         path_prefix=src_cfg.get("path", "/"))
+    sink = make_sink(cfg["sink"])
+    rep = Replicator(source, sink)
+    sub = EventSubscriber(src_cfg["filer"], since=args.since)
+    print(f"replicating {src_cfg['filer']}{source.path_prefix} "
+          f"-> {sink.kind} sink", flush=True)
+    import time as _time
+    from ..server.http_util import HttpError
+    try:
+        while True:
+            try:
+                # cursor advances only after the batch fully applies —
+                # a down sink must stall replication, not skip events
+                batch = sub.poll_once(advance=False)
+            except HttpError:
+                _time.sleep(1.0)
+                continue
+            for e in batch:
+                delay = 1.0
+                while True:
+                    try:
+                        action = rep.replicate(e["event"])
+                        break
+                    except Exception as err:
+                        print(f"RETRY in {delay:.0f}s: {err}",
+                              flush=True)
+                        _time.sleep(delay)
+                        delay = min(delay * 2, 30.0)
+                if action != "skip":
+                    path = (e["event"].get("newEntry")
+                            or e["event"].get("oldEntry")
+                            or {}).get("FullPath", "?")
+                    print(f"{action} {path}", flush=True)
+            sub.commit(batch)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_version(args):
     from .. import VERSION
     print(f"seaweedfs_tpu {VERSION}")
@@ -371,6 +427,22 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("-master", default="127.0.0.1:9333")
     d.add_argument("fids", nargs="+")
     d.set_defaults(fn=cmd_download)
+
+    wt = sub.add_parser("watch", help="follow a filer's metadata events")
+    wt.add_argument("-filer", default="127.0.0.1:8888")
+    wt.add_argument("-since", type=float, default=0.0,
+                    help="resume from this event timestamp")
+    wt.set_defaults(fn=cmd_watch)
+
+    fr = sub.add_parser("filer.replicate",
+                        help="continuously replicate filer changes to a "
+                             "sink (another filer or an S3 bucket)")
+    fr.add_argument("-config", required=True,
+                    help='JSON: {"source": {"filer":..., "master":..., '
+                         '"path":...}, "sink": {"type": "filer"|"s3", '
+                         '...}}')
+    fr.add_argument("-since", type=float, default=0.0)
+    fr.set_defaults(fn=cmd_filer_replicate)
 
     bk = sub.add_parser("backup",
                         help="incremental local copy of a live volume")

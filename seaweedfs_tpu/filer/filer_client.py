@@ -75,9 +75,23 @@ class FilerClient:
     def rename_entry(self, old_path: str, new_path: str):
         self._post("rename", {"old": old_path, "new": new_path})
 
+    def mkdir(self, full_path: str):
+        """Create a directory entry (parents included, server-side
+        mkdir-p); ok if it already exists."""
+        from .entry import entry_to_wire, new_dir_entry
+        from ..server.http_util import HttpError
+        try:
+            self._post("create",
+                       {"entry": entry_to_wire(new_dir_entry(full_path))})
+        except HttpError as e:
+            if e.status != 409:     # 409 = already exists
+                raise
+
     def ensure_parents(self, full_path: str):
-        # server-side create_entry already mkdir-p's parents
-        pass
+        import posixpath
+        parent = posixpath.dirname(full_path)
+        if parent and parent != "/":
+            self.mkdir(parent)
 
     def queue_chunk_deletion(self, chunks: List[FileChunk]):
         self._post("delete_chunks",

@@ -46,9 +46,23 @@ class LogBuffer:
         with self._lock:
             self._flush_locked()
 
+    @staticmethod
+    def _take_since(events, ts: float, limit: int):
+        """Newer-than-ts slice, never splitting a same-timestamp run at
+        the limit: subscribers resume with a strict `> ts` filter, so a
+        run cut mid-way would lose its tail forever."""
+        got = [(t, e) for t, e in events if t > ts]
+        if len(got) > limit:
+            cut = limit
+            last_ts = got[cut - 1][0]
+            while cut < len(got) and got[cut][0] == last_ts:
+                cut += 1
+            got = got[:cut]
+        return got
+
     def read_since(self, ts: float, limit: int = 1024) -> List[Tuple[float, dict]]:
         with self._lock:
-            return [(t, e) for t, e in self._events if t > ts][:limit]
+            return self._take_since(self._events, ts, limit)
 
     def wait_since(self, ts: float, timeout: float = 10.0,
                    limit: int = 1024) -> List[Tuple[float, dict]]:
@@ -58,7 +72,7 @@ class LogBuffer:
         deadline = time.time() + timeout
         with self._lock:
             while not self._closed:
-                got = [(t, e) for t, e in self._events if t > ts][:limit]
+                got = self._take_since(self._events, ts, limit)
                 if got:
                     return got
                 remaining = deadline - time.time()
@@ -75,13 +89,19 @@ class LogBuffer:
 
 def event_notification(old, new, delete_chunks: bool) -> dict:
     """Build the EventNotification payload
-    (reference filer_pb.EventNotification, filer_notify.go:16-60)."""
+    (reference filer_pb.EventNotification, filer_notify.go:16-60).
+    Entries go out in full wire shape so a replication sink can recreate
+    them faithfully (mime, mode, chunks, ...)."""
 
     def enc(e):
         if e is None:
             return None
-        return {"path": e.full_path, "isDirectory": e.is_directory,
-                "chunks": [c.to_dict() for c in e.chunks]}
+        from .entry import entry_to_wire
+        d = entry_to_wire(e)
+        # kept for pre-wire consumers of the event stream
+        d["path"] = e.full_path
+        d["isDirectory"] = e.is_directory
+        return d
 
     return {
         "oldEntry": enc(old),

@@ -64,3 +64,42 @@ def read_chunked(chunks: List[FileChunk], offset: int, size: int,
         start = v.logical_offset - offset
         out[start:start + len(data)] = data
     return bytes(out)
+
+
+def stream_chunked(chunks: List[FileChunk], fetch, out) -> int:
+    """Write the whole logical file into file-like `out`, one chunk view
+    at a time — bounded memory regardless of file size (the RAM-bound
+    alternative to read_chunked for replication and export paths). Gaps
+    between chunks write as zeros. Returns total bytes written."""
+    from .filechunks import total_size
+    size = total_size(chunks)
+    views = view_from_chunks(chunks, 0, size)
+    pos = 0
+    for v in views:
+        if v.logical_offset > pos:
+            _write_zeros(out, v.logical_offset - pos)
+            pos = v.logical_offset
+        if v.cipher_key or v.is_compressed:
+            blob = fetch(v.fid, 0, -1)
+            if v.cipher_key:
+                from ..util import decrypt
+                blob = decrypt(blob, v.cipher_key)
+            if v.is_compressed:
+                from ..util import gunzip_data
+                blob = gunzip_data(blob)
+            data = blob[v.offset:v.offset + v.size]
+        else:
+            data = fetch(v.fid, v.offset, v.size)
+        out.write(data)
+        pos += len(data)
+    if pos < size:
+        _write_zeros(out, size - pos)
+        pos = size
+    return pos
+
+
+def _write_zeros(out, n: int, block: int = 1 << 20):
+    zeros = b"\x00" * min(n, block)
+    while n > 0:
+        out.write(zeros[:min(n, block)])
+        n -= block

@@ -1,0 +1,164 @@
+"""Replication sinks (reference weed/replication/sink/).
+
+FilerSink replicates into another filer cluster (the reference's
+filersink, the only sink with full fidelity there too); S3Sink writes
+objects to any S3-compatible endpoint through the same SigV4 client the
+tier backend uses. GCS/Azure/B2 exist for config parity but raise at
+construction — their SDKs are not in this build.
+"""
+
+from __future__ import annotations
+
+import io
+import posixpath
+from typing import Optional
+
+from ..server.http_util import HttpError, post_multipart_file
+
+
+class SinkError(Exception):
+    pass
+
+
+def _file_and_size(data):
+    """Sinks take bytes (tests, small entries) or a (fileobj, size)
+    pair (the replicator's spooled stream)."""
+    if isinstance(data, (bytes, bytearray)):
+        return io.BytesIO(data), len(data)
+    return data
+
+
+class ReplicationSink:
+    kind = "?"
+
+    def create_entry(self, key: str, entry: dict, data: bytes):
+        raise NotImplementedError
+
+    def update_entry(self, key: str, old: dict, new: dict, data):
+        """Default: replace (reference sinks mostly delete+create).
+        Directory updates are metadata-only — a recursive delete here
+        would wipe the replicated subtree."""
+        if old.get("IsDirectory") and new.get("IsDirectory"):
+            self.create_entry(key, new, data)
+            return
+        self.delete_entry(key, old.get("IsDirectory", False))
+        self.create_entry(key, new, data)
+
+    def delete_entry(self, key: str, is_directory: bool):
+        raise NotImplementedError
+
+
+class FilerSink(ReplicationSink):
+    """Write entries into a target filer over its public HTTP surface —
+    uploads re-chunk on the target cluster, so the two clusters share
+    nothing but this sink's HTTP calls."""
+
+    kind = "filer"
+
+    def __init__(self, filer_url: str, target_dir: str = "/"):
+        from ..filer.filer_client import FilerClient
+        self.filer_url = filer_url
+        self.target_dir = "/" + target_dir.strip("/")
+        self.client = FilerClient(filer_url)
+
+    def _path(self, key: str) -> str:
+        return posixpath.normpath(
+            posixpath.join(self.target_dir, key.lstrip("/")))
+
+    def create_entry(self, key: str, entry: dict, data):
+        path = self._path(key)
+        if entry.get("IsDirectory"):
+            self.client.mkdir(path)
+            return
+        mime = entry.get("Mime") or "application/octet-stream"
+        name = posixpath.basename(path) or "file"
+        fileobj, size = _file_and_size(data)
+        try:
+            post_multipart_file(f"http://{self.filer_url}{path}",
+                                name, fileobj, size, content_type=mime)
+        except HttpError as e:
+            raise SinkError(f"filer sink create {path}: {e}") from None
+
+    def delete_entry(self, key: str, is_directory: bool):
+        path = self._path(key)
+        try:
+            self.client.delete_entry(path, recursive=is_directory,
+                                     ignore_recursive_error=True)
+        except HttpError as e:
+            if e.status != 404:
+                raise SinkError(
+                    f"filer sink delete {path}: {e}") from None
+
+
+class S3Sink(ReplicationSink):
+    """Replicate files as objects into an S3 bucket (reference s3sink)."""
+
+    kind = "s3"
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str = "",
+                 secret_key: str = "", directory: str = "",
+                 region: str = "us-east-1"):
+        from ..storage.backend import S3Backend
+        self.s3 = S3Backend("replication", endpoint, bucket,
+                            access_key=access_key, secret_key=secret_key,
+                            region=region)
+        self.directory = directory.strip("/")
+
+    def _key(self, key: str) -> str:
+        key = key.lstrip("/")
+        return f"{self.directory}/{key}" if self.directory else key
+
+    def create_entry(self, key: str, entry: dict, data):
+        if entry.get("IsDirectory"):
+            return                     # S3 has no directories
+        from ..storage.backend import BackendError
+        try:
+            self.s3._request("PUT", self._key(key), _file_and_size(data))
+        except BackendError as e:
+            raise SinkError(str(e)) from None
+
+    def delete_entry(self, key: str, is_directory: bool):
+        if is_directory:
+            return
+        from ..storage.backend import BackendError
+        try:
+            self.s3.delete(self._key(key))
+        except BackendError as e:
+            if "404" not in str(e) and "NoSuchKey" not in str(e):
+                raise SinkError(str(e)) from None
+
+
+class _UnavailableSink(ReplicationSink):
+    """Config-compatible placeholder for sinks whose cloud SDKs are not
+    in this build (reference gcssink/azuresink/b2sink)."""
+
+    def __init__(self, *a, **kw):
+        raise SinkError(
+            f"{self.kind} sink requires its cloud SDK, which is not "
+            f"available in this build; use the filer or s3 sink")
+
+
+class GcsSink(_UnavailableSink):
+    kind = "gcs"
+
+
+class AzureSink(_UnavailableSink):
+    kind = "azure"
+
+
+class B2Sink(_UnavailableSink):
+    kind = "b2"
+
+
+_SINKS = {"filer": FilerSink, "s3": S3Sink, "gcs": GcsSink,
+          "azure": AzureSink, "b2": B2Sink}
+
+
+def make_sink(cfg: dict) -> ReplicationSink:
+    """cfg = {"type": "filer", ...kwargs} (reference replication.toml
+    [sink.<type>] sections)."""
+    kind = cfg.get("type")
+    if kind not in _SINKS:
+        raise SinkError(f"unknown sink type {kind!r}")
+    kwargs = {k: v for k, v in cfg.items() if k != "type"}
+    return _SINKS[kind](**kwargs)

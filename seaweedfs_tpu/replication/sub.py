@@ -1,0 +1,76 @@
+"""EventSubscriber — follow a filer's metadata event stream.
+
+Reference `weed watch` / filer_pb.SubscribeMetadata: long-polls the
+filer's /filer/events endpoint, yielding (ts, event) in order and
+resuming from the last seen timestamp.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+from typing import Iterator, Tuple
+
+from ..server.http_util import HttpError, get_json
+
+
+class EventSubscriber:
+    def __init__(self, filer_url: str, since: float = 0.0,
+                 poll_timeout: float = 10.0):
+        self.filer_url = filer_url
+        self.since = since
+        self.poll_timeout = poll_timeout
+        self.stopped = False
+
+    def poll_once(self, advance: bool = True):
+        """One long-poll; returns the (possibly empty) event batch. With
+        advance=False the cursor stays put — callers that might fail to
+        apply the batch (a replicator with its sink down) commit() only
+        after the whole batch landed, so nothing is ever skipped."""
+        q = urllib.parse.urlencode(
+            {"since": repr(self.since), "timeout": self.poll_timeout})
+        out = get_json(f"http://{self.filer_url}/filer/events?{q}",
+                       timeout=self.poll_timeout + 30)
+        events = out.get("events", [])
+        if events and advance:
+            self.since = max(e["ts"] for e in events)
+        return events
+
+    def commit(self, events):
+        """Advance the cursor past an applied batch."""
+        if events:
+            self.since = max(self.since,
+                             max(e["ts"] for e in events))
+
+    def follow(self) -> Iterator[Tuple[float, dict]]:
+        """Yield (ts, event) forever (until .stopped is set). Transient
+        filer outages back off and resume from the cursor."""
+        import time
+        while not self.stopped:
+            try:
+                batch = self.poll_once()
+            except HttpError:
+                time.sleep(1.0)
+                continue
+            for e in batch:
+                yield e["ts"], e["event"]
+
+
+def format_event(ts: float, event: dict) -> str:
+    """One-line rendering for `weed-tpu watch`."""
+    old = event.get("oldEntry")
+    new = event.get("newEntry")
+    if old and new:
+        kind = "update" if old.get("FullPath") == new.get("FullPath") \
+            else "rename"
+    elif new:
+        kind = "create"
+    elif old:
+        kind = "delete"
+    else:
+        kind = "noop"
+    path = (new or old or {}).get("FullPath", "?")
+    extra = ""
+    if kind == "rename":
+        extra = f" <- {old.get('FullPath')}"
+    return f"{ts:.6f} {kind:7s} {path}{extra}"

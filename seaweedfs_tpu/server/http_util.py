@@ -354,3 +354,59 @@ def post_multipart(url: str, filename: str, data: bytes,
     all_headers.update(headers or {})
     out = http_call("POST", url, body, all_headers, timeout)
     return json.loads(out or b"{}")
+
+
+class _ChainReader:
+    """read()-able concatenation of byte segments and file objects with
+    a known total length — streams a multipart body without building it."""
+
+    def __init__(self, parts):
+        self.parts = []
+        self.len = 0
+        import io as _io
+        for p in parts:
+            if isinstance(p, bytes):
+                self.parts.append(_io.BytesIO(p))
+                self.len += len(p)
+            else:
+                f, size = p
+                self.parts.append(f)
+                self.len += size
+        self.i = 0
+
+    def __len__(self):
+        return self.len
+
+    def read(self, n: int = -1) -> bytes:
+        out = b""
+        while self.i < len(self.parts):
+            chunk = self.parts[self.i].read(n if n >= 0 else (1 << 20))
+            if chunk:
+                out += chunk
+                if n >= 0:
+                    return out
+            else:
+                self.i += 1
+        return out
+
+
+def post_multipart_file(url: str, filename: str, fileobj, size: int,
+                        content_type: str = "application/octet-stream",
+                        timeout: float = 600.0,
+                        headers: dict = None) -> dict:
+    """post_multipart for file-likes: the body streams, so a
+    volume-sized upload never transits RAM whole."""
+    boundary = uuid.uuid4().hex
+    prologue = (f"--{boundary}\r\n"
+                f'Content-Disposition: form-data; name="file"; '
+                f'filename="{filename or "file"}"\r\n'
+                f"Content-Type: {content_type}\r\n\r\n").encode()
+    epilogue = f"\r\n--{boundary}--\r\n".encode()
+    body = _ChainReader([prologue, (fileobj, size), epilogue])
+    all_headers = {
+        "Content-Type": f"multipart/form-data; boundary={boundary}",
+        "Content-Length": str(len(body)),
+    }
+    all_headers.update(headers or {})
+    out = http_call("POST", url, body, all_headers, timeout)
+    return json.loads(out or b"{}")

@@ -184,13 +184,26 @@ def volume_tier_upload(env: CommandEnv, args: List[str]):
     # doVolumeTierUpload): replica .dat files are not byte-identical in
     # general, so two uploaders racing on one backend key would corrupt
     # the tier for whichever .idx loses
+    frozen = []
     for r in replicas:
-        env.node_post(r["url"], f"/admin/volume/readonly?volume={vid}")
+        if not r.get("read_only"):
+            env.node_post(r["url"],
+                          f"/admin/volume/readonly?volume={vid}")
+            frozen.append(r["url"])
     keep = "true" if flags.get("keepLocalDatFile") else "false"
     r = replicas[0]
-    info = env.node_post(
-        r["url"], f"/admin/volume/tier_upload?volume={vid}"
-                  f"&dest={dest}&keep_local={keep}")
+    try:
+        info = env.node_post(
+            r["url"], f"/admin/volume/tier_upload?volume={vid}"
+                      f"&dest={dest}&keep_local={keep}")
+    except Exception:
+        # thaw exactly the replicas this command froze — a failed
+        # upload must not leave the volume permanently unwritable
+        for url in frozen:
+            env.node_post(
+                url, f"/admin/volume/readonly?volume={vid}"
+                     f"&readonly=false")
+        raise
     env.write(f"volume {vid} @ {r['url']}: .dat -> "
               f"{info['remote']['backend']}/{info['remote']['key']} "
               f"({info['remote']['file_size']} bytes)")

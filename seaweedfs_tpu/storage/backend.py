@@ -243,6 +243,10 @@ class S3Backend(BackendStorage):
                 f"{e.read().decode('utf-8', 'replace')[:200]}") from None
         except urllib.error.URLError as e:
             raise BackendError(f"{method} {url}: {e}") from None
+        except OSError as e:
+            # mid-stream timeout/reset after headers — urllib raises the
+            # raw socket error, not URLError
+            raise BackendError(f"{method} {url}: {e}") from None
 
     # -- tier ops ---------------------------------------------------------
     def upload_file(self, path: str, key: str) -> int:

@@ -94,17 +94,20 @@ def download_dat(volume: Volume, delete_remote: bool = False) -> dict:
     remote = info["remote"]
     backend = get_backend(remote["backend"])
     tmp = volume.dat_path + ".tierdl"
-    got = backend.download_file(remote["key"], tmp)
-    if got != remote["file_size"]:
-        os.remove(tmp)
-        raise VolumeError(
-            f"tier download size mismatch: {got} != "
-            f"{remote['file_size']}")
-    with volume.lock:
-        os.replace(tmp, volume.dat_path)
-        volume.dat.close()
-        volume.dat = open(volume.dat_path, "r+b")
-        os.remove(vif_path(volume))
+    try:
+        got = backend.download_file(remote["key"], tmp)
+        if got != remote["file_size"]:
+            raise VolumeError(
+                f"tier download size mismatch: {got} != "
+                f"{remote['file_size']}")
+        with volume.lock:
+            os.replace(tmp, volume.dat_path)
+            volume.dat.close()
+            volume.dat = open(volume.dat_path, "r+b")
+            os.remove(vif_path(volume))
+    finally:
+        if os.path.exists(tmp):    # failed pull leaves no junk behind
+            os.remove(tmp)
     if delete_remote:
         backend.delete(remote["key"])
     return {"volume": volume.id, "size": got}

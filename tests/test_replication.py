@@ -1,0 +1,254 @@
+"""Async replication (reference weed/replication/): event subscriber,
+replicator routing, filer->filer and filer->S3 sinks, end to end."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.replication import (EventSubscriber, FilerSource,
+                                       Replicator, SinkError, make_sink)
+from seaweedfs_tpu.replication.sub import format_event
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import http_call, post_multipart
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+class RecordingSink:
+    kind = "recording"
+
+    def __init__(self):
+        self.ops = []
+
+    @staticmethod
+    def _bytes(data):
+        if isinstance(data, (bytes, bytearray)):
+            return bytes(data)
+        fileobj, size = data          # the replicator's spooled stream
+        return fileobj.read(size)
+
+    def create_entry(self, key, entry, data):
+        self.ops.append(("create", key, self._bytes(data)))
+
+    def update_entry(self, key, old, new, data):
+        self.ops.append(("update", key, self._bytes(data)))
+
+    def delete_entry(self, key, is_directory):
+        self.ops.append(("delete", key, is_directory))
+
+
+def _cluster(tmp_path, sub):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    vol = VolumeServer(port=0, directories=[str(tmp_path / sub)],
+                       master_url=master.url, pulse_seconds=1,
+                       max_volume_counts=[20], ec_backend="numpy").start()
+    filer = FilerServer(port=0, master_url=master.url).start()
+    return master, vol, filer
+
+
+@pytest.fixture
+def stack(tmp_path):
+    src = _cluster(tmp_path, "src")
+    dst = _cluster(tmp_path, "dst")
+    yield src, dst
+    for group in (src, dst):
+        for s in reversed(group):
+            s.stop()
+
+
+def test_replicator_routing(stack):
+    (master, vol, filer), _ = stack
+    source = FilerSource(filer.url, master.url, path_prefix="/docs")
+    sink = RecordingSink()
+    rep = Replicator(source, sink)
+
+    post_multipart(f"http://{filer.url}/docs/a.txt", "a.txt", b"hello")
+    post_multipart(f"http://{filer.url}/other/b.txt", "b.txt", b"nope")
+    sub = EventSubscriber(filer.url)
+    actions = [rep.replicate(e["event"]) for e in sub.poll_once()]
+    assert "create" in actions
+    assert ("create", "a.txt", b"hello") in sink.ops
+    # the /other write must have been filtered out
+    assert not any("b.txt" in str(op) for op in sink.ops)
+
+    http_call("DELETE", f"http://{filer.url}/docs/a.txt")
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    assert ("delete", "a.txt", False) in sink.ops
+
+
+def test_rename_routes_as_delete_create(stack):
+    (master, vol, filer), _ = stack
+    source = FilerSource(filer.url, master.url, path_prefix="/d")
+    sink = RecordingSink()
+    rep = Replicator(source, sink)
+    post_multipart(f"http://{filer.url}/d/old.bin", "old.bin", b"data1")
+    sub = EventSubscriber(filer.url)
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    from seaweedfs_tpu.filer.filer_client import FilerClient
+    FilerClient(filer.url).rename_entry("/d/old.bin", "/d/new.bin")
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    assert ("delete", "old.bin", False) in sink.ops
+    assert ("create", "new.bin", b"data1") in sink.ops
+
+
+def test_filer_to_filer_end_to_end(stack):
+    (s_master, s_vol, s_filer), (d_master, d_vol, d_filer) = stack
+    source = FilerSource(s_filer.url, s_master.url, path_prefix="/data")
+    sink = make_sink({"type": "filer", "filer_url": d_filer.url,
+                      "target_dir": "/mirror"})
+    rep = Replicator(source, sink)
+    sub = EventSubscriber(s_filer.url)
+
+    payload = b"replicate-me" * 500
+    post_multipart(f"http://{s_filer.url}/data/sub/file.bin", "file.bin",
+                   payload)
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    got = http_call("GET", f"http://{d_filer.url}/mirror/sub/file.bin")
+    assert got == payload
+
+    # update
+    post_multipart(f"http://{s_filer.url}/data/sub/file.bin", "file.bin",
+                   b"v2-content")
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    assert http_call(
+        "GET", f"http://{d_filer.url}/mirror/sub/file.bin") == \
+        b"v2-content"
+
+    # delete
+    http_call("DELETE", f"http://{s_filer.url}/data/sub/file.bin")
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    import urllib.error
+    from seaweedfs_tpu.server.http_util import HttpError
+    with pytest.raises(HttpError):
+        http_call("GET", f"http://{d_filer.url}/mirror/sub/file.bin")
+
+
+def test_filer_to_s3_sink(stack, tmp_path):
+    (s_master, s_vol, s_filer), (d_master, d_vol, d_filer) = stack
+    from seaweedfs_tpu.s3.auth import Iam, Identity
+    from seaweedfs_tpu.s3.s3_server import S3ApiServer
+    ak, sk = "REPKEY", "REPSECRET"
+    s3 = S3ApiServer(d_filer.filer, d_master.url, port=0,
+                     iam=Iam([Identity("rep", ak, sk)])).start()
+    try:
+        from seaweedfs_tpu.storage.backend import S3Backend
+        boot = S3Backend("boot", f"http://{s3.url}", "rep-bucket",
+                         access_key=ak, secret_key=sk)
+        boot._request("PUT", "")        # create bucket
+        source = FilerSource(s_filer.url, s_master.url,
+                             path_prefix="/data")
+        sink = make_sink({"type": "s3", "endpoint": f"http://{s3.url}",
+                          "bucket": "rep-bucket", "access_key": ak,
+                          "secret_key": sk, "directory": "backup"})
+        rep = Replicator(source, sink)
+        sub = EventSubscriber(s_filer.url)
+        post_multipart(f"http://{s_filer.url}/data/obj.bin", "obj.bin",
+                       b"s3-bound-bytes")
+        for e in sub.poll_once():
+            rep.replicate(e["event"])
+        assert boot.read_range("backup/obj.bin", 0, 14) == \
+            b"s3-bound-bytes"
+    finally:
+        s3.stop()
+
+
+def test_unavailable_sinks_raise_cleanly():
+    for kind in ("gcs", "azure", "b2"):
+        with pytest.raises(SinkError):
+            make_sink({"type": kind})
+    with pytest.raises(SinkError):
+        make_sink({"type": "ftp"})
+
+
+def test_subscriber_cursor_advances(stack):
+    (master, vol, filer), _ = stack
+    sub = EventSubscriber(filer.url)
+    post_multipart(f"http://{filer.url}/x/1.txt", "1.txt", b"one")
+    batch1 = sub.poll_once()
+    assert batch1
+    # same events do not come back on the next poll
+    post_multipart(f"http://{filer.url}/x/2.txt", "2.txt", b"two")
+    batch2 = sub.poll_once()
+    paths = [(e["event"].get("newEntry") or {}).get("FullPath", "")
+             for e in batch2]
+    assert any(p.endswith("2.txt") for p in paths)
+    assert not any(p.endswith("1.txt") for p in paths)
+
+
+def test_log_buffer_never_splits_same_ts_run():
+    from seaweedfs_tpu.filer.log_buffer import LogBuffer
+    lb = LogBuffer(flush_interval=3600)
+    for i in range(5):
+        lb.append({"n": i}, ts=1.0)
+    lb.append({"n": 99}, ts=2.0)
+    got = lb.read_since(0.0, limit=3)
+    # the limit lands inside the ts=1.0 run: the whole run must come out
+    assert [e["n"] for _, e in got] == [0, 1, 2, 3, 4]
+    rest = lb.read_since(1.0)
+    assert [e["n"] for _, e in rest] == [99]
+    lb.close()
+
+
+def test_subscriber_commit_only_after_apply(stack):
+    (master, vol, filer), _ = stack
+    sub = EventSubscriber(filer.url)
+    post_multipart(f"http://{filer.url}/c/f.txt", "f.txt", b"x")
+    batch = sub.poll_once(advance=False)
+    assert batch and sub.since == 0.0     # cursor untouched
+    again = sub.poll_once(advance=False)
+    assert [e["ts"] for e in again] == [e["ts"] for e in batch]
+    sub.commit(batch)
+    assert sub.since == max(e["ts"] for e in batch)
+    assert sub.poll_once() == []          # drained after commit
+
+
+def test_directory_update_does_not_wipe_subtree(stack):
+    (s_master, s_vol, s_filer), (d_master, d_vol, d_filer) = stack
+    source = FilerSource(s_filer.url, s_master.url, path_prefix="/data")
+    sink = make_sink({"type": "filer", "filer_url": d_filer.url,
+                      "target_dir": "/mirror"})
+    rep = Replicator(source, sink)
+    sub = EventSubscriber(s_filer.url)
+    post_multipart(f"http://{s_filer.url}/data/dir/keep.bin", "keep.bin",
+                   b"precious")
+    for e in sub.poll_once():
+        rep.replicate(e["event"])
+    assert http_call("GET", f"http://{d_filer.url}/mirror/dir/keep.bin") \
+        == b"precious"
+    # metadata-only update on the directory entry must not touch files
+    dir_event = {
+        "oldEntry": {"FullPath": "/data/dir", "IsDirectory": True,
+                     "chunks": []},
+        "newEntry": {"FullPath": "/data/dir", "IsDirectory": True,
+                     "chunks": []},
+    }
+    assert rep.replicate(dir_event) == "update"
+    assert http_call("GET", f"http://{d_filer.url}/mirror/dir/keep.bin") \
+        == b"precious"
+
+
+def test_empty_directory_replicates(stack):
+    (s_master, s_vol, s_filer), (d_master, d_vol, d_filer) = stack
+    source = FilerSource(s_filer.url, s_master.url, path_prefix="/data")
+    sink = make_sink({"type": "filer", "filer_url": d_filer.url,
+                      "target_dir": "/mirror"})
+    rep = Replicator(source, sink)
+    rep.replicate({"oldEntry": None,
+                   "newEntry": {"FullPath": "/data/emptydir",
+                                "IsDirectory": True, "chunks": []}})
+    from seaweedfs_tpu.filer.filer_client import FilerClient
+    e = FilerClient(d_filer.url).find_entry("/mirror/emptydir")
+    assert e.is_directory
+
+
+def test_format_event():
+    line = format_event(12.5, {"newEntry": {"FullPath": "/a/b"},
+                               "oldEntry": None})
+    assert "create" in line and "/a/b" in line
