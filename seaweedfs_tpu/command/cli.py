@@ -157,8 +157,9 @@ def _filer_master(filer_url: str) -> str:
 
 
 def cmd_shell(args):
+    import seaweedfs_tpu.shell  # noqa: F401  (registers all commands)
     from ..shell.command_env import CommandEnv, run_command
-    env = CommandEnv(args.master)
+    env = CommandEnv(args.master, filer_url=args.filer)
     if args.c:
         run_command(env, args.c)
         return
@@ -287,6 +288,11 @@ def cmd_filer_replicate(args):
         pass
 
 
+def cmd_scaffold(args):
+    from .scaffold import print_scaffold
+    print(print_scaffold(args.config), end="")
+
+
 def cmd_version(args):
     from .. import VERSION
     print(f"seaweedfs_tpu {VERSION}")
@@ -302,6 +308,10 @@ def _wait():
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="weed-tpu")
+    p.add_argument("-v", type=int, default=0,
+                   help="glog verbosity level")
+    p.add_argument("-vmodule", default="",
+                   help="per-module verbosity, e.g. volume_server=3")
     sub = p.add_subparsers(dest="command", required=True)
 
     m = sub.add_parser("master", help="start a master server")
@@ -404,6 +414,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sh = sub.add_parser("shell", help="admin shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-filer", default="",
+                    help="filer host:port for fs.* commands")
     sh.add_argument("-c", default="", help="run one command and exit")
     sh.set_defaults(fn=cmd_shell)
 
@@ -472,6 +484,12 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("-collection", default="")
     cp.set_defaults(fn=cmd_compact)
 
+    sc = sub.add_parser("scaffold", help="print example config files")
+    sc.add_argument("-config", default="replication",
+                    choices=["tier", "s3", "replication", "security",
+                             "notification"])
+    sc.set_defaults(fn=cmd_scaffold)
+
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=cmd_version)
     return p
@@ -479,6 +497,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    from ..util import glog
+    glog.set_verbosity(args.v)
+    if args.vmodule:
+        glog.set_vmodule(args.vmodule)
     args.fn(args)
 
 

@@ -1,0 +1,90 @@
+"""`weed-tpu scaffold` — print commented example configs.
+
+Reference weed/command/scaffold.go prints the five TOML templates
+(security/master/filer/notification/replication); this build's configs
+are JSON files passed via flags, so the scaffold prints annotated JSON
+examples for each.
+"""
+
+SCAFFOLDS = {
+    "tier": """\
+// volume/server -tierConfig: remote backends for volume.tier.upload
+// (reference master.toml [storage.backend.<kind>.<id>])
+{
+  "s3": {
+    "default": {
+      "endpoint": "http://s3.example.com:8333",
+      "bucket": "volume-tier",
+      "access_key": "ACCESSKEY",
+      "secret_key": "SECRETKEY",
+      "region": "us-east-1"
+    }
+  },
+  "dir": {
+    "cold": {"path": "/mnt/cold-disk/tier"}
+  }
+}
+""",
+    "s3": """\
+// s3 / filer -s3Config: IAM identities and per-identity actions
+// (reference s3 config shape, weed/s3api/auth_credentials.go)
+{
+  "identities": [
+    {
+      "name": "admin",
+      "credentials": [
+        {"accessKey": "ACCESSKEY", "secretKey": "SECRETKEY"}
+      ],
+      "actions": ["Admin", "Read", "Write", "List", "Tagging"]
+    },
+    {
+      "name": "readonly",
+      "credentials": [
+        {"accessKey": "ROKEY", "secretKey": "ROSECRET"}
+      ],
+      "actions": ["Read", "List"]
+    }
+  ]
+}
+""",
+    "replication": """\
+// filer.replicate -config: follow one filer's events into a sink
+// (reference replication.toml [source.filer] + [sink.*])
+{
+  "source": {
+    "filer": "127.0.0.1:8888",
+    "master": "127.0.0.1:9333",
+    "path": "/buckets"
+  },
+// sink alternatives: "type": "filer" (below) or "type": "s3" with
+// endpoint/bucket/access_key/secret_key/directory keys
+  "sink": {
+    "type": "filer",
+    "filer_url": "remote-filer:8888",
+    "target_dir": "/backup"
+  }
+}
+""",
+    "security": """\
+// security knobs (flags, not a file — listed here for discovery):
+//   -jwtKey <secret>     master/volume/filer: JWT-protected writes
+//                        (reference security.toml jwt.signing.key)
+//   -whiteList <cidrs>   volume server: IP allowlist
+//                        (reference guard white_list)
+{}
+""",
+    "notification": """\
+// filer notification publisher (reference notification.toml):
+// configured programmatically via
+// seaweedfs_tpu.notification.make_publisher(name, **options);
+// built-ins: "log", "memory" (kafka/sqs/pubsub are gated stubs)
+{}
+""",
+}
+
+
+def print_scaffold(name: str) -> str:
+    if name not in SCAFFOLDS:
+        raise SystemExit(
+            f"unknown config {name!r}; have {sorted(SCAFFOLDS)}")
+    return SCAFFOLDS[name]

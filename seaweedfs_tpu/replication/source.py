@@ -10,7 +10,7 @@ from __future__ import annotations
 import tempfile
 
 from ..filer.entry import FileChunk
-from ..filer.stream import default_fetcher, read_chunked, stream_chunked
+from ..filer.stream import default_fetcher, stream_chunked
 
 # entries at most this big replicate via RAM; larger ones spool to disk
 SPOOL_MAX_BYTES = 32 << 20
@@ -36,14 +36,6 @@ class FilerSource:
             return ""
         return path[len(self.path_prefix):] if \
             path.startswith(self.path_prefix) else path.lstrip("/")
-
-    def read_entry_data(self, entry: dict) -> bytes:
-        """Materialize an event entry's content from its chunk list."""
-        chunks = [FileChunk.from_dict(c) for c in entry.get("chunks", [])]
-        if not chunks:
-            return b""
-        total = max(c.offset + c.size for c in chunks)
-        return read_chunked(chunks, 0, total, self._fetch)
 
     def open_entry_data(self, entry: dict):
         """(fileobj, size) for an entry's content — spooled to disk past

@@ -54,7 +54,15 @@ class FilerServer:
         router.add("POST", "/filer/meta/rename", self.meta_rename)
         router.add("POST", "/filer/meta/delete_chunks",
                    self.meta_delete_chunks)
+        router.add("GET", "/metrics", self.metrics_handler)
         router.set_fallback(self.data_handler)
+        from ..stats.metrics import (FILER_REQUEST_COUNTER,
+                                     FILER_REQUEST_HISTOGRAM)
+
+        def observe(label, seconds, ok):
+            FILER_REQUEST_COUNTER.inc(label if ok else label + " error")
+            FILER_REQUEST_HISTOGRAM.observe(seconds, label)
+        router.observe = observe
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
         self.host = host
@@ -118,6 +126,11 @@ class FilerServer:
                 pass
 
     # -- handlers -----------------------------------------------------------
+
+    def metrics_handler(self, req: Request):
+        from ..stats.metrics import FILER_GATHER
+        return Response(FILER_GATHER.render().encode(),
+                        content_type="text/plain; version=0.0.4")
 
     def status_handler(self, req: Request):
         return {"version": "seaweedfs-tpu", "master": self.master_url}

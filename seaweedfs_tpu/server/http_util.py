@@ -100,6 +100,9 @@ class Router:
         self.fallback: Optional[Callable] = None
         # runs before every handler (guard checks); may raise HttpError
         self.before: Optional[Callable] = None
+        # observe(op_label, seconds, ok) after every request — the
+        # servers plug their metric registries in here
+        self.observe: Optional[Callable] = None
 
     def add(self, method: str, path: str, fn: Callable,
             prefix: bool = False):
@@ -109,15 +112,38 @@ class Router:
         self.fallback = fn
 
     def dispatch(self, req: Request):
+        if self.observe is None:
+            return self._dispatch(req)
+        import time as _time
+        t0 = _time.monotonic()
+        label = None
+        try:
+            label, fn = self._route(req)
+            out = fn(req)
+            self.observe(label, _time.monotonic() - t0, True)
+            return out
+        except Exception:
+            # label stays low-cardinality: the raw path would mint a
+            # new Prometheus series per fid/404 probe
+            self.observe(label or f"{req.method} unrouted",
+                         _time.monotonic() - t0, False)
+            raise
+
+    def _dispatch(self, req: Request):
+        label, fn = self._route(req)
+        return fn(req)
+
+    def _route(self, req: Request):
+        """(metric label, handler) for a request; raises 404."""
         if self.before is not None:
             self.before(req)
         for method, path, prefix, fn in self.routes:
             if method != "*" and method != req.method:
                 continue
             if (prefix and req.path.startswith(path)) or req.path == path:
-                return fn(req)
+                return f"{method} {path}", fn
         if self.fallback is not None:
-            return self.fallback(req)
+            return f"{req.method} data", self.fallback
         raise HttpError(404, f"no route for {req.method} {req.path}")
 
 

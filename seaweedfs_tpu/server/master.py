@@ -49,10 +49,22 @@ class MasterServer:
         router.add("*", "/cluster/ec_lookup", self.ec_lookup)
         router.add("*", "/cluster/ec_status", self.ec_status)
         router.add("*", "/cluster/volumes", self.cluster_volumes)
+        router.add("GET", "/metrics", self.metrics_handler)
+        from ..stats.metrics import MASTER_REQUEST_COUNTER
+
+        def observe(label, seconds, ok):
+            MASTER_REQUEST_COUNTER.inc(label if ok else label + " error")
+        router.observe = observe
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._stop = threading.Event()
+
+    def metrics_handler(self, req: Request):
+        from ..stats.metrics import MASTER_GATHER
+        from .http_util import Response
+        return Response(MASTER_GATHER.render().encode(),
+                        content_type="text/plain; version=0.0.4")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

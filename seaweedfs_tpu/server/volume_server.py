@@ -64,8 +64,16 @@ class VolumeServer:
         router.add("GET", "/admin/volume/tail", self.admin_volume_tail)
         router.add("POST", "/admin/volume/tail_receive",
                    self.admin_volume_tail_receive)
+        router.add("GET", "/metrics", self.metrics_handler)
         router.set_fallback(self.data_handler)
         router.before = self._guard_check
+        from ..stats.metrics import (VOLUME_REQUEST_COUNTER,
+                                     VOLUME_REQUEST_HISTOGRAM)
+
+        def observe(label, seconds, ok):
+            VOLUME_REQUEST_COUNTER.inc(label if ok else label + " error")
+            VOLUME_REQUEST_HISTOGRAM.observe(seconds, label)
+        router.observe = observe
 
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
@@ -107,11 +115,14 @@ class VolumeServer:
         return f"{self.host}:{self.port}"
 
     def _heartbeat_loop(self):
+        from ..util import glog
         while not self._stop.wait(self.pulse_seconds):
             try:
                 self.heartbeat_once()
-            except HttpError:
-                pass
+                glog.V(4).infof("heartbeat to %s ok", self.master_url)
+            except HttpError as e:
+                glog.V(0).infof("heartbeat to %s failed: %s",
+                                self.master_url, e)
 
     def heartbeat_once(self):
         resp = post_json(f"http://{self.master_url}/cluster/heartbeat",
@@ -122,6 +133,41 @@ class VolumeServer:
     # -- admin -------------------------------------------------------------
     def status(self, req: Request):
         return self.store.status()
+
+    def metrics_handler(self, req: Request):
+        """Prometheus text exposition; volume/disk gauges refresh from
+        the store on scrape (the reference sets them during heartbeat
+        collection, store.go:232)."""
+        from ..stats.metrics import (VOLUME_COUNT_GAUGE,
+                                     VOLUME_DISK_GAUGE,
+                                     VOLUME_SERVER_GATHER)
+        # aggregate across ALL locations before setting, and zero out
+        # series for collections that disappeared so a scrape never
+        # shows one directory's numbers or a stale collection
+        by_coll: Dict[str, list] = {}
+        ec_by_coll: Dict[str, int] = {}
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                agg = by_coll.setdefault(v.collection, [0, 0])
+                agg[0] += 1
+                agg[1] += v.size()
+            for ev in loc.ec_volumes.values():
+                ec_by_coll[ev.collection] = \
+                    ec_by_coll.get(ev.collection, 0) + len(ev.shards)
+        seen = set()
+        for coll, (count, size) in by_coll.items():
+            VOLUME_COUNT_GAUGE.set(count, coll, "normal")
+            VOLUME_DISK_GAUGE.set(size, coll, "normal")
+            seen.add((coll, "normal"))
+        for coll, count in ec_by_coll.items():
+            VOLUME_COUNT_GAUGE.set(count, coll, "ec")
+            seen.add((coll, "ec"))
+        for stale in getattr(self, "_metric_series", set()) - seen:
+            VOLUME_COUNT_GAUGE.set(0, *stale)
+            VOLUME_DISK_GAUGE.set(0, *stale)
+        self._metric_series = seen
+        return Response(VOLUME_SERVER_GATHER.render().encode(),
+                        content_type="text/plain; version=0.0.4")
 
     def admin_assign_volume(self, req: Request):
         vid = int(req.query["volume"])

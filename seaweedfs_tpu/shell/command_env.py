@@ -21,10 +21,29 @@ def command(name: str, help_text: str = ""):
 
 
 class CommandEnv:
-    def __init__(self, master_url: str, out=None):
+    def __init__(self, master_url: str, out=None, filer_url: str = ""):
         self.master_url = master_url
+        self.filer_url = filer_url
+        self.cwd = "/"          # fs.* commands' working directory
         import sys
         self.out = out or sys.stdout
+
+    def filer(self):
+        """FilerClient for fs.* commands (requires shell -filer)."""
+        if not self.filer_url:
+            raise HttpError(400, "no filer configured: start the shell "
+                                 "with -filer <host:port>")
+        from ..filer.filer_client import FilerClient
+        return FilerClient(self.filer_url)
+
+    def resolve(self, path: str) -> str:
+        """Absolute path for an fs.* operand, relative to fs.cd's cwd."""
+        import posixpath
+        if not path:
+            return self.cwd
+        if not path.startswith("/"):
+            path = posixpath.join(self.cwd, path)
+        return posixpath.normpath(path)
 
     def write(self, *args):
         print(*args, file=self.out)
@@ -76,9 +95,38 @@ def run_command(env: CommandEnv, line: str) -> bool:
         fn(env, args)
     except HttpError as e:
         env.write(f"error: {e.status} {e.message or e}")
-    except (ValueError, KeyError) as e:
-        env.write(f"error: {type(e).__name__}: {e}")
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:  # noqa: BLE001 — a REPL must survive any
+        env.write(f"error: {type(e).__name__}: {e}")  # command failure
     return True
+
+
+def parse_flags2(args: List[str], bool_flags=()):
+    """Like parse_flags but keeps positional operands and never lets a
+    known boolean flag swallow the operand after it.
+    '-l /dir' with bool_flags={'l'} -> ({'l': 'true'}, ['/dir'])."""
+    flags: Dict[str, str] = {}
+    ops: List[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-"):
+            key = a.lstrip("-")
+            if "=" in key:
+                k, v = key.split("=", 1)
+                flags[k] = v
+            elif key in bool_flags:
+                flags[key] = "true"
+            elif i + 1 < len(args) and not args[i + 1].startswith("-"):
+                flags[key] = args[i + 1]
+                i += 1
+            else:
+                flags[key] = "true"
+        else:
+            ops.append(a)
+        i += 1
+    return flags, ops
 
 
 def parse_flags(args: List[str]) -> Dict[str, str]:

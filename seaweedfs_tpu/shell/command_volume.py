@@ -185,20 +185,21 @@ def volume_tier_upload(env: CommandEnv, args: List[str]):
     # general, so two uploaders racing on one backend key would corrupt
     # the tier for whichever .idx loses
     frozen = []
-    for r in replicas:
-        if not r.get("read_only"):
-            env.node_post(r["url"],
-                          f"/admin/volume/readonly?volume={vid}")
-            frozen.append(r["url"])
     keep = "true" if flags.get("keepLocalDatFile") else "false"
-    r = replicas[0]
     try:
+        for r in replicas:
+            if not r.get("read_only"):
+                env.node_post(r["url"],
+                              f"/admin/volume/readonly?volume={vid}")
+                frozen.append(r["url"])
+        r = replicas[0]
         info = env.node_post(
             r["url"], f"/admin/volume/tier_upload?volume={vid}"
                       f"&dest={dest}&keep_local={keep}")
     except Exception:
-        # thaw exactly the replicas this command froze — a failed
-        # upload must not leave the volume permanently unwritable
+        # thaw exactly the replicas this command froze — a failure at
+        # any point (a later freeze included) must not leave the
+        # volume permanently unwritable
         for url in frozen:
             env.node_post(
                 url, f"/admin/volume/readonly?volume={vid}"

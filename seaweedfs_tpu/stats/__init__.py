@@ -1,0 +1,6 @@
+"""Metrics (reference weed/stats/metrics.go) — Prometheus-compatible
+counters/gauges/histograms with a text exposition endpoint."""
+
+from .metrics import (Counter, Gauge, Histogram, Registry,  # noqa: F401
+                      VOLUME_SERVER_GATHER, FILER_GATHER, MASTER_GATHER,
+                      start_push_loop)

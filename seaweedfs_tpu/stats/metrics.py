@@ -1,0 +1,228 @@
+"""Prometheus-compatible metrics (reference weed/stats/metrics.go).
+
+The reference registers counters/histograms/gauges into per-role
+gatherers (FilerGather, VolumeServerGather) and pushes them to a
+pushgateway on an interval the master broadcasts; this build exposes the
+same families on a pull `/metrics` endpoint (the modern deployment
+shape) and keeps an optional push loop for parity.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1,
+                    0.3, 1.0, 3.0, 10.0)
+
+
+def _fmt_labels(label_names, label_values) -> str:
+    if not label_names:
+        return ""
+    pairs = ",".join(f'{k}="{v}"' for k, v in
+                     zip(label_names, label_values))
+    return "{" + pairs + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = "",
+                 labels: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+
+    def header(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.kind}"]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_text="", labels=()):
+        super().__init__(name, help_text, labels)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, *label_values, amount: float = 1.0):
+        with self._lock:
+            self._values[label_values] = \
+                self._values.get(label_values, 0.0) + amount
+
+    def value(self, *label_values) -> float:
+        with self._lock:
+            return self._values.get(label_values, 0.0)
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            for lv, v in sorted(self._values.items()):
+                out.append(
+                    f"{self.name}"
+                    f"{_fmt_labels(self.label_names, lv)} {v:g}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text="", labels=()):
+        super().__init__(name, help_text, labels)
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, *label_values):
+        with self._lock:
+            self._values[label_values] = value
+
+    def value(self, *label_values) -> float:
+        with self._lock:
+            return self._values.get(label_values, 0.0)
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            for lv, v in sorted(self._values.items()):
+                out.append(
+                    f"{self.name}"
+                    f"{_fmt_labels(self.label_names, lv)} {v:g}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text="", labels=(),
+                 buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, labels)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._totals: Dict[tuple, int] = {}
+
+    def observe(self, value: float, *label_values):
+        with self._lock:
+            counts = self._counts.setdefault(
+                label_values, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
+            self._sums[label_values] = \
+                self._sums.get(label_values, 0.0) + value
+            self._totals[label_values] = \
+                self._totals.get(label_values, 0) + 1
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            for lv in sorted(self._counts):
+                cumulative = 0
+                for bound, c in zip(self.buckets, self._counts[lv]):
+                    cumulative += c
+                    labels = _fmt_labels(
+                        self.label_names + ("le",),
+                        lv + (f"{bound:g}",))
+                    out.append(f"{self.name}_bucket{labels} {cumulative}")
+                labels = _fmt_labels(self.label_names + ("le",),
+                                     lv + ("+Inf",))
+                out.append(
+                    f"{self.name}_bucket{labels} {self._totals[lv]}")
+                base = _fmt_labels(self.label_names, lv)
+                out.append(f"{self.name}_sum{base} "
+                           f"{self._sums[lv]:g}")
+                out.append(f"{self.name}_count{base} "
+                           f"{self._totals[lv]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name, help_text="", labels=()) -> Counter:
+        return self.register(Counter(name, help_text, labels))
+
+    def gauge(self, name, help_text="", labels=()) -> Gauge:
+        return self.register(Gauge(name, help_text, labels))
+
+    def histogram(self, name, help_text="", labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_text, labels, buckets))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# -- per-role gatherers (reference metrics.go:14-107) -----------------------
+
+MASTER_GATHER = Registry()
+VOLUME_SERVER_GATHER = Registry()
+FILER_GATHER = Registry()
+
+VOLUME_REQUEST_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_request_total",
+    "Counter of volume server requests.", labels=("type",))
+VOLUME_REQUEST_HISTOGRAM = VOLUME_SERVER_GATHER.histogram(
+    "SeaweedFS_volumeServer_request_seconds",
+    "Bucketed histogram of volume server request processing time.",
+    labels=("type",))
+VOLUME_COUNT_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_volumes",
+    "Number of volumes or EC shards.",
+    labels=("collection", "type"))
+VOLUME_DISK_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_total_disk_size",
+    "Actual disk size used by volumes.",
+    labels=("collection", "type"))
+
+FILER_REQUEST_COUNTER = FILER_GATHER.counter(
+    "SeaweedFS_filer_request_total",
+    "Counter of filer requests.", labels=("type",))
+FILER_REQUEST_HISTOGRAM = FILER_GATHER.histogram(
+    "SeaweedFS_filer_request_seconds",
+    "Bucketed histogram of filer request processing time.",
+    labels=("type",))
+
+MASTER_REQUEST_COUNTER = MASTER_GATHER.counter(
+    "SeaweedFS_master_request_total",
+    "Counter of master requests.", labels=("type",))
+
+
+def start_push_loop(registry: Registry, gateway_url: str,
+                    job: str, interval_s: float = 15.0,
+                    stop_event: Optional[threading.Event] = None
+                    ) -> threading.Thread:
+    """Push-gateway parity (reference LoopPushingMetric,
+    metrics.go:109-137): POST the text exposition on an interval."""
+    from ..server.http_util import HttpError, http_call
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.wait(interval_s):
+            try:
+                http_call(
+                    "POST",
+                    f"{gateway_url.rstrip('/')}/metrics/job/{job}",
+                    registry.render().encode(),
+                    {"Content-Type": "text/plain"})
+            except HttpError:
+                pass
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.stop_event = stop
+    t.start()
+    return t

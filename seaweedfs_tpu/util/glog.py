@@ -1,0 +1,100 @@
+"""Leveled logging (reference weed/glog, a vendored google/glog fork).
+
+Same conventions: `V(n)` gates verbose logs behind a -v level, vmodule
+overrides per-module, severities I/W/E with glog's line format
+`I0729 14:30:05.123456 file.py:42] message`. Backed by a plain stream
+(stderr default) rather than rotating files — containerized deployments
+collect stdout/stderr.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional, TextIO
+
+_verbosity = 0
+_vmodule: Dict[str, int] = {}
+_stream: TextIO = sys.stderr
+_lock = threading.Lock()
+
+
+def set_verbosity(v: int):
+    global _verbosity
+    _verbosity = int(v)
+
+
+def set_vmodule(spec: str):
+    """'volume_server=3,store=1' — per-module verbosity overrides
+    (reference glog -vmodule)."""
+    _vmodule.clear()
+    for part in spec.split(","):
+        if "=" in part:
+            mod, lvl = part.split("=", 1)
+            _vmodule[mod.strip()] = int(lvl)
+
+
+def set_stream(stream: TextIO):
+    global _stream
+    _stream = stream
+
+
+def _caller(depth: int = 3):
+    frame = inspect.currentframe()
+    for _ in range(depth):
+        if frame.f_back is None:
+            break
+        frame = frame.f_back
+    fname = os.path.basename(frame.f_code.co_filename)
+    return fname, frame.f_lineno
+
+
+def _emit(severity: str, msg: str, args):
+    if args:
+        msg = msg % args
+    fname, lineno = _caller()
+    now = time.time()
+    stamp = time.strftime("%m%d %H:%M:%S", time.localtime(now))
+    micros = int((now % 1) * 1e6)
+    line = f"{severity}{stamp}.{micros:06d} {fname}:{lineno}] {msg}\n"
+    with _lock:
+        _stream.write(line)
+        _stream.flush()
+
+
+def infof(msg: str, *args):
+    _emit("I", msg, args)
+
+
+def warningf(msg: str, *args):
+    _emit("W", msg, args)
+
+
+def errorf(msg: str, *args):
+    _emit("E", msg, args)
+
+
+class _Verbose:
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def infof(self, msg: str, *args):
+        if self.enabled:
+            _emit("I", msg, args)
+
+    def __bool__(self):
+        return self.enabled
+
+
+def V(level: int) -> _Verbose:
+    """glog.V(n).infof(...) — logs only when -v >= n (or the calling
+    module's vmodule override allows it)."""
+    if _vmodule:
+        fname, _ = _caller(depth=2)
+        mod = fname[:-3] if fname.endswith(".py") else fname
+        if mod in _vmodule:
+            return _Verbose(level <= _vmodule[mod])
+    return _Verbose(level <= _verbosity)

@@ -1,0 +1,142 @@
+"""fs.* shell commands (reference weed/shell/command_fs_*.go)."""
+
+import io
+
+import pytest
+
+import seaweedfs_tpu.shell  # noqa: F401  (registers commands)
+from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
+from seaweedfs_tpu.server.filer_server import FilerServer
+from seaweedfs_tpu.server.http_util import HttpError, http_call, \
+    post_multipart
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    vol = VolumeServer(port=0, directories=[str(tmp_path / "v0")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[20], ec_backend="numpy").start()
+    filer = FilerServer(port=0, master_url=master.url).start()
+    post_multipart(f"http://{filer.url}/docs/a.txt", "a.txt",
+                   b"alpha-content")
+    post_multipart(f"http://{filer.url}/docs/sub/b.txt", "b.txt",
+                   b"bb" * 100)
+    yield master, vol, filer
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
+def _env(master, filer):
+    out = io.StringIO()
+    return CommandEnv(master.url, out=out, filer_url=filer.url), out
+
+
+def test_fs_requires_filer(stack):
+    master, vol, filer = stack
+    out = io.StringIO()
+    env = CommandEnv(master.url, out=out)    # no filer url
+    run_command(env, "fs.ls /")
+    assert "no filer configured" in out.getvalue()
+
+
+def test_missing_path_does_not_kill_shell(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    # NotFoundError (a FilerError, not HttpError) must render as an
+    # error line, not escape the REPL loop
+    assert run_command(env, "fs.cd /nonexistent") is True
+    assert "error:" in out.getvalue()
+    run_command(env, "fs.du /nonexistent")
+    assert "0 bytes" in out.getvalue()     # _walk tolerates missing
+
+
+def test_fs_ls_and_cat(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    run_command(env, "fs.ls /docs")
+    assert "a.txt" in out.getvalue() and "sub/" in out.getvalue()
+    run_command(env, "fs.ls -l /docs")
+    assert "13" in out.getvalue()            # a.txt size
+    run_command(env, "fs.cat /docs/a.txt")
+    assert "alpha-content" in out.getvalue()
+
+
+def test_fs_cd_pwd_relative(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    run_command(env, "fs.cd /docs")
+    run_command(env, "fs.pwd")
+    assert "/docs" in out.getvalue()
+    run_command(env, "fs.cat a.txt")         # relative to cwd
+    assert "alpha-content" in out.getvalue()
+    run_command(env, "fs.cd /docs/a.txt")
+    assert "not a directory" in out.getvalue()
+
+
+def test_fs_du_and_tree(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    run_command(env, "fs.du /docs")
+    assert f"{13 + 200} bytes" in out.getvalue()
+    assert "2 files" in out.getvalue()
+    run_command(env, "fs.tree /docs")
+    text = out.getvalue()
+    assert "b.txt (200)" in text and "sub/" in text
+
+
+def test_fs_mkdir_mv_rm(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    run_command(env, "fs.mkdir /newdir")
+    run_command(env, "fs.mv /docs/a.txt /newdir/renamed.txt")
+    assert http_call(
+        "GET", f"http://{filer.url}/newdir/renamed.txt") == \
+        b"alpha-content"
+    run_command(env, "fs.rm /newdir/renamed.txt")
+    with pytest.raises(HttpError):
+        http_call("GET", f"http://{filer.url}/newdir/renamed.txt")
+    run_command(env, "fs.rm -r /docs")
+    with pytest.raises(HttpError):
+        http_call("GET", f"http://{filer.url}/docs/sub/b.txt")
+
+
+def test_fs_meta_save_load(stack, tmp_path):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    dump = str(tmp_path / "meta.jsonl")
+    run_command(env, f"fs.meta.save -o {dump} /docs")
+    assert "saved" in out.getvalue()
+
+    # disaster-recovery shape: restore the metadata into a fresh filer
+    # sharing the same volume tier — content resolves through the
+    # restored chunk lists
+    filer2 = FilerServer(port=0, master_url=master.url).start()
+    try:
+        env2, out2 = _env(master, filer2)
+        run_command(env2, f"fs.meta.load -i {dump}")
+        assert "loaded" in out2.getvalue()
+        assert http_call("GET", f"http://{filer2.url}/docs/a.txt") == \
+            b"alpha-content"
+        assert http_call(
+            "GET", f"http://{filer2.url}/docs/sub/b.txt") == b"bb" * 100
+    finally:
+        filer2.stop()
+
+
+def test_fs_meta_notify_reemits_events(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    from seaweedfs_tpu.replication import EventSubscriber
+    sub = EventSubscriber(filer.url)
+    sub.poll_once()                          # drain setup events
+    run_command(env, "fs.meta.notify /docs")
+    assert "notified" in out.getvalue()
+    batch = sub.poll_once()
+    paths = [(e["event"].get("newEntry") or {}).get("FullPath", "")
+             for e in batch]
+    assert any(p.endswith("a.txt") for p in paths)

@@ -1,0 +1,121 @@
+"""Metrics, leveled logging, scaffold (reference weed/stats/metrics.go,
+weed/glog, weed/command/scaffold.go)."""
+
+import io
+import json
+
+import pytest
+
+from seaweedfs_tpu.stats.metrics import (Counter, Gauge, Histogram,
+                                         Registry)
+from seaweedfs_tpu.util import glog
+
+
+class TestMetrics:
+    def test_counter(self):
+        r = Registry()
+        c = r.counter("x_total", "help here", labels=("op",))
+        c.inc("read")
+        c.inc("read")
+        c.inc("write", amount=3)
+        text = r.render()
+        assert '# TYPE x_total counter' in text
+        assert 'x_total{op="read"} 2' in text
+        assert 'x_total{op="write"} 3' in text
+
+    def test_gauge(self):
+        r = Registry()
+        g = r.gauge("vols", labels=("collection", "type"))
+        g.set(5, "", "normal")
+        g.set(14, "pics", "ec")
+        text = r.render()
+        assert 'vols{collection="",type="normal"} 5' in text
+        assert 'vols{collection="pics",type="ec"} 14' in text
+
+    def test_histogram_buckets(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", labels=("op",),
+                        buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v, "get")
+        text = r.render()
+        assert 'lat_seconds_bucket{op="get",le="0.01"} 1' in text
+        assert 'lat_seconds_bucket{op="get",le="0.1"} 2' in text
+        assert 'lat_seconds_bucket{op="get",le="1"} 3' in text
+        assert 'lat_seconds_bucket{op="get",le="+Inf"} 4' in text
+        assert 'lat_seconds_count{op="get"} 4' in text
+        assert 'lat_seconds_sum{op="get"} 5.555' in text
+
+    def test_servers_expose_metrics(self, tmp_path):
+        from seaweedfs_tpu.server.http_util import http_call
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        vs = VolumeServer(port=0, directories=[str(tmp_path)],
+                          master_url=master.url, pulse_seconds=1,
+                          max_volume_counts=[5],
+                          ec_backend="numpy").start()
+        try:
+            from seaweedfs_tpu.client import operation as op
+            op.upload_data(master.url, b"metric-me", filename="m.bin")
+            mtext = http_call("GET",
+                              f"http://{master.url}/metrics").decode()
+            assert "SeaweedFS_master_request_total" in mtext
+            vtext = http_call("GET", f"http://{vs.url}/metrics").decode()
+            assert "SeaweedFS_volumeServer_request_total" in vtext
+            assert "SeaweedFS_volumeServer_request_seconds_bucket" \
+                in vtext
+            assert "SeaweedFS_volumeServer_volumes" in vtext
+        finally:
+            vs.stop()
+            master.stop()
+
+
+class TestGlog:
+    def setup_method(self):
+        self.buf = io.StringIO()
+        glog.set_stream(self.buf)
+        glog.set_verbosity(0)
+        glog.set_vmodule("")
+
+    def teardown_method(self):
+        import sys
+        glog.set_stream(sys.stderr)
+
+    def test_severities_and_format(self):
+        glog.infof("hello %s", "world")
+        glog.warningf("warn")
+        glog.errorf("bad: %d", 7)
+        lines = self.buf.getvalue().splitlines()
+        assert lines[0].startswith("I") and "hello world" in lines[0]
+        assert "test_stats.py:" in lines[0]
+        assert lines[1].startswith("W")
+        assert lines[2].startswith("E") and "bad: 7" in lines[2]
+
+    def test_verbosity_gate(self):
+        glog.V(2).infof("hidden")
+        assert self.buf.getvalue() == ""
+        glog.set_verbosity(2)
+        glog.V(2).infof("visible")
+        assert "visible" in self.buf.getvalue()
+
+    def test_vmodule_override(self):
+        glog.set_vmodule("test_stats=3")
+        glog.V(3).infof("module-level")
+        assert "module-level" in self.buf.getvalue()
+
+
+class TestScaffold:
+    def test_all_configs_print(self):
+        from seaweedfs_tpu.command.scaffold import SCAFFOLDS, \
+            print_scaffold
+        for name in SCAFFOLDS:
+            text = print_scaffold(name)
+            payload = "\n".join(l for l in text.splitlines()
+                                if not l.strip().startswith("//"))
+            json.loads(payload)     # the non-comment part is valid JSON
+
+    def test_unknown_raises(self):
+        from seaweedfs_tpu.command.scaffold import print_scaffold
+        with pytest.raises(SystemExit):
+            print_scaffold("nope")
