@@ -29,8 +29,15 @@ def assign(master_url: str, count: int = 1, collection: str = "",
 
 
 def upload(url: str, fid: str, data: bytes, filename: str = "",
-           content_type: str = "application/octet-stream",
+           content_type: str = "",
            ttl: str = "", jwt: str = "") -> dict:
+    if not content_type:
+        # guess from the filename like the reference's clients do —
+        # mime drives read-side features (image resize, browser render);
+        # an explicit octet-stream is respected
+        import mimetypes
+        guessed, _ = mimetypes.guess_type(filename or "")
+        content_type = guessed or "application/octet-stream"
     target = f"http://{url}/{fid}"
     if ttl:
         target += f"?ttl={ttl}"
@@ -42,7 +49,7 @@ def upload(url: str, fid: str, data: bytes, filename: str = "",
 def upload_data(master_url: str, data: bytes, filename: str = "",
                 collection: str = "", replication: str = "",
                 ttl: str = "",
-                content_type: str = "application/octet-stream") -> str:
+                content_type: str = "") -> str:
     """Assign + upload; returns the fid."""
     a = assign(master_url, collection=collection, replication=replication,
                ttl=ttl)

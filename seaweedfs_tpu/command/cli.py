@@ -288,6 +288,13 @@ def cmd_filer_replicate(args):
         pass
 
 
+def cmd_msg_broker(args):
+    from ..server.msg_broker import MsgBrokerServer
+    b = MsgBrokerServer(port=args.port, host=args.ip).start()
+    print(f"message broker on {b.url}")
+    _wait()
+
+
 def cmd_scaffold(args):
     from .scaffold import print_scaffold
     print(print_scaffold(args.config), end="")
@@ -483,6 +490,11 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("-volumeId", type=int, required=True)
     cp.add_argument("-collection", default="")
     cp.set_defaults(fn=cmd_compact)
+
+    mb = sub.add_parser("msgBroker", help="message queue broker")
+    mb.add_argument("-port", type=int, default=17777)
+    mb.add_argument("-ip", default="127.0.0.1")
+    mb.set_defaults(fn=cmd_msg_broker)
 
     sc = sub.add_parser("scaffold", help="print example config files")
     sc.add_argument("-config", default="replication",

@@ -1,0 +1,3 @@
+"""Image ops on the read path (reference weed/images/)."""
+
+from .resizing import fix_orientation, resize_image  # noqa: F401

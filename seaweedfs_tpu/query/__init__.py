@@ -1,0 +1,3 @@
+"""S3-Select-style JSON query engine (reference weed/query/)."""
+
+from .json_query import QueryError, parse_query, query_json_lines  # noqa: F401

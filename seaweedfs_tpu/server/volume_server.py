@@ -65,6 +65,7 @@ class VolumeServer:
         router.add("POST", "/admin/volume/tail_receive",
                    self.admin_volume_tail_receive)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("POST", "/query", self.query_handler)
         router.set_fallback(self.data_handler)
         router.before = self._guard_check
         from ..stats.metrics import (VOLUME_REQUEST_COUNTER,
@@ -134,6 +135,68 @@ class VolumeServer:
     def status(self, req: Request):
         return self.store.status()
 
+    def query_handler(self, req: Request):
+        """S3-Select-ish query over JSON needles (reference Query RPC,
+        volume_grpc_query.go:12 + query/json/query_json.go:17). Body:
+        {"fids": [...], "sql": "SELECT ... WHERE ..."}; rows stream
+        back as JSON lines."""
+        import json as _json
+        from ..query import QueryError, query_json_lines
+        body = _json.loads(req.body or b"{}")
+        sql = body.get("sql", "")
+        fids = body.get("fids", [])
+        if not sql or not fids:
+            raise HttpError(400, "need sql and fids")
+        limit = int(body.get("limit", 0))
+        rows: List[dict] = []
+        for fid in fids:
+            try:
+                vid, key, cookie = parse_file_id(fid)
+            except ValueError:
+                raise HttpError(400, f"bad fid {fid!r}")
+            got = self._read_needle_local(vid, key, cookie, fid)
+            try:
+                rows.extend(query_json_lines(
+                    got.data, sql,
+                    limit=(limit - len(rows)) if limit else 0))
+            except QueryError as e:
+                raise HttpError(400, str(e))
+            if limit and len(rows) >= limit:
+                break
+        out = "\n".join(_json.dumps(r, separators=(",", ":"))
+                        for r in rows)
+        return Response((out + "\n").encode() if out else b"",
+                        content_type="application/jsonl")
+
+    def _read_needle_local(self, vid: int, key: int, cookie: int,
+                           fid: str) -> Needle:
+        """Needle from a local normal OR ec volume (the query path must
+        keep working after ec.encode, like the public read path)."""
+        v = self.store.find_volume(vid)
+        if v is not None:
+            try:
+                return self.store.read_needle(
+                    vid, Needle(cookie=cookie, id=key))
+            except NotFound:
+                raise HttpError(404, f"{fid} not found")
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            raise HttpError(404, f"volume {vid} not local")
+        from ..ec.ec_volume import EcShardNotFound
+        try:
+            blob = ev.read_needle_blob(
+                key,
+                remote_fetch=self._fetch_remote_shard,
+                reconstruct_fetch=self._reconstruct_shard_range)
+        except KeyError:
+            raise HttpError(404, f"{fid} not found") from None
+        except EcShardNotFound as e:
+            raise HttpError(503, f"ec volume {vid}: {e}") from None
+        got = Needle.from_bytes(blob, ev.version)
+        if got.cookie != cookie:
+            raise HttpError(404, "cookie mismatch")
+        return got
+
     def metrics_handler(self, req: Request):
         """Prometheus text exposition; volume/disk gauges refresh from
         the store on scrape (the reference sets them during heartbeat
@@ -154,18 +217,23 @@ class VolumeServer:
             for ev in loc.ec_volumes.values():
                 ec_by_coll[ev.collection] = \
                     ec_by_coll.get(ev.collection, 0) + len(ev.shards)
-        seen = set()
+        seen_count, seen_disk = set(), set()
         for coll, (count, size) in by_coll.items():
             VOLUME_COUNT_GAUGE.set(count, coll, "normal")
             VOLUME_DISK_GAUGE.set(size, coll, "normal")
-            seen.add((coll, "normal"))
+            seen_count.add((coll, "normal"))
+            seen_disk.add((coll, "normal"))
         for coll, count in ec_by_coll.items():
             VOLUME_COUNT_GAUGE.set(count, coll, "ec")
-            seen.add((coll, "ec"))
-        for stale in getattr(self, "_metric_series", set()) - seen:
+            seen_count.add((coll, "ec"))
+        # zero each gauge's own vanished series — never mint a series
+        # in a gauge that never carried it
+        for stale in getattr(self, "_count_series", set()) - seen_count:
             VOLUME_COUNT_GAUGE.set(0, *stale)
+        for stale in getattr(self, "_disk_series", set()) - seen_disk:
             VOLUME_DISK_GAUGE.set(0, *stale)
-        self._metric_series = seen
+        self._count_series = seen_count
+        self._disk_series = seen_disk
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -564,6 +632,14 @@ class VolumeServer:
         n = Needle(cookie=cookie, id=key, data=data)
         if filename:
             n.set_name(filename.encode())
+        if not ctype:
+            # fall back to the filename's extension (reference
+            # needle_parse_upload.go keeps only a meaningful mime); an
+            # explicit octet-stream is respected — the filer uploads
+            # chunk needles that way on purpose
+            import mimetypes
+            guessed, _ = mimetypes.guess_type(filename or "")
+            ctype = guessed or ctype
         if ctype and ctype != "application/octet-stream":
             n.set_mime(ctype.encode())
         n.set_last_modified()
@@ -647,6 +723,23 @@ class VolumeServer:
             headers["Content-Disposition"] = \
                 f'inline; filename="{got.name.decode("utf-8", "replace")}"'
         body = got.data
+        # image ops on read (reference volume_server_handlers_read.go
+        # resize-on-GET + images/orientation.go) — ONLY on explicit
+        # whole-object resize requests. Range reads (the filer's chunk
+        # fetch path) must return stored bytes verbatim: re-encoding
+        # before slicing would change lengths and corrupt chunked
+        # files' etags/content.
+        if req is not None and ctype.startswith("image/") and \
+                not req.headers.get("Range"):
+            width = int(req.query.get("width", 0) or 0)
+            height = int(req.query.get("height", 0) or 0)
+            if width or height:
+                from ..images import fix_orientation, resize_image
+                if ctype == "image/jpeg":
+                    body = fix_orientation(body, ctype)
+                body, ctype = resize_image(
+                    body, ctype, width, height,
+                    req.query.get("mode", ""))
         # single-range requests (reference volume_server_handlers_read.go
         # processRangeRequest): the filer fetches chunk slices this way
         from .http_util import parse_range
@@ -663,20 +756,7 @@ class VolumeServer:
 
     # -- EC degraded read (reference store_ec.go:119-373) ------------------
     def _read_ec_needle(self, req: Request, ev, vid, key, cookie):
-        from ..ec.ec_volume import EcShardNotFound
-        try:
-            blob = ev.read_needle_blob(
-                key,
-                remote_fetch=self._fetch_remote_shard,
-                reconstruct_fetch=self._reconstruct_shard_range)
-        except KeyError:
-            raise HttpError(404, f"needle {key} not in ec volume {vid}") \
-                from None
-        except EcShardNotFound as e:
-            raise HttpError(503, f"ec volume {vid}: {e}") from None
-        got = Needle.from_bytes(blob, ev.version)
-        if got.cookie != cookie:
-            raise HttpError(404, "cookie mismatch")
+        got = self._read_needle_local(vid, key, cookie, f"{vid},{key:x}")
         return self._needle_response(got, req)
 
     def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:

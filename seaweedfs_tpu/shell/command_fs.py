@@ -13,6 +13,19 @@ from ..server.http_util import HttpError, http_call
 from .command_env import CommandEnv, command, parse_flags2
 
 
+def _list_all(client, path: str):
+    """Every entry of a directory, paginating past the server's batch
+    limit — a silent cap here would truncate fs.meta.save backups and
+    fs.rm -r."""
+    start = ""
+    while True:
+        batch = client.list_entries(path, start_file=start, limit=1000)
+        yield from batch
+        if len(batch) < 1000:
+            return
+        start = batch[-1].name
+
+
 def _walk(client, path: str):
     """Yield entries depth-first under path (path's own entry first if
     it exists and is not the root)."""
@@ -25,8 +38,7 @@ def _walk(client, path: str):
         yield e
         if not e.is_directory:
             return
-    batch = client.list_entries(path, limit=10000)
-    for e in batch:
+    for e in _list_all(client, path):
         if e.is_directory:
             yield from _walk(client, e.full_path)
         else:
@@ -54,7 +66,7 @@ def fs_ls(env: CommandEnv, args: List[str]):
     flags, ops = parse_flags2(args, bool_flags={"l"})
     long = bool(flags.get("l"))
     path = env.resolve(ops[0] if ops else "")
-    entries = env.filer().list_entries(path, limit=10000)
+    entries = list(_list_all(env.filer(), path))
     for e in sorted(entries, key=lambda x: x.full_path):
         name = e.name + ("/" if e.is_directory else "")
         if long:
@@ -70,7 +82,9 @@ def fs_cat(env: CommandEnv, args: List[str]):
     if not args:
         env.write("usage: fs.cat <path>")
         return
-    path = env.resolve(args[0])
+    env.filer()        # same no-filer-configured guard as other fs.*
+    import urllib.parse
+    path = urllib.parse.quote(env.resolve(args[0]))
     data = http_call("GET", f"http://{env.filer_url}{path}")
     try:
         env.write(data.decode())
@@ -167,16 +181,16 @@ def fs_meta_load(env: CommandEnv, args: List[str]):
             line = line.strip()
             if not line:
                 continue
+            # directories restore through the same create/update path
+            # as files so their saved attrs (mode, mtime, owner)
+            # survive the round trip
             entry = entry_from_wire(json.loads(line))
-            if entry.is_directory:
-                client.mkdir(entry.full_path)
-            else:
-                try:
-                    client.create_entry(entry)
-                except HttpError as e:
-                    if e.status != 409:
-                        raise
-                    client.update_entry(entry)
+            try:
+                client.create_entry(entry)
+            except HttpError as e:
+                if e.status != 409:
+                    raise
+                client.update_entry(entry)
             count += 1
     env.write(f"loaded {count} entries")
 

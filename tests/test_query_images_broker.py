@@ -1,0 +1,234 @@
+"""Query engine, image ops, msg broker (reference weed/query/,
+weed/images/, msg_broker + queue.proto)."""
+
+import io
+import json
+
+import pytest
+
+from seaweedfs_tpu.query import QueryError, parse_query, query_json_lines
+
+
+class TestJsonQuery:
+    DOCS = b"""\
+{"name": "alice", "age": 30, "addr": {"city": "sf"}}
+{"name": "bob", "age": 25, "addr": {"city": "nyc"}}
+{"name": "carol", "age": 35, "addr": {"city": "sf"}}
+not-json-line
+"""
+
+    def test_select_star(self):
+        rows = query_json_lines(self.DOCS, "SELECT * FROM s3object")
+        assert len(rows) == 3
+        assert rows[0]["name"] == "alice"
+
+    def test_projection_dotted(self):
+        rows = query_json_lines(
+            self.DOCS, "SELECT name, addr.city FROM t")
+        assert rows[1] == {"name": "bob", "city": "nyc"}
+
+    def test_where_equals_string(self):
+        rows = query_json_lines(
+            self.DOCS, "SELECT name FROM t WHERE addr.city = 'sf'")
+        assert [r["name"] for r in rows] == ["alice", "carol"]
+
+    def test_where_numeric_and(self):
+        rows = query_json_lines(
+            self.DOCS,
+            "SELECT name FROM t WHERE age >= 30 AND addr.city = 'sf'")
+        assert [r["name"] for r in rows] == ["alice", "carol"]
+        rows = query_json_lines(
+            self.DOCS, "SELECT name FROM t WHERE age < 30 OR age > 33")
+        assert [r["name"] for r in rows] == ["bob", "carol"]
+
+    def test_json_array_input(self):
+        data = json.dumps([{"x": 1}, {"x": 2}]).encode()
+        rows = query_json_lines(data, "SELECT x FROM t WHERE x > 1")
+        assert rows == [{"x": 2}]
+
+    def test_limit(self):
+        rows = query_json_lines(self.DOCS, "SELECT name FROM t",
+                                limit=2)
+        assert len(rows) == 2
+
+    def test_parse_errors(self):
+        for bad in ("SELECT", "SELECT FROM t", "FROM t",
+                    "SELECT a FROM t WHERE", "SELECT a FROM t WHERE a",
+                    "SELECT a FROM t WHERE a = 1 extra"):
+            with pytest.raises(QueryError):
+                q = parse_query(bad)
+                # some malformed strings only fail at match time
+                q.match({})
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path)],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[10], ec_backend="numpy").start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_query_endpoint(cluster):
+    master, vs = cluster
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import HttpError, http_call
+    docs = b'{"level": "error", "code": 500}\n' \
+           b'{"level": "info", "code": 200}\n'
+    fid = op.upload_data(master.url, docs, filename="log.jsonl")
+    body = json.dumps({"fids": [fid],
+                       "sql": "SELECT code FROM t "
+                              "WHERE level = 'error'"}).encode()
+    out = http_call("POST", f"http://{vs.url}/query", body)
+    assert json.loads(out) == {"code": 500}
+    # bad sql -> clean 400
+    bad = json.dumps({"fids": [fid], "sql": "SELEC"}).encode()
+    with pytest.raises(HttpError) as ei:
+        http_call("POST", f"http://{vs.url}/query", bad)
+    assert ei.value.status == 400
+
+
+def test_query_after_ec_encode(tmp_path):
+    """ec.encode must not break /query (reads route through the local
+    EC volume like the public read path)."""
+    import seaweedfs_tpu.shell  # noqa: F401
+    from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import http_call
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    servers = [VolumeServer(port=0,
+                            directories=[str(tmp_path / f"v{i}")],
+                            master_url=master.url, pulse_seconds=1,
+                            max_volume_counts=[30],
+                            ec_backend="numpy").start()
+               for i in range(3)]
+    try:
+        fid = op.upload_data(master.url,
+                             b'{"k": 1}\n{"k": 2}\n', filename="d.jsonl")
+        vid = int(fid.split(",")[0])
+        env = CommandEnv(master.url, out=io.StringIO())
+        assert run_command(env, f"ec.encode -volumeId {vid}")
+        holder = next(s for s in servers
+                      if s.store.find_ec_volume(vid) is not None)
+        body = json.dumps({"fids": [fid],
+                           "sql": "SELECT k FROM t WHERE k > 1"}).encode()
+        out = http_call("POST", f"http://{holder.url}/query", body)
+        assert json.loads(out) == {"k": 2}
+    finally:
+        for s in servers:
+            s.stop()
+        master.stop()
+
+
+class TestImages:
+    @staticmethod
+    def _png(w=64, h=32, color=(255, 0, 0)):
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.new("RGB", (w, h), color).save(buf, format="PNG")
+        return buf.getvalue()
+
+    def test_resize_fit(self):
+        from PIL import Image
+        from seaweedfs_tpu.images import resize_image
+        out, mime = resize_image(self._png(), "image/png", 32, 32)
+        img = Image.open(io.BytesIO(out))
+        assert img.size == (32, 16)       # aspect preserved within box
+
+    def test_resize_fill(self):
+        from PIL import Image
+        from seaweedfs_tpu.images import resize_image
+        out, _ = resize_image(self._png(), "image/png", 20, 20,
+                              mode="fill")
+        assert Image.open(io.BytesIO(out)).size == (20, 20)
+
+    def test_width_only(self):
+        from PIL import Image
+        from seaweedfs_tpu.images import resize_image
+        out, _ = resize_image(self._png(), "image/png", width=16)
+        assert Image.open(io.BytesIO(out)).size == (16, 8)
+
+    def test_passthrough_non_image(self):
+        from seaweedfs_tpu.images import resize_image
+        data = b"plain bytes"
+        out, mime = resize_image(data, "text/plain", 10, 10)
+        assert out == data and mime == "text/plain"
+
+    def test_orientation_passthrough_on_garbage(self):
+        from seaweedfs_tpu.images import fix_orientation
+        assert fix_orientation(b"not-a-jpeg") == b"not-a-jpeg"
+
+    def test_range_read_returns_stored_bytes(self, cluster):
+        """The filer's chunk fetches use Range; image transforms must
+        never rewrite those bytes."""
+        master, vs = cluster
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.server.http_util import http_call
+        data = self._png(80, 40)
+        a = op.assign(master.url)
+        op.upload(a["url"], a["fid"], data, filename="r.png",
+                  content_type="image/png")
+        got = http_call("GET",
+                        f"http://{a['url']}/{a['fid']}?width=10",
+                        headers={"Range": f"bytes=0-{len(data) - 1}"})
+        assert got == data        # verbatim despite width param
+
+    def test_resize_on_get(self, cluster):
+        from PIL import Image
+        master, vs = cluster
+        from seaweedfs_tpu.client import operation as op
+        a = op.assign(master.url)
+        op.upload(a["url"], a["fid"], self._png(100, 50),
+                  filename="pic.png", content_type="image/png")
+        from seaweedfs_tpu.server.http_util import http_call
+        out = http_call(
+            "GET", f"http://{a['url']}/{a['fid']}?width=50&height=50")
+        assert Image.open(io.BytesIO(out)).size == (50, 25)
+        # no params -> original bytes
+        out2 = http_call("GET", f"http://{a['url']}/{a['fid']}")
+        assert Image.open(io.BytesIO(out2)).size == (100, 50)
+
+
+class TestMsgBroker:
+    def test_pub_sub_roundtrip(self):
+        from seaweedfs_tpu.server.msg_broker import (MsgBrokerServer,
+                                                     QueueClient)
+        b = MsgBrokerServer(port=0).start()
+        try:
+            c = QueueClient(b.url)
+            c.publish("events", b"msg-one", source="test")
+            c.publish("events", b"msg-two")
+            msgs = c.poll("events")
+            assert [m[0] for m in msgs] == [b"msg-one", b"msg-two"]
+            assert msgs[0][1].get("source") == "test"
+            # cursor advances: no redelivery
+            assert c.poll("events", timeout=0.2) == []
+            c.publish("events", b"msg-three")
+            assert [m[0] for m in c.poll("events")] == [b"msg-three"]
+        finally:
+            b.stop()
+
+    def test_topics_and_delete(self):
+        from seaweedfs_tpu.server.http_util import HttpError, get_json, \
+            http_call
+        from seaweedfs_tpu.server.msg_broker import MsgBrokerServer
+        b = MsgBrokerServer(port=0).start()
+        try:
+            http_call("POST", f"http://{b.url}/queue/publish?topic=t1",
+                      b"x")
+            out = get_json(f"http://{b.url}/queue/topics")
+            assert out["topics"] == ["t1"]
+            http_call("POST", f"http://{b.url}/queue/delete?topic=t1")
+            # subscribing to a deleted topic is a clean 404
+            with pytest.raises(HttpError) as ei:
+                get_json(f"http://{b.url}/queue/subscribe?topic=t1")
+            assert ei.value.status == 404
+        finally:
+            b.stop()
