@@ -19,7 +19,8 @@ def cmd_master(args):
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds,
-                     jwt_signing_key=args.jwtKey).start()
+                     jwt_signing_key=args.jwtKey,
+                     peers=args.peers, raft_dir=args.mdir).start()
     print(f"master listening on {m.url}")
     _wait()
 
@@ -329,6 +330,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-pulseSeconds", type=int, default=5)
     m.add_argument("-jwtKey", default="",
                    help="HS256 key for per-fid write tokens")
+    m.add_argument("-peers", default="",
+                   help="comma-separated master peers for raft HA, "
+                        "e.g. host1:9333,host2:9333,host3:9333")
+    m.add_argument("-mdir", default="",
+                   help="directory for raft state persistence")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")

@@ -9,6 +9,7 @@ volume (reference master_grpc_server_volume.go:43-101).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
@@ -26,7 +27,8 @@ class MasterServer:
                  default_replication: str = "000",
                  pulse_seconds: int = 5,
                  garbage_threshold: float = 0.3,
-                 jwt_signing_key: str = ""):
+                 jwt_signing_key: str = "",
+                 peers: str = "", raft_dir: str = ""):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -60,6 +62,83 @@ class MasterServer:
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._stop = threading.Event()
 
+        # raft HA (reference weed/server/raft_server.go): multi-master
+        # when -peers is set; single-master otherwise (no raft at all)
+        self.raft = None
+        if peers:
+            from ..topology.raft import RaftNode
+            peer_list = [p.strip() for p in peers.split(",")
+                         if p.strip()]
+            if not raft_dir:
+                # persistence must never silently vanish: a node that
+                # forgets voted_for can grant two votes in one term and
+                # elect two leaders (reference defaults -mdir to the OS
+                # temp dir the same way)
+                import tempfile
+                raft_dir = os.path.join(tempfile.gettempdir(),
+                                        "weed-tpu-raft")
+            self.raft = RaftNode(self.url, peer_list, self._apply_raft,
+                                 state_dir=raft_dir)
+            router.add("POST", "/raft/request_vote",
+                       self.raft_request_vote)
+            router.add("POST", "/raft/append_entries",
+                       self.raft_append_entries)
+            router.add("GET", "/raft/status", self.raft_status)
+
+    # -- raft glue ---------------------------------------------------------
+    def _apply_raft(self, command: dict):
+        """Apply a committed raft command (reference
+        topology/cluster_commands.go MaxVolumeIdCommand)."""
+        if command.get("type") == "max_volume_id":
+            with self.topology.lock:
+                self.topology.max_volume_id = max(
+                    self.topology.max_volume_id, int(command["value"]))
+
+    def raft_request_vote(self, req: Request):
+        return self.raft.handle_request_vote(req.json())
+
+    def raft_append_entries(self, req: Request):
+        return self.raft.handle_append_entries(req.json())
+
+    def raft_status(self, req: Request):
+        return self.raft.status()
+
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader
+
+    def leader_url(self) -> str:
+        if self.raft is None:
+            return self.url
+        return self.raft.leader() or ""
+
+    def _leader_forward(self, req: Request):
+        """Proxy a request to the raft leader when this master is a
+        follower (reference master_server.go proxyToLeader:155-185) —
+        followers hold no topology (volume servers heartbeat only to
+        the leader), so every data-affecting call must run there.
+        Returns None when this node should handle the request itself."""
+        if self.is_leader():
+            return None
+        if req.headers.get("X-Raft-Forwarded"):
+            raise HttpError(503, "raft leadership unsettled, retry")
+        leader = self.leader_url()
+        if not leader:
+            raise HttpError(503, "no raft leader elected yet")
+        import json as _json
+        import urllib.parse
+        from .http_util import http_call
+        q = urllib.parse.urlencode(req.query)
+        url = f"http://{leader}{req.path}" + (f"?{q}" if q else "")
+        headers = {"X-Raft-Forwarded": "1"}
+        # the payload-shaping headers must survive the hop or a
+        # multipart /submit arrives at the leader as opaque bytes
+        for h in ("Content-Type", "Authorization"):
+            v = req.headers.get(h)
+            if v:
+                headers[h] = v
+        out = http_call(req.method, url, req.body or None, headers)
+        return _json.loads(out or b"{}")
+
     def metrics_handler(self, req: Request):
         from ..stats.metrics import MASTER_GATHER
         from .http_util import Response
@@ -70,10 +149,14 @@ class MasterServer:
     def start(self):
         self.server.start()
         self._pruner.start()
+        if self.raft is not None:
+            self.raft.start()
         return self
 
     def stop(self):
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         self.server.stop()
 
     @property
@@ -86,6 +169,14 @@ class MasterServer:
 
     # -- handlers ----------------------------------------------------------
     def cluster_heartbeat(self, req: Request):
+        # volume servers must register with the LEADER only (reference
+        # master_grpc_server.go: topology lives on the leader; followers
+        # hand back the leader address and the client re-targets)
+        if not self.is_leader():
+            return {"volume_size_limit":
+                    self.topology.volume_size_limit,
+                    "leader": self.leader_url(),
+                    "not_leader": True}
         hb = req.json()
         self.topology.register_heartbeat(
             dc_id=hb.get("data_center", ""),
@@ -103,9 +194,12 @@ class MasterServer:
             max_file_key=int(hb.get("max_file_key", 0)),
         )
         return {"volume_size_limit": self.topology.volume_size_limit,
-                "leader": self.url}
+                "leader": self.leader_url() or self.url}
 
     def dir_assign(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         count = int(req.query.get("count", 1))
         collection = req.query.get("collection", "")
         replication = req.query.get("replication") \
@@ -140,6 +234,17 @@ class MasterServer:
             out["auth"] = GenJwt(self.jwt_signing_key, fid)
         return out
 
+    def _next_volume_id(self) -> int:
+        """New volume id — a raft command in HA mode (reference
+        Topology.NextVolumeId raising a MaxVolumeIdCommand,
+        topology.go:115-122) so a new leader never reissues an id."""
+        if self.raft is None:
+            return self.topology.next_volume_id()
+        with self.topology.lock:
+            value = self.topology.max_volume_id + 1
+        self.raft.propose({"type": "max_volume_id", "value": value})
+        return value
+
     def _grow_volumes(self, collection: str, replication: str, ttl: TTL,
                       preferred_dc: str = "", count: int = None):
         rp = ReplicaPlacement.parse(replication)
@@ -154,7 +259,7 @@ class MasterServer:
                 if grown:
                     break
                 raise
-            vid = self.topology.next_volume_id()
+            vid = self._next_volume_id()
             ok = True
             for n in nodes:
                 try:
@@ -170,6 +275,9 @@ class MasterServer:
         return grown
 
     def vol_grow(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         collection = req.query.get("collection", "")
         replication = req.query.get("replication") \
             or self.default_replication
@@ -182,6 +290,9 @@ class MasterServer:
         return {"count": grown}
 
     def dir_lookup(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         vid_s = req.query.get("volumeId", "")
         if "," in vid_s:
             vid_s = vid_s.split(",")[0]
@@ -196,6 +307,9 @@ class MasterServer:
                               for n in locs]}
 
     def ec_lookup(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         vid = int(req.query.get("volumeId", 0))
         shards = self.topology.lookup_ec_shards(vid)
         if shards is None:
@@ -203,6 +317,9 @@ class MasterServer:
         return {"volumeId": vid, "shards": shards}
 
     def ec_status(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         """Full EC shard map: vid -> shard -> holder urls."""
         with self.topology.lock:
             return {"volumes": {
@@ -214,6 +331,9 @@ class MasterServer:
                 } for vid, per_shard in self.topology.ec_shard_map.items()}}
 
     def cluster_volumes(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         """Every volume replica: vid -> [{url, ...volume info}]."""
         out = {}
         with self.topology.lock:
@@ -225,15 +345,26 @@ class MasterServer:
         return {"volumes": out}
 
     def dir_status(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         return {"topology": self.topology.to_dict(),
                 "volumeSizeLimit": self.topology.volume_size_limit,
                 "version": "seaweedfs_tpu 0.1"}
 
     def cluster_status(self, req: Request):
-        return {"isLeader": True, "leader": self.url,
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
+        return {"isLeader": self.is_leader(),
+                "leader": self.leader_url() or self.url,
+                "peers": self.raft.peers if self.raft else [],
                 "nodes": [n.to_dict() for n in self.topology.all_nodes()]}
 
     def vol_vacuum(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         threshold = float(req.query.get("garbageThreshold",
                                         self.garbage_threshold))
         results = []
@@ -257,6 +388,9 @@ class MasterServer:
         return {"vacuumed": results}
 
     def col_delete(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         collection = req.query.get("collection", "")
         if not collection:
             raise HttpError(400, "collection required")
@@ -279,6 +413,9 @@ class MasterServer:
 
     def submit(self, req: Request):
         """Convenience upload: assign + forward (reference /submit)."""
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         filename, ctype, data = req.upload_payload()
         assign = self.dir_assign(req)
         headers = {}

@@ -79,7 +79,13 @@ class VolumeServer:
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
         self.host = host
-        self.master_url = master_url
+        # master_url may list several seed masters; heartbeats follow
+        # the leader hint and rotate seeds on failure (reference
+        # volume_grpc_client_to_master.go:25-55)
+        self._seed_masters = [m.strip() for m in master_url.split(",")
+                              if m.strip()]
+        self.master_url = self._seed_masters[0]
+        self._seed_i = 0
         self.pulse_seconds = pulse_seconds
         self.read_redirect = read_redirect
         codec = get_codec(DATA_SHARDS, 4, backend=ec_backend) \
@@ -102,7 +108,14 @@ class VolumeServer:
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         self.server.start()
-        self.heartbeat_once()
+        try:
+            self.heartbeat_once()
+        except HttpError as e:
+            # no master reachable yet — serve anyway; the heartbeat
+            # loop keeps retrying (reference volume servers outlive
+            # master outages the same way)
+            from ..util import glog
+            glog.V(0).infof("initial heartbeat failed: %s", e)
         self._hb_thread.start()
         return self
 
@@ -122,14 +135,41 @@ class VolumeServer:
                 self.heartbeat_once()
                 glog.V(4).infof("heartbeat to %s ok", self.master_url)
             except HttpError as e:
-                glog.V(0).infof("heartbeat to %s failed: %s",
-                                self.master_url, e)
+                # heartbeat_once already rotated through every seed
+                glog.V(0).infof("no master reachable: %s", e)
 
     def heartbeat_once(self):
-        resp = post_json(f"http://{self.master_url}/cluster/heartbeat",
-                         self.store.collect_heartbeat(), timeout=10)
+        """Heartbeat the current master, trying every seed before
+        giving up — startup must not die because the first listed seed
+        happens to be the down one."""
+        hb = self.store.collect_heartbeat()
+        last = None
+        for _ in range(len(self._seed_masters)):
+            try:
+                resp = post_json(
+                    f"http://{self.master_url}/cluster/heartbeat",
+                    hb, timeout=10)
+                break
+            except HttpError as e:
+                last = e
+                self._seed_i = (self._seed_i + 1) % \
+                    len(self._seed_masters)
+                self.master_url = self._seed_masters[self._seed_i]
+        else:
+            raise last
         if resp.get("volume_size_limit"):
             self.volume_size_limit = resp["volume_size_limit"]
+        # follow the leader hint: a follower master does not register
+        # us, so re-send the heartbeat there right away
+        leader = resp.get("leader")
+        if leader and leader != self.master_url:
+            self.master_url = leader
+            if resp.get("not_leader"):
+                resp = post_json(
+                    f"http://{self.master_url}/cluster/heartbeat",
+                    hb, timeout=10)
+                if resp.get("volume_size_limit"):
+                    self.volume_size_limit = resp["volume_size_limit"]
 
     # -- admin -------------------------------------------------------------
     def status(self, req: Request):

@@ -1,0 +1,391 @@
+"""Minimal Raft — leader election + replicated log for master HA.
+
+Reference weed/server/raft_server.go wraps github.com/chrislusf/raft to
+elect a master leader and replicate exactly one kind of state: the
+topology's max-volume-id counter (weed/topology/cluster_commands.go).
+This build implements that slice of Raft directly (election, log
+replication, commit, persistence) over the masters' existing HTTP
+transport — no external coordination service.
+
+Scope notes (matching the reference's usage, not full Raft):
+  * fixed membership (the -peers list), no joint consensus
+  * no log compaction/snapshotting — the log holds max-volume-id bumps,
+    which are tiny and bounded by volume-creation rate
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..server.http_util import HttpError, post_json
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+ELECTION_TIMEOUT_RANGE = (0.6, 1.2)     # seconds (HTTP-scaled)
+HEARTBEAT_INTERVAL = 0.15
+RPC_TIMEOUT = 0.5    # must stay well under the election timeout
+
+
+def _resolve_host(host: str) -> str:
+    try:
+        return socket.gethostbyname(host)
+    except OSError:
+        return host
+
+
+def same_node(a: str, b: str) -> bool:
+    """host:port equality tolerant of localhost/127.0.0.1/hostname
+    spellings — an exact-string self-match would leave a node in its
+    own peer list (phantom quorum member, self-demoting heartbeats)."""
+    if a == b:
+        return True
+    try:
+        ha, pa = a.rsplit(":", 1)
+        hb, pb = b.rsplit(":", 1)
+    except ValueError:
+        return False
+    return pa == pb and _resolve_host(ha) == _resolve_host(hb)
+
+
+class NotLeaderError(Exception):
+    """Raised for writes on a non-leader (reference raft.NotLeaderError);
+    carries the current leader hint."""
+
+    def __init__(self, leader: Optional[str]):
+        super().__init__(f"not the raft leader; leader is {leader}")
+        self.leader = leader
+
+
+class RaftNode:
+    def __init__(self, node_id: str, peers: List[str],
+                 apply_fn: Callable[[dict], None],
+                 state_dir: Optional[str] = None,
+                 transport: Optional[Callable] = None):
+        """node_id and peers are master urls (host:port). apply_fn is
+        called exactly once per committed command, in log order.
+        transport(peer, rpc_name, payload) -> reply dict; the default
+        POSTs to http://<peer>/raft/<rpc_name>."""
+        self.id = node_id
+        self.peers = [p for p in peers if not same_node(p, node_id)]
+        self.apply_fn = apply_fn
+        self.state_dir = state_dir
+        self.transport = transport or self._http_transport
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[dict] = []        # {"term": t, "command": {...}}
+        self._load_state()
+
+        # volatile
+        self.state = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0            # 1-based; 0 = nothing
+        self.last_applied = 0
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+
+        self.lock = threading.RLock()
+        self._commit_cv = threading.Condition(self.lock)
+        self._stop = threading.Event()
+        self._election_deadline = self._new_deadline()
+        self._inflight: Dict[str, bool] = {}   # one RPC per peer at a time
+        self._ticker = threading.Thread(target=self._tick_loop,
+                                        daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._ticker.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    @property
+    def is_leader(self) -> bool:
+        with self.lock:
+            return self.state == LEADER
+
+    def leader(self) -> Optional[str]:
+        with self.lock:
+            return self.id if self.state == LEADER else self.leader_id
+
+    # -- persistence -------------------------------------------------------
+    def _state_path(self) -> str:
+        safe = self.id.replace(":", "_").replace("/", "_")
+        return os.path.join(self.state_dir, f"raft-{safe}.json")
+
+    def _load_state(self):
+        if not self.state_dir:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        p = self._state_path()
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    st = json.load(f)
+                self.current_term = st.get("term", 0)
+                self.voted_for = st.get("voted_for")
+                self.log = st.get("log", [])
+            except (ValueError, OSError):
+                pass
+
+    def _persist(self):
+        if not self.state_dir:
+            return
+        p = self._state_path()
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term,
+                       "voted_for": self.voted_for,
+                       "log": self.log}, f)
+        os.replace(tmp, p)
+
+    # -- timers ------------------------------------------------------------
+    def _new_deadline(self) -> float:
+        return time.monotonic() + random.uniform(*ELECTION_TIMEOUT_RANGE)
+
+    def _tick_loop(self):
+        while not self._stop.wait(0.05):
+            with self.lock:
+                state = self.state
+                expired = time.monotonic() >= self._election_deadline
+            if state == LEADER:
+                self._broadcast_heartbeats()
+            elif expired:
+                self._run_election()
+
+    # -- election ----------------------------------------------------------
+    def _run_election(self):
+        with self.lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.id
+            self.leader_id = None
+            self._persist()
+            term = self.current_term
+            last_index = len(self.log)
+            last_term = self.log[-1]["term"] if self.log else 0
+            self._election_deadline = self._new_deadline()
+        # solicit votes in parallel — serial RPCs against a dead peer
+        # would stall past the election timeout and flap leadership
+        votes = [1]
+        done = threading.Event()
+
+        def ask(peer):
+            reply = self._rpc(peer, "request_vote", {
+                "term": term, "candidate_id": self.id,
+                "last_log_index": last_index,
+                "last_log_term": last_term})
+            if reply is None:
+                return
+            with self.lock:
+                if reply["term"] > self.current_term:
+                    self._become_follower(reply["term"], None)
+                    done.set()
+                    return
+                if self.state != CANDIDATE or self.current_term != term:
+                    done.set()
+                    return
+                if reply.get("vote_granted"):
+                    votes[0] += 1
+                    if votes[0] * 2 > len(self.peers) + 1:
+                        done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        done.wait(RPC_TIMEOUT + 0.2)
+        with self.lock:
+            votes = votes[0]
+            if self.state == CANDIDATE and self.current_term == term \
+                    and votes * 2 > len(self.peers) + 1:
+                self.state = LEADER
+                self.leader_id = self.id
+                nxt = len(self.log) + 1
+                self.next_index = {p: nxt for p in self.peers}
+                self.match_index = {p: 0 for p in self.peers}
+        if self.is_leader:
+            self._broadcast_heartbeats()
+
+    def _become_follower(self, term: int, leader: Optional[str]):
+        self.state = FOLLOWER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist()
+        if leader:
+            self.leader_id = leader
+        self._election_deadline = self._new_deadline()
+
+    # -- replication (leader side) ----------------------------------------
+    def _broadcast_heartbeats(self):
+        """One concurrent replication RPC per peer — a dead peer's
+        timeout must never delay the live peers' heartbeats (that would
+        expire their election timers and flap leadership)."""
+        for peer in self.peers:
+            with self.lock:
+                if self._inflight.get(peer):
+                    continue
+                self._inflight[peer] = True
+
+            def run(p=peer):
+                try:
+                    self._replicate_to(p)
+                    self._advance_commit()
+                finally:
+                    with self.lock:
+                        self._inflight[p] = False
+            threading.Thread(target=run, daemon=True).start()
+
+    def _replicate_to(self, peer: str):
+        with self.lock:
+            if self.state != LEADER:
+                return
+            term = self.current_term
+            nxt = self.next_index.get(peer, len(self.log) + 1)
+            prev_index = nxt - 1
+            prev_term = self.log[prev_index - 1]["term"] \
+                if prev_index >= 1 else 0
+            entries = self.log[nxt - 1:]
+            commit = self.commit_index
+        reply = self._rpc(peer, "append_entries", {
+            "term": term, "leader_id": self.id,
+            "prev_log_index": prev_index, "prev_log_term": prev_term,
+            "entries": entries, "leader_commit": commit})
+        if reply is None:
+            return
+        with self.lock:
+            if reply["term"] > self.current_term:
+                self._become_follower(reply["term"], None)
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if reply.get("success"):
+                self.match_index[peer] = prev_index + len(entries)
+                self.next_index[peer] = self.match_index[peer] + 1
+            else:
+                self.next_index[peer] = max(1, nxt - 1)
+
+    def _advance_commit(self):
+        with self.lock:
+            if self.state != LEADER:
+                return
+            for n in range(len(self.log), self.commit_index, -1):
+                if self.log[n - 1]["term"] != self.current_term:
+                    break
+                replicas = 1 + sum(1 for p in self.peers
+                                   if self.match_index.get(p, 0) >= n)
+                if replicas * 2 > len(self.peers) + 1:
+                    self.commit_index = n
+                    self._apply_committed()
+                    self._commit_cv.notify_all()
+                    break
+
+    def _apply_committed(self):
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            self.apply_fn(self.log[self.last_applied - 1]["command"])
+
+    # -- public write path -------------------------------------------------
+    def propose(self, command: dict, timeout: float = 5.0) -> int:
+        """Append a command, replicate to a majority, apply, return its
+        log index. Raises NotLeaderError on a non-leader."""
+        with self.lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader())
+            self.log.append({"term": self.current_term,
+                             "command": command})
+            self._persist()
+            index = len(self.log)
+        if not self.peers:                  # single-node cluster
+            with self.lock:
+                self.commit_index = index
+                self._apply_committed()
+            return index
+        self._broadcast_heartbeats()
+        deadline = time.monotonic() + timeout
+        with self._commit_cv:
+            while self.commit_index < index:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._stop.is_set():
+                    raise TimeoutError(
+                        f"raft commit of index {index} timed out")
+                if self.state != LEADER:
+                    raise NotLeaderError(self.leader())
+                self._commit_cv.wait(min(left, 0.1))
+        return index
+
+    # -- RPC handlers (follower side) --------------------------------------
+    def handle_request_vote(self, req: dict) -> dict:
+        with self.lock:
+            term = req["term"]
+            if term > self.current_term:
+                self._become_follower(term, None)
+            granted = False
+            if term == self.current_term and \
+                    self.voted_for in (None, req["candidate_id"]):
+                my_last_term = self.log[-1]["term"] if self.log else 0
+                up_to_date = (
+                    req["last_log_term"] > my_last_term or
+                    (req["last_log_term"] == my_last_term and
+                     req["last_log_index"] >= len(self.log)))
+                if up_to_date:
+                    granted = True
+                    self.voted_for = req["candidate_id"]
+                    self._persist()
+                    self._election_deadline = self._new_deadline()
+            return {"term": self.current_term, "vote_granted": granted}
+
+    def handle_append_entries(self, req: dict) -> dict:
+        with self.lock:
+            term = req["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if same_node(req["leader_id"], self.id):
+                # our own heartbeat reflected back (misconfigured peer
+                # list) — stepping down to it would depose us forever
+                return {"term": self.current_term, "success": True}
+            self._become_follower(term, req["leader_id"])
+            prev = req["prev_log_index"]
+            if prev > len(self.log) or (
+                    prev >= 1 and
+                    self.log[prev - 1]["term"] != req["prev_log_term"]):
+                return {"term": self.current_term, "success": False}
+            entries = req["entries"]
+            if entries:
+                # drop conflicting suffix, append the leader's entries
+                self.log = self.log[:prev] + entries
+                self._persist()
+            if req["leader_commit"] > self.commit_index:
+                self.commit_index = min(req["leader_commit"],
+                                        len(self.log))
+                self._apply_committed()
+            return {"term": self.current_term, "success": True}
+
+    # -- transport ---------------------------------------------------------
+    def _http_transport(self, peer: str, rpc: str, payload: dict):
+        return post_json(f"http://{peer}/raft/{rpc}", payload,
+                         timeout=RPC_TIMEOUT)
+
+    def _rpc(self, peer: str, rpc: str, payload: dict) -> Optional[dict]:
+        try:
+            return self.transport(peer, rpc, payload)
+        except (HttpError, OSError):
+            return None
+
+    def status(self) -> dict:
+        with self.lock:
+            return {"id": self.id, "state": self.state,
+                    "term": self.current_term,
+                    "leader": self.leader(),
+                    "log_length": len(self.log),
+                    "commit_index": self.commit_index,
+                    "peers": self.peers}
