@@ -289,6 +289,21 @@ def cmd_filer_replicate(args):
         pass
 
 
+def cmd_mount(args):
+    from ..mount.fuse_ll import FuseError, FuseMount
+    from ..mount.wfs import WeedFS
+    try:
+        fs = WeedFS(args.filer, master_url=args.master,
+                    chunk_size=args.chunkSizeLimitMB << 20,
+                    collection=args.collection,
+                    replication=args.replication)
+        mount = FuseMount(fs, args.dir, allow_other=args.allowOthers)
+    except FuseError as e:
+        raise SystemExit(str(e))
+    print(f"mounting {args.filer} at {args.dir}", flush=True)
+    raise SystemExit(mount.run())
+
+
 def cmd_msg_broker(args):
     from ..server.msg_broker import MsgBrokerServer
     b = MsgBrokerServer(port=args.port, host=args.ip).start()
@@ -496,6 +511,17 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("-volumeId", type=int, required=True)
     cp.add_argument("-collection", default="")
     cp.set_defaults(fn=cmd_compact)
+
+    mt = sub.add_parser("mount", help="FUSE-mount the filer namespace")
+    mt.add_argument("-filer", default="127.0.0.1:8888")
+    mt.add_argument("-master", default="",
+                    help="master url (default: ask the filer)")
+    mt.add_argument("-dir", required=True, help="mount point")
+    mt.add_argument("-collection", default="")
+    mt.add_argument("-replication", default="")
+    mt.add_argument("-chunkSizeLimitMB", type=int, default=8)
+    mt.add_argument("-allowOthers", action="store_true")
+    mt.set_defaults(fn=cmd_mount)
 
     mb = sub.add_parser("msgBroker", help="message queue broker")
     mb.add_argument("-port", type=int, default=17777)

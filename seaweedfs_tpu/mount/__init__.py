@@ -1,0 +1,4 @@
+"""FUSE mount (reference weed/filesys/): the filer namespace as a
+local filesystem, via a ctypes binding to libfuse2."""
+
+from .dirty_pages import ContinuousIntervals  # noqa: F401

@@ -1,0 +1,98 @@
+"""Dirty write buffering — merged in-memory intervals per open file.
+
+Reference weed/filesys/dirty_page_interval.go: writes land in
+non-overlapping intervals (newer data wins on overlap); a flush walks
+them in order and uploads each run as a chunk. This is the pure logic
+core of the mount's write path, testable without FUSE.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class _Interval:
+    __slots__ = ("offset", "data")
+
+    def __init__(self, offset: int, data: bytes):
+        self.offset = offset
+        self.data = data
+
+    @property
+    def end(self) -> int:
+        return self.offset + len(self.data)
+
+
+class ContinuousIntervals:
+    """Sorted, non-overlapping dirty intervals; adjacent runs merge."""
+
+    def __init__(self):
+        self.intervals: List[_Interval] = []
+
+    def size(self) -> int:
+        return self.intervals[-1].end if self.intervals else 0
+
+    def total_bytes(self) -> int:
+        return sum(len(iv.data) for iv in self.intervals)
+
+    def add(self, offset: int, data: bytes):
+        """Newer data overwrites any overlapped older bytes
+        (reference AddInterval)."""
+        if not data:
+            return
+        new = _Interval(offset, bytes(data))
+        out: List[_Interval] = []
+        for iv in self.intervals:
+            if iv.end <= new.offset or iv.offset >= new.end:
+                out.append(iv)                      # disjoint
+                continue
+            if iv.offset < new.offset:              # keep left remnant
+                out.append(_Interval(
+                    iv.offset, iv.data[:new.offset - iv.offset]))
+            if iv.end > new.end:                    # keep right remnant
+                out.append(_Interval(
+                    new.end, iv.data[new.end - iv.offset:]))
+        out.append(new)
+        out.sort(key=lambda iv: iv.offset)
+        # merge touching runs so a flush uploads maximal chunks
+        merged: List[_Interval] = []
+        for iv in out:
+            if merged and merged[-1].end == iv.offset:
+                merged[-1].data += iv.data
+            else:
+                merged.append(_Interval(iv.offset, iv.data))
+        self.intervals = merged
+
+    def read_at(self, buf: bytearray, offset: int) -> int:
+        """Overlay dirty bytes onto buf (which holds the stored
+        content); returns the max end position filled (reference
+        ReadDataAt)."""
+        max_stop = 0
+        for iv in self.intervals:
+            start = max(iv.offset, offset)
+            stop = min(iv.end, offset + len(buf))
+            if start >= stop:
+                continue
+            buf[start - offset:stop - offset] = \
+                iv.data[start - iv.offset:stop - iv.offset]
+            max_stop = max(max_stop, stop)
+        return max_stop
+
+    def truncate(self, length: int):
+        """Drop dirty bytes at/after length (an ftruncate while the
+        handle holds buffered writes)."""
+        out: List[_Interval] = []
+        for iv in self.intervals:
+            if iv.offset >= length:
+                continue
+            if iv.end > length:
+                out.append(_Interval(iv.offset,
+                                     iv.data[:length - iv.offset]))
+            else:
+                out.append(iv)
+        self.intervals = out
+
+    def pop_all(self) -> List[Tuple[int, bytes]]:
+        out = [(iv.offset, iv.data) for iv in self.intervals]
+        self.intervals = []
+        return out

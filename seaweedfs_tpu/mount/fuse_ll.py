@@ -1,0 +1,235 @@
+"""Minimal ctypes binding to libfuse2's high-level API.
+
+The environment ships libfuse.so.2 (2.9) but no Python FUSE package, so
+this binds the handful of fuse_operations the mount needs directly.
+Struct layouts follow FUSE_USE_VERSION 26 on x86-64 Linux (fuse.h of
+libfuse 2.9.x) — getattr/readdir/open/create/read/write/truncate/
+unlink/mkdir/rmdir/rename/flush/release/utimens/chmod.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+from typing import Callable, List, Optional
+
+
+class FuseError(Exception):
+    pass
+
+
+def _load_libfuse():
+    for name in ("libfuse.so.2", ctypes.util.find_library("fuse")):
+        if not name:
+            continue
+        try:
+            return ctypes.CDLL(name)
+        except OSError:
+            continue
+    raise FuseError(
+        "libfuse.so.2 not found — `weed-tpu mount` needs FUSE; use the "
+        "WebDAV gateway or filer HTTP API instead")
+
+
+c_off_t = ctypes.c_int64
+c_mode_t = ctypes.c_uint32
+c_dev_t = ctypes.c_uint64
+c_uid_t = ctypes.c_uint32
+c_gid_t = ctypes.c_uint32
+
+
+class Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+class Stat(ctypes.Structure):
+    # x86-64 Linux struct stat
+    _fields_ = [
+        ("st_dev", ctypes.c_uint64),
+        ("st_ino", ctypes.c_uint64),
+        ("st_nlink", ctypes.c_uint64),
+        ("st_mode", ctypes.c_uint32),
+        ("st_uid", ctypes.c_uint32),
+        ("st_gid", ctypes.c_uint32),
+        ("__pad0", ctypes.c_int),
+        ("st_rdev", ctypes.c_uint64),
+        ("st_size", ctypes.c_int64),
+        ("st_blksize", ctypes.c_int64),
+        ("st_blocks", ctypes.c_int64),
+        ("st_atim", Timespec),
+        ("st_mtim", Timespec),
+        ("st_ctim", Timespec),
+        ("__reserved", ctypes.c_int64 * 3),
+    ]
+
+
+class FuseFileInfo(ctypes.Structure):
+    # fuse_common.h (v26)
+    _fields_ = [
+        ("flags", ctypes.c_int),
+        ("fh_old", ctypes.c_ulong),
+        ("writepage", ctypes.c_int),
+        ("bits", ctypes.c_uint),        # direct_io/keep_cache/... bitfield
+        ("fh", ctypes.c_uint64),
+        ("lock_owner", ctypes.c_uint64),
+    ]
+
+
+FILL_DIR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
+    ctypes.POINTER(Stat), c_off_t)
+
+_GETATTR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                              ctypes.POINTER(Stat))
+_READLINK_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_size_t)
+_GETDIR_T = ctypes.c_void_p
+_MKNOD_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t,
+                            c_dev_t)
+_MKDIR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t)
+_PATH_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p)
+_PATH2_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.c_char_p)
+_CHMOD_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t)
+_CHOWN_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_uid_t,
+                            c_gid_t)
+_TRUNCATE_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_off_t)
+_OPEN_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                           ctypes.POINTER(FuseFileInfo))
+_READ_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                           ctypes.POINTER(ctypes.c_char),
+                           ctypes.c_size_t, c_off_t,
+                           ctypes.POINTER(FuseFileInfo))
+_WRITE_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.POINTER(ctypes.c_char),
+                            ctypes.c_size_t, c_off_t,
+                            ctypes.POINTER(FuseFileInfo))
+_FSYNC_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.c_int,
+                            ctypes.POINTER(FuseFileInfo))
+_READDIR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_void_p, FILL_DIR_T, c_off_t,
+                              ctypes.POINTER(FuseFileInfo))
+_CREATE_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t,
+                             ctypes.POINTER(FuseFileInfo))
+_UTIMENS_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                              ctypes.POINTER(Timespec))
+
+
+class FuseOperations(ctypes.Structure):
+    # fuse.h v26 field order — do not reorder
+    _fields_ = [
+        ("getattr", _GETATTR_T),
+        ("readlink", _READLINK_T),
+        ("getdir", _GETDIR_T),
+        ("mknod", _MKNOD_T),
+        ("mkdir", _MKDIR_T),
+        ("unlink", _PATH_T),
+        ("rmdir", _PATH_T),
+        ("symlink", _PATH2_T),
+        ("rename", _PATH2_T),
+        ("link", _PATH2_T),
+        ("chmod", _CHMOD_T),
+        ("chown", _CHOWN_T),
+        ("truncate", _TRUNCATE_T),
+        ("utime", ctypes.c_void_p),
+        ("open", _OPEN_T),
+        ("read", _READ_T),
+        ("write", _WRITE_T),
+        ("statfs", ctypes.c_void_p),
+        ("flush", _OPEN_T),
+        ("release", _OPEN_T),
+        ("fsync", _FSYNC_T),
+        ("setxattr", ctypes.c_void_p),
+        ("getxattr", ctypes.c_void_p),
+        ("listxattr", ctypes.c_void_p),
+        ("removexattr", ctypes.c_void_p),
+        ("opendir", _OPEN_T),
+        ("readdir", _READDIR_T),
+        ("releasedir", _OPEN_T),
+        ("fsyncdir", _FSYNC_T),
+        ("init", ctypes.c_void_p),
+        ("destroy", ctypes.c_void_p),
+        ("access", ctypes.c_void_p),
+        ("create", _CREATE_T),
+        ("ftruncate", ctypes.c_void_p),
+        ("fgetattr", ctypes.c_void_p),
+        ("lock", ctypes.c_void_p),
+        ("utimens", _UTIMENS_T),
+        ("bmap", ctypes.c_void_p),
+        ("flags", ctypes.c_uint),
+        ("ioctl", ctypes.c_void_p),
+        ("poll", ctypes.c_void_p),
+        ("write_buf", ctypes.c_void_p),
+        ("read_buf", ctypes.c_void_p),
+        ("flock", ctypes.c_void_p),
+        ("fallocate", ctypes.c_void_p),
+    ]
+
+
+def _wrap(fn: Callable, functype, name: str):
+    """C callback that maps Python exceptions to -errno."""
+
+    def call(*args):
+        try:
+            out = fn(*args)
+            return 0 if out is None else out
+        except OSError as e:
+            return -(e.errno or errno.EIO)
+        except Exception:  # noqa: BLE001 — must never unwind into C
+            return -errno.EIO
+    return functype(call)
+
+
+class FuseMount:
+    """Mount `ops` (an object with optional getattr/readdir/... methods
+    returning 0/-errno or raising OSError) at mountpoint and serve
+    until unmounted. Blocks the calling thread."""
+
+    def __init__(self, ops, mountpoint: str, foreground: bool = True,
+                 allow_other: bool = False, fsname: str = "seaweedfs"):
+        self.lib = _load_libfuse()
+        self.ops = ops
+        self.mountpoint = mountpoint
+        args = ["weed-tpu-mount", mountpoint, "-s"]   # single-threaded
+        if foreground:
+            args.append("-f")
+        opts = [f"fsname={fsname}", "default_permissions"]
+        if allow_other:
+            opts.append("allow_other")
+        args += ["-o", ",".join(opts)]
+        self.argv = (ctypes.c_char_p * len(args))(
+            *[a.encode() for a in args])
+        self.argc = len(args)
+
+        self.c_ops = FuseOperations()
+        self._keep = []       # keep callback objects alive
+        table = [
+            ("getattr", _GETATTR_T), ("mkdir", _MKDIR_T),
+            ("unlink", _PATH_T), ("rmdir", _PATH_T),
+            ("rename", _PATH2_T), ("chmod", _CHMOD_T),
+            ("chown", _CHOWN_T),
+            ("truncate", _TRUNCATE_T), ("open", _OPEN_T),
+            ("read", _READ_T), ("write", _WRITE_T),
+            ("flush", _OPEN_T), ("release", _OPEN_T),
+            ("readdir", _READDIR_T), ("create", _CREATE_T),
+            ("utimens", _UTIMENS_T),
+        ]
+        for name, ftype in table:
+            fn = getattr(ops, name, None)
+            if fn is not None:
+                cb = _wrap(fn, ftype, name)
+                self._keep.append(cb)
+                setattr(self.c_ops, name, cb)
+
+    def run(self) -> int:
+        main = self.lib.fuse_main_real
+        main.restype = ctypes.c_int
+        main.argtypes = [ctypes.c_int,
+                         ctypes.POINTER(ctypes.c_char_p),
+                         ctypes.POINTER(FuseOperations),
+                         ctypes.c_size_t, ctypes.c_void_p]
+        return main(self.argc, self.argv, ctypes.byref(self.c_ops),
+                    ctypes.sizeof(self.c_ops), None)

@@ -1,0 +1,317 @@
+"""WFS — the filer namespace served through FUSE.
+
+Reference weed/filesys/wfs.go + dir.go + file.go + filehandle.go: each
+open file buffers writes as merged dirty intervals; a flush uploads
+each run as a chunk whose logical offset overlaps older chunks, and the
+chunk model's visible-interval resolution (newest mtime wins) yields
+the right bytes on read — the same overlap semantics the reference
+relies on.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno
+import posixpath
+import stat as stat_mod
+import time
+from typing import Dict, Optional
+
+from ..filer.entry import Attr, Entry, FileChunk
+from ..filer.filechunks import total_size
+from ..filer.filer import FilerError, NotFoundError
+from ..filer.filer_client import FilerClient
+from ..filer.stream import default_fetcher, read_chunked
+from ..filer.upload import split_and_upload
+from ..server.http_util import HttpError, get_json
+from .dirty_pages import ContinuousIntervals
+from .fuse_ll import Stat, Timespec
+
+
+class _Handle:
+    def __init__(self, entry: Entry):
+        self.entry = entry
+        self.dirty = ContinuousIntervals()
+        self.new_size = None      # set by truncate while open
+
+
+class WeedFS:
+    """fuse_operations receiver; methods return 0/-errno."""
+
+    def __init__(self, filer_url: str, master_url: str = "",
+                 chunk_size: int = 8 << 20, collection: str = "",
+                 replication: str = ""):
+        self.client = FilerClient(filer_url)
+        self.filer_url = filer_url
+        if not master_url:
+            master_url = get_json(
+                f"http://{filer_url}/filer/status")["master"]
+        self.master_url = master_url
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        self._fetch = default_fetcher(master_url)
+        self.handles: Dict[int, _Handle] = {}
+        self._next_fh = 1
+
+    # -- helpers -----------------------------------------------------------
+    def _path(self, raw) -> str:
+        return raw.decode() if isinstance(raw, bytes) else raw
+
+    def _entry(self, path: str) -> Entry:
+        try:
+            return self.client.find_entry(path)
+        except (NotFoundError, HttpError):
+            raise OSError(errno.ENOENT, path)
+
+    def _fill_stat(self, st, entry: Optional[Entry]):
+        ctypes.memset(ctypes.addressof(st.contents), 0,
+                      ctypes.sizeof(Stat))
+        s = st.contents
+        if entry is None:             # the mount root
+            s.st_mode = stat_mod.S_IFDIR | 0o755
+            s.st_nlink = 2
+            return
+        mode = entry.attr.mode & 0o7777
+        if entry.is_directory:
+            s.st_mode = stat_mod.S_IFDIR | (mode or 0o755)
+            s.st_nlink = 2
+        else:
+            s.st_mode = stat_mod.S_IFREG | (mode or 0o644)
+            s.st_nlink = 1
+            s.st_size = total_size(entry.chunks)
+        s.st_uid = entry.attr.uid
+        s.st_gid = entry.attr.gid
+        ts = int(entry.attr.mtime or time.time())
+        s.st_mtim.tv_sec = ts
+        s.st_ctim.tv_sec = int(entry.attr.crtime or ts)
+        s.st_atim.tv_sec = ts
+        s.st_blksize = 512
+        s.st_blocks = (s.st_size + 511) // 512
+
+    def _read_stored(self, entry: Entry, offset: int,
+                     size: int) -> bytes:
+        if not entry.chunks:
+            return b""
+        want = min(size, max(0, total_size(entry.chunks) - offset))
+        if want <= 0:
+            return b""
+        return read_chunked(entry.chunks, offset, want, self._fetch)
+
+    # -- fuse_operations ---------------------------------------------------
+    def getattr(self, path, st):
+        p = self._path(path)
+        if p == "/":
+            self._fill_stat(st, None)
+            return 0
+        self._fill_stat(st, self._entry(p))
+        return 0
+
+    def readdir(self, path, buf, filler, offset, fi):
+        p = self._path(path)
+        filler(buf, b".", None, 0)
+        filler(buf, b"..", None, 0)
+        start = ""
+        while True:
+            batch = self.client.list_entries(p, start_file=start,
+                                             limit=1000)
+            for e in batch:
+                filler(buf, e.name.encode(), None, 0)
+            if len(batch) < 1000:
+                return 0
+            start = batch[-1].name
+
+    def mkdir(self, path, mode):
+        p = self._path(path)
+        now = time.time()
+        entry = Entry(full_path=p,
+                      attr=Attr(mtime=now, crtime=now,
+                                mode=mode & 0o7777))
+        entry.attr.set_directory()
+        try:
+            self.client.create_entry(entry)
+        except FilerError:
+            raise OSError(errno.EEXIST, p)
+        return 0
+
+    def unlink(self, path):
+        self._delete(self._path(path), recursive=False)
+        return 0
+
+    def rmdir(self, path):
+        p = self._path(path)
+        if self.client.list_entries(p, limit=1):
+            raise OSError(errno.ENOTEMPTY, p)
+        self._delete(p, recursive=False)
+        return 0
+
+    def _delete(self, p: str, recursive: bool):
+        try:
+            self.client.delete_entry(p, recursive=recursive,
+                                     ignore_recursive_error=False)
+        except NotFoundError:
+            raise OSError(errno.ENOENT, p)
+        except FilerError:
+            raise OSError(errno.ENOTEMPTY, p)
+        except HttpError as e:
+            raise OSError(errno.ENOENT if e.status == 404 else
+                          errno.EIO, p)
+
+    def rename(self, old, new):
+        try:
+            self.client.rename_entry(self._path(old), self._path(new))
+        except NotFoundError:
+            raise OSError(errno.ENOENT, self._path(old))
+        return 0
+
+    def chmod(self, path, mode):
+        entry = self._entry(self._path(path))
+        keep_dir = entry.is_directory
+        entry.attr.mode = mode & 0o7777
+        if keep_dir:
+            entry.attr.set_directory()
+        self.client.update_entry(entry)
+        return 0
+
+    def chown(self, path, uid, gid):
+        entry = self._entry(self._path(path))
+        entry.attr.uid, entry.attr.gid = uid, gid
+        self.client.update_entry(entry)
+        return 0
+
+    def utimens(self, path, times):
+        entry = self._entry(self._path(path))
+        if times:
+            entry.attr.mtime = times[1].tv_sec
+        else:
+            entry.attr.mtime = time.time()
+        self.client.update_entry(entry)
+        return 0
+
+    def create(self, path, mode, fi):
+        p = self._path(path)
+        now = time.time()
+        entry = Entry(full_path=p,
+                      attr=Attr(mtime=now, crtime=now,
+                                mode=mode & 0o7777))
+        try:
+            self.client.create_entry(entry)
+        except FilerError:
+            entry = self._entry(p)     # already exists: open it
+        fi.contents.fh = self._open_handle(entry)
+        return 0
+
+    def open(self, path, fi):
+        entry = self._entry(self._path(path))
+        fi.contents.fh = self._open_handle(entry)
+        return 0
+
+    def _open_handle(self, entry: Entry) -> int:
+        fh = self._next_fh
+        self._next_fh += 1
+        self.handles[fh] = _Handle(entry)
+        return fh
+
+    def _handle(self, fi) -> _Handle:
+        h = self.handles.get(fi.contents.fh)
+        if h is None:
+            raise OSError(errno.EBADF, "stale handle")
+        return h
+
+    def read(self, path, buf, size, offset, fi):
+        h = self._handle(fi)
+        eff_size = total_size(h.entry.chunks)
+        if h.new_size is not None:
+            eff_size = h.new_size
+        eff_size = max(eff_size, h.dirty.size())
+        if offset >= eff_size:
+            return 0
+        want = min(size, eff_size - offset)
+        out = bytearray(want)
+        stored = self._read_stored(h.entry, offset, want)
+        out[:len(stored)] = stored
+        h.dirty.read_at(out, offset)
+        ctypes.memmove(buf, bytes(out), len(out))
+        return len(out)
+
+    def write(self, path, buf, size, offset, fi):
+        h = self._handle(fi)
+        data = ctypes.string_at(buf, size)
+        h.dirty.add(offset, data)
+        return size
+
+    def truncate(self, path, length):
+        """Path truncate — fuse2 also routes ftruncate here (the
+        ftruncate slot is NULL), so open handles' dirty buffers and
+        size views must shrink with the entry or a later flush would
+        resurrect the cut bytes."""
+        p = self._path(path)
+        entry = self._entry(p)
+        self._truncate_entry(entry, length)
+        for h in self.handles.values():
+            if h.entry.full_path == p:
+                h.dirty.truncate(length)
+                h.new_size = length
+                h.entry = entry
+        return 0
+
+    def _truncate_entry(self, entry: Entry, length: int):
+        current = total_size(entry.chunks)
+        if length == current:
+            return
+        old_chunks = list(entry.chunks)
+        if length == 0:
+            entry.chunks = []
+        else:
+            # materialize to the new size and re-chunk — the chunk
+            # model has no truncate marker
+            content = self._read_stored(entry, 0, length)
+            content = content.ljust(length, b"\x00")
+            chunks, _ = split_and_upload(
+                self.master_url, content, entry.name,
+                self.chunk_size, collection=self.collection,
+                replication=self.replication)
+            entry.chunks = chunks
+        entry.attr.mtime = time.time()
+        self.client.update_entry(entry)
+        if old_chunks:
+            # replaced chunks would otherwise sit on volume servers
+            # forever (every open(.., 'w') rewrite truncates first)
+            try:
+                self.client.queue_chunk_deletion(old_chunks)
+            except HttpError:
+                pass
+
+    def flush(self, path, fi):
+        return self._flush_handle(fi)
+
+    def release(self, path, fi):
+        out = self._flush_handle(fi)
+        self.handles.pop(fi.contents.fh, None)
+        return out
+
+    def _flush_handle(self, fi):
+        h = self.handles.get(fi.contents.fh)
+        if h is None or (not h.dirty.intervals and h.new_size is None):
+            return 0
+        # re-fetch: another writer may have updated the entry meanwhile
+        try:
+            entry = self.client.find_entry(h.entry.full_path)
+        except (NotFoundError, HttpError):
+            entry = h.entry
+        for run_offset, data in h.dirty.pop_all():
+            chunks, _ = split_and_upload(
+                self.master_url, data, entry.name, self.chunk_size,
+                collection=self.collection,
+                replication=self.replication)
+            for c in chunks:
+                c.offset += run_offset
+            entry.chunks = list(entry.chunks) + chunks
+        entry.attr.mtime = time.time()
+        try:
+            self.client.update_entry(entry)
+        except (NotFoundError, HttpError):
+            self.client.create_entry(entry)
+        h.entry = entry
+        h.new_size = None
+        return 0
