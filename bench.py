@@ -60,12 +60,9 @@ def init_device(timeout_s: float):
     def probe():
         try:
             import jax
-            # honor an explicit platform request even though the
-            # environment's sitecustomize imported jax before us (config
-            # values were baked from the env at that import)
-            want = os.environ.get("JAX_PLATFORMS")
-            if want:
-                jax.config.update("jax_platforms", want)
+            from seaweedfs_tpu.util.jax_platform import (
+                honor_platform_request)
+            honor_platform_request()
             result["devices"] = jax.devices()
         except Exception as e:  # noqa: BLE001
             result["error"] = e

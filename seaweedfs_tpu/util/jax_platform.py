@@ -1,0 +1,70 @@
+"""Workarounds for sitecustomize pre-importing jax with JAX_PLATFORMS=axon.
+
+The environment imports jax at interpreter startup and bakes the platform
+choice from the env at that moment, so later changes to JAX_PLATFORMS are
+ignored unless ``jax.config`` is updated directly — and even that is
+silently ignored once any backend has been initialized (jax's
+``xla_bridge.backends()`` caches and the config value has no update hook
+that clears it). These helpers are the single home for that dance; used by
+``bench.py``, ``tests/conftest.py`` and ``__graft_entry__.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def honor_platform_request() -> None:
+    """Re-apply the JAX_PLATFORMS env request onto jax.config.
+
+    Only effective before the first device touch of the process; call it
+    before any ``jax.devices()`` / array creation.
+    """
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+
+
+def set_host_device_count_flag(n: int, flags: Optional[str] = None) -> str:
+    """Return XLA_FLAGS with the host-device-count flag forced to ``n``,
+    replacing any existing value rather than keeping a stale one."""
+    flags = os.environ.get("XLA_FLAGS", "") if flags is None else flags
+    flags = re.sub(rf"{_COUNT_FLAG}=\d+", "", flags)
+    return (flags.strip() + f" {_COUNT_FLAG}={n}").strip()
+
+
+def force_cpu_devices(n: int):
+    """Try to realize >= n virtual CPU devices in this process.
+
+    Returns the jax device list on success, or None when the process's
+    backends were already initialized on another platform (the caller
+    should then fall back to a fresh subprocess). The driver env
+    (JAX_PLATFORMS / XLA_FLAGS) is restored afterwards so later calls in
+    the same process still see the original request.
+    """
+    old = {k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    os.environ["XLA_FLAGS"] = set_host_device_count_flag(n)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            devices = jax.devices()
+        except Exception:  # noqa: BLE001 - backend init can fail many ways
+            return None
+        if devices and devices[0].platform == "cpu" and len(devices) >= n:
+            return devices
+        return None
+    finally:
+        for key, val in old.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val

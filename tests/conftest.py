@@ -3,20 +3,21 @@
 Tests never touch the real TPU; multi-chip sharding is validated on
 8 virtual CPU devices (the driver separately dry-runs __graft_entry__).
 
-Note: the environment's sitecustomize imports jax at interpreter startup
-with JAX_PLATFORMS=axon already in the env, so setting the env var here is
-not enough — jax.config must be updated directly (config values are read
-from the env at jax import time, which happened before this file ran).
+The env vars are set permanently (not save/restored) on purpose: tests
+spawn server subprocesses that must inherit the CPU platform. The
+jax.config update is still needed because sitecustomize imported jax
+before this file ran — see seaweedfs_tpu/util/jax_platform.py.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seaweedfs_tpu.util.jax_platform import (  # noqa: E402
+    honor_platform_request, set_host_device_count_flag)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = set_host_device_count_flag(8)
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+honor_platform_request()
