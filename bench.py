@@ -1,55 +1,119 @@
-"""Benchmark: RS(10,4) EC encode throughput on TPU vs the native CPU path.
+"""Benchmark: end-to-end RS(10,4) EC volume encode, TPU vs native CPU.
+
+This measures the BASELINE metric (BASELINE.md): `ec.encode` of a real
+multi-GB .dat through `write_ec_files` — disk reads, h2d, device compute,
+d2h and the 14 shard-file writes all included — with the TPU-backed
+pipelined path, against the multi-threaded native C++ codec (the stand-in
+for the reference's AVX2 reedsolomon path, measured live on this host).
+All 14 shard files are byte-compared (sha256 of the full files) between
+the two paths; a mismatch fails the bench.
 
 Prints ONE JSON line:
-  {"metric": "ec_encode_rs10_4_mbps", "value": <TPU MB/s>, "unit": "MB/s",
-   "vs_baseline": <TPU / native-AVX2 CPU>}
+  {"metric": "ec_encode_e2e_rs10_4_mbps", "value": <TPU MB/s>,
+   "unit": "MB/s", "vs_baseline": <TPU / native CPU>}
 
-The baseline denominator is this host's native C++ codec (the stand-in for
-the reference's AVX2 reedsolomon path, measured live — BASELINE.md says
-"measured on our hardware is the real baseline"). Payload MB/s counts data
-bytes in (the reference benchmarks encode the same way).
+Secondary numbers on stderr: e2e rebuild of 4 dropped shards, and a
+device-resident compute figure measured honestly (per-iteration
+block_until_ready over rotating fresh buffers — round 1's same-buffer
+sync-once loop reported a physically impossible number and is gone).
 
-Defensive against the fragile axon tunnel (see memory): device init is
-watchdogged; per-call payloads stay modest; throughput is measured
-device-resident (one-time transfer excluded, reported on stderr).
-
-Env knobs: SW_BENCH_MB (payload per shard row, default 8),
-SW_BENCH_ITERS (default 8), SW_BENCH_INIT_TIMEOUT (default 180s).
+Env knobs: SW_BENCH_DAT_MB (volume size, default 4096),
+SW_BENCH_SLAB_MB (device slab per shard row, default 8),
+SW_BENCH_TRIALS (best-of trials per timed pass, default 2),
+SW_BENCH_INIT_TIMEOUT (default 180s), SW_BENCH_DIR (workdir).
 """
 
+import hashlib
 import json
 import os
+import shutil
 import sys
+import tempfile
 import threading
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 K, M = 10, 4
+TOTAL = K + M
+TRIALS = int(os.environ.get("SW_BENCH_TRIALS", "2"))
 
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def measure_cpu(data) -> float:
-    from seaweedfs_tpu.ops.codec import get_codec
-    from seaweedfs_tpu.ops.rs_native import native_available
-    if not native_available():
+def generate_dat(path: str, size_mb: int) -> int:
+    """Write size_mb MB of deterministic pseudo-random bytes, streamed."""
+    rng = np.random.default_rng(0)
+    chunk = 128 << 20
+    total = size_mb << 20
+    t = time.perf_counter()
+    with open(path, "wb") as f:
+        written = 0
+        while written < total:
+            n = min(chunk, total - written)
+            f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            written += n
+    log(f"generated {size_mb}MB .dat in {time.perf_counter() - t:.1f}s")
+    return total
+
+
+def shard_digests(base: str) -> list:
+    from seaweedfs_tpu.ec import to_ext
+    out = []
+    for i in range(TOTAL):
+        with open(base + to_ext(i), "rb") as f:
+            out.append(hashlib.file_digest(f, "sha256").hexdigest())
+    return out
+
+
+def remove_shards(base: str, ids=range(TOTAL)):
+    from seaweedfs_tpu.ec import to_ext
+    for i in ids:
+        p = base + to_ext(i)
+        if os.path.exists(p):
+            os.remove(p)
+
+
+def ensure_native():
+    """Build (or rebuild) the native lib; a stale pre-threading .so would
+    silently give a single-threaded denominator."""
+    import seaweedfs_tpu.ops.rs_native as rs_native
+
+    def has_mt():
+        lib = rs_native._load()
+        return lib is not None and hasattr(lib, "sw_ec_matmul_mt")
+
+    if not has_mt():
+        import importlib
         import subprocess
         subprocess.run([os.path.join(os.path.dirname(__file__),
                                      "seaweedfs_tpu/ops/native/build.sh")],
                        check=False, capture_output=True)
-    backend = "native" if native_available() else "numpy"
-    codec = get_codec(K, M, backend=backend)
-    codec.encode(data[:, :1024])  # warm
+        rs_native = importlib.reload(rs_native)
+    return rs_native.native_available()
+
+
+def measure_cpu_e2e(base: str, dat_size: int) -> float:
+    """End-to-end native encode. Slab 1MB: the native path is fastest when
+    rows fit in LLC (the reference streams 256KB buffers for the same
+    reason), so the denominator gets its best configuration."""
+    from seaweedfs_tpu.ec import write_ec_files
+    from seaweedfs_tpu.ops.codec import get_codec
+    backend = "native" if ensure_native() else "numpy"
+    codec = get_codec(K, M, backend=backend)  # native: all hw threads
     best = 0.0
-    for _ in range(3):
+    for trial in range(TRIALS):
+        os.sync()  # settle writeback so each trial starts clean
         t = time.perf_counter()
-        codec.encode(data)
+        write_ec_files(base, codec=codec, slab=1 << 20, pipelined=False)
         dt = time.perf_counter() - t
-        best = max(best, data.nbytes / dt / 1e6)
-    log(f"cpu[{backend}] encode: {best:.0f} MB/s")
+        best = max(best, dat_size / dt / 1e6)
+        log(f"cpu[{backend}] e2e encode trial {trial}: "
+            f"{dat_size / dt / 1e6:.0f} MB/s ({dt:.1f}s)")
     return best
 
 
@@ -76,68 +140,166 @@ def init_device(timeout_s: float):
     return result["devices"]
 
 
-def measure_tpu(data, iters: int) -> float:
+def probe_link():
+    """Measure raw h2d/d2h of the host↔device link at bench time. The
+    axon tunnel's bandwidth is shared and varies run to run (observed
+    h2d 74MB/s..1.4GB/s, d2h 8..43MB/s); this records the conditions the
+    e2e number was taken under so it can be interpreted."""
+    import jax.numpy as jnp
+    a = np.zeros(32 << 20, dtype=np.uint8)
+    t = time.perf_counter()
+    dev = jnp.asarray(a)
+    dev.block_until_ready()
+    h2d = a.nbytes / (time.perf_counter() - t) / 1e6
+    t = time.perf_counter()
+    np.asarray(dev)
+    d2h = a.nbytes / (time.perf_counter() - t) / 1e6
+    log(f"link probe: h2d {h2d:.0f} MB/s, d2h {d2h:.0f} MB/s "
+        f"(e2e TPU encode is bounded by ~d2h/0.4 payload MB/s)")
+
+
+def measure_tpu_e2e(base: str, dat_size: int, slab_mb: int) -> float:
+    from seaweedfs_tpu.ec import write_ec_files
+    from seaweedfs_tpu.ops.rs_tpu import TpuCodec
+    codec = TpuCodec(K, M)
+    # warm the compile cache for every power-of-two bucket the coalesced
+    # stream can hit (steady-state batches are exactly slab wide; the tail
+    # batch is a smaller multiple of the 1MB small block) so no JIT
+    # compile lands inside the timed region
+    from seaweedfs_tpu.ops.pipeline import PipelinedMatmul
+    warm = PipelinedMatmul(codec.matrix[K:], max_width=slab_mb << 20)
+    widths, w = [], slab_mb << 20
+    while w >= 1 << 20:
+        widths.append(w)
+        w >>= 1
+    list(warm.stream(iter(
+        [(0, np.zeros((K, wi), dtype=np.uint8)) for wi in widths])))
+    best = 0.0
+    for trial in range(TRIALS):
+        os.sync()  # settle prior-pass writeback so timing starts clean
+        t = time.perf_counter()
+        write_ec_files(base, codec=codec, slab=slab_mb << 20, pipelined=True)
+        dt = time.perf_counter() - t
+        best = max(best, dat_size / dt / 1e6)
+        log(f"tpu e2e encode trial {trial} (disk+h2d+mxu+d2h+write): "
+            f"{dat_size / dt / 1e6:.0f} MB/s ({dt:.1f}s, "
+            f"{slab_mb}MB coalesced batches per device call)")
+    return best
+
+
+def measure_tpu_rebuild(base: str, dat_size: int, slab_mb: int):
+    """Drop 4 random shards, rebuild through the device, verify digests."""
+    import random
+    from seaweedfs_tpu.ec import rebuild_ec_files
+    from seaweedfs_tpu.ops.rs_tpu import TpuCodec
+    before = shard_digests(base)
+    dropped = sorted(random.Random(42).sample(range(TOTAL), M))
+    remove_shards(base, dropped)
+    codec = TpuCodec(K, M)
+    t = time.perf_counter()
+    rebuilt = rebuild_ec_files(base, codec=codec, slab=slab_mb << 20,
+                               pipelined=True)
+    dt = time.perf_counter() - t
+    assert sorted(rebuilt) == dropped, (rebuilt, dropped)
+    after = shard_digests(base)
+    if after != before:
+        raise AssertionError(f"rebuild of shards {dropped} not byte-identical")
+    mbps = dat_size / dt / 1e6
+    log(f"tpu e2e rebuild of {M} shards: {mbps:.0f} MB/s of volume bytes "
+        f"({dt:.1f}s, dropped {dropped}, digests verified)")
+
+
+def measure_device_resident(slab_mb: int, iters: int = 8):
+    """Honest device-resident figure: per-iteration sync, rotating fresh
+    buffers so no result can be served from an unexecuted cached launch."""
     import jax.numpy as jnp
     from seaweedfs_tpu.ops.rs_tpu import make_encode_fn
-
-    n = data.shape[1]
+    n = slab_mb << 20
     fn, bitmat = make_encode_fn(K, M, n)
     bm = jnp.asarray(bitmat)
+    rng = np.random.default_rng(1)
+    bufs = [jnp.asarray(rng.integers(0, 256, (K, n), dtype=np.uint8))
+            for _ in range(3)]
+    for b in bufs:
+        b.block_until_ready()
+    fn(bm, bufs[0]).block_until_ready()  # compile
+    times = []
+    for i in range(iters):
+        t = time.perf_counter()
+        fn(bm, bufs[i % len(bufs)]).block_until_ready()
+        times.append(time.perf_counter() - t)
+    best = (K * n) / min(times) / 1e6
+    med = (K * n) / sorted(times)[len(times) // 2] / 1e6
+    log(f"tpu device-resident encode (per-iter sync, rotating buffers): "
+        f"median {med:.0f} MB/s, best {best:.0f} MB/s")
+    # throughput view: dispatch all, sync once — still honest (distinct
+    # rotating inputs, every dispatched executable runs) but without a
+    # host round-trip per iteration, which dominates over a remote link
     t = time.perf_counter()
-    dev = jnp.asarray(data)
-    dev.block_until_ready()
-    log(f"h2d {data.nbytes / 1e6:.0f}MB: {time.perf_counter() - t:.2f}s")
-    t = time.perf_counter()
-    out = fn(bm, dev)
-    out.block_until_ready()
-    log(f"compile+first: {time.perf_counter() - t:.2f}s")
-    t = time.perf_counter()
-    for _ in range(iters):
-        out = fn(bm, dev)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t) / iters
-    mbps = data.nbytes / dt / 1e6
-    log(f"tpu encode (device-resident): {mbps:.0f} MB/s")
-    # correctness spot check on a slice
-    from seaweedfs_tpu.ops.codec import NumpyCodec
-    ref = NumpyCodec(K, M).encode(data[:, :4096])
-    got = np.asarray(out)[:, :4096]
-    if not np.array_equal(ref, got):
-        raise AssertionError("TPU parity mismatch vs CPU oracle")
-    return mbps
+    outs = [fn(bm, bufs[i % len(bufs)]) for i in range(iters)]
+    for o in outs:
+        o.block_until_ready()
+    thr = (K * n * iters) / (time.perf_counter() - t) / 1e6
+    log(f"tpu device-resident encode (pipelined dispatch, one sync): "
+        f"{thr:.0f} MB/s")
+
+
+def emit(value: float, vs_baseline: float):
+    print(json.dumps({"metric": "ec_encode_e2e_rs10_4_mbps",
+                      "value": round(value, 1), "unit": "MB/s",
+                      "vs_baseline": round(vs_baseline, 2)}))
 
 
 def main():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    mb = int(os.environ.get("SW_BENCH_MB", "8"))
-    iters = int(os.environ.get("SW_BENCH_ITERS", "8"))
+    dat_mb = int(os.environ.get("SW_BENCH_DAT_MB", "4096"))
+    slab_mb = int(os.environ.get("SW_BENCH_SLAB_MB", "8"))
     init_timeout = float(os.environ.get("SW_BENCH_INIT_TIMEOUT", "180"))
-
-    rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, (K, mb << 20), dtype=np.uint8)
-
-    cpu_mbps = measure_cpu(data)
-
-    devices = init_device(init_timeout)
-    if devices is None:
-        # device unreachable: report the CPU path so the driver still gets
-        # a number; vs_baseline 1.0 marks "no TPU speedup measured"
-        print(json.dumps({"metric": "ec_encode_rs10_4_mbps",
-                          "value": round(cpu_mbps, 1), "unit": "MB/s",
-                          "vs_baseline": 1.0}))
-        return
-    log(f"devices: {devices}")
+    user_dir = os.environ.get("SW_BENCH_DIR")
+    workdir = user_dir or tempfile.mkdtemp(prefix="swbench_")
+    os.makedirs(workdir, exist_ok=True)
+    base = os.path.join(workdir, "1")
     try:
-        tpu_mbps = measure_tpu(data, iters)
-    except Exception as e:  # noqa: BLE001
-        log(f"tpu bench failed: {e!r}")
-        print(json.dumps({"metric": "ec_encode_rs10_4_mbps",
-                          "value": round(cpu_mbps, 1), "unit": "MB/s",
-                          "vs_baseline": 1.0}))
-        return
-    print(json.dumps({"metric": "ec_encode_rs10_4_mbps",
-                      "value": round(tpu_mbps, 1), "unit": "MB/s",
-                      "vs_baseline": round(tpu_mbps / cpu_mbps, 2)}))
+        dat_size = generate_dat(base + ".dat", dat_mb)
+
+        cpu_mbps = measure_cpu_e2e(base, dat_size)
+        cpu_digests = shard_digests(base)
+        remove_shards(base)
+
+        devices = init_device(init_timeout)
+        if devices is None:
+            emit(cpu_mbps, 1.0)
+            return
+        log(f"devices: {devices}")
+        try:
+            probe_link()
+            tpu_mbps = measure_tpu_e2e(base, dat_size, slab_mb)
+        except Exception as e:  # noqa: BLE001 - tunnel flakiness: fall back
+            log(f"tpu bench failed: {e!r}")
+            emit(cpu_mbps, 1.0)
+            return
+        # correctness failures must NOT fall back to a healthy-looking
+        # line: a digest mismatch is data corruption and fails the bench
+        if shard_digests(base) != cpu_digests:
+            raise AssertionError("TPU shards != native shards")
+        log("all 14 shard digests identical to the native path")
+        measure_tpu_rebuild(base, dat_size, slab_mb)
+        try:
+            measure_device_resident(slab_mb)
+        except Exception as e:  # noqa: BLE001 - secondary metric only
+            log(f"device-resident measurement failed: {e!r}")
+        emit(tpu_mbps, tpu_mbps / cpu_mbps)
+    finally:
+        if not os.environ.get("SW_BENCH_KEEP"):
+            if user_dir:
+                from seaweedfs_tpu.ec import to_ext
+                # caller-provided dir may hold unrelated files: remove only
+                # what the bench created
+                for p in [base + ".dat"] + [
+                        base + to_ext(i) for i in range(TOTAL)]:
+                    if os.path.exists(p):
+                        os.remove(p)
+            else:
+                shutil.rmtree(workdir, ignore_errors=True)
 
 
 if __name__ == "__main__":

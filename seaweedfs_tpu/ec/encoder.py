@@ -4,30 +4,36 @@ Behavior-compatible with reference ec_encoder.go:
   * write_sorted_file_from_idx: .idx append log -> .ecx (same 16B entries,
     sorted by needle id) [ec_encoder.go:27-54]
   * write_ec_files: two-level striping — while MORE than one large row
-    (10 x 1GB) remains, emit a large row; tail as small rows (10 x 1MB),
+    (k x 1GB) remains, emit a large row; tail as small rows (k x 1MB),
     zero-padded [ec_encoder.go:192-229]
-  * rebuild_ec_files: regenerate missing .ecNN from >=10 survivors
+  * rebuild_ec_files: regenerate missing .ecNN from >=k survivors
     [ec_encoder.go:61-116, 231-285]
 
-TPU-first difference: the reference streams 10 x 256KB buffers per GF call;
-here each device call covers a whole slab (default 10 x 8MB) so a volume
+Geometry is taken from the codec (generic RS(k,m), default 10+4 — the
+reference hardcodes 10+4 at ec_encoder.go:17-20).
+
+TPU-first difference: the reference streams k x 256KB buffers per GF call;
+here each device call covers a whole slab (default k x 8MB) so a volume
 encode is a few hundred kernel launches instead of ~120k, and the GF math
-runs as one MXU matmul per slab (ops/rs_tpu.py). Slab reads are strided
-(block i of a row lives at start + i*block_size), the same column layout
-the reference uses, so shard bytes are identical.
+runs as one MXU matmul per slab (ops/rs_tpu.py). With a TPU-backed codec
+the slabs additionally flow through ops/pipeline.PipelinedMatmul, which
+overlaps disk reads (reader thread), h2d, MXU compute, d2h and shard-file
+writes. Slab reads are strided (block i of a row lives at start +
+i*block_size), the same column layout the reference uses, so shard bytes
+are identical across all backends.
 """
 
 from __future__ import annotations
 
 import os
-from typing import List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ..ops.codec import ReedSolomonCodec, get_codec
 from ..storage.needle_map import MemDb
 from .constants import (DATA_SHARDS, LARGE_BLOCK_SIZE, PARITY_SHARDS,
-                        SMALL_BLOCK_SIZE, TOTAL_SHARDS, to_ext)
+                        SMALL_BLOCK_SIZE, to_ext)
 
 DEFAULT_SLAB = 8 << 20  # bytes per shard per device call
 
@@ -38,67 +44,119 @@ def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx"):
     db.save_to_idx(base_name + ext)
 
 
+def _row_slabs(f, k: int, start: int, block_size: int, slab: int
+               ) -> Iterator[Tuple[None, np.ndarray]]:
+    """Yield the slabs of one row of k blocks at [start, start+k*block)."""
+    step = min(slab, block_size)
+    for off in range(0, block_size, step):
+        width = min(step, block_size - off)  # final chunk may be partial
+        data = np.zeros((k, width), dtype=np.uint8)
+        for i in range(k):
+            f.seek(start + i * block_size + off)
+            chunk = f.read(width)
+            if chunk:
+                data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        yield None, data
+
+
+def _dat_slabs(dat_path: str, dat_size: int, k: int, large_block: int,
+               small_block: int, slab: int
+               ) -> Iterator[Tuple[None, np.ndarray]]:
+    """All slabs of a .dat in shard-file order (large rows, then small)."""
+    with open(dat_path, "rb") as f:
+        remaining = dat_size
+        processed = 0
+        large_row = large_block * k
+        while remaining > large_row:
+            yield from _row_slabs(f, k, processed, large_block, slab)
+            remaining -= large_row
+            processed += large_row
+        small_row = small_block * k
+        while remaining > 0:
+            yield from _row_slabs(f, k, processed, small_block, slab)
+            remaining -= small_row
+            processed += small_row
+
+
+def _coalesce_slabs(slabs: Iterator[Tuple[None, np.ndarray]],
+                    target_width: int) -> Iterator[Tuple[None, np.ndarray]]:
+    """Hstack consecutive row-slabs up to target_width per device call.
+
+    GF coding is columnwise-independent, so concat-then-encode equals
+    encode-then-concat; and consecutive slabs append contiguously to each
+    shard file, so the batched rows are exactly the shard byte ranges —
+    the 'streaming stripe batches' of BASELINE config 3. Without this, a
+    volume of 1MB small rows would reach the device 10MB per call.
+    """
+    batch: List[np.ndarray] = []
+    total = 0
+    for _, data in slabs:
+        w = data.shape[1]
+        if batch and total + w > target_width:
+            yield None, (batch[0] if len(batch) == 1
+                         else np.concatenate(batch, axis=1))
+            batch, total = [], 0
+        batch.append(data)
+        total += w
+    if batch:
+        yield None, (batch[0] if len(batch) == 1
+                     else np.concatenate(batch, axis=1))
+
+
 def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                    large_block: int = LARGE_BLOCK_SIZE,
                    small_block: int = SMALL_BLOCK_SIZE,
-                   slab: int = DEFAULT_SLAB):
-    """Encode base_name.dat into base_name.ec00 .. .ec13."""
+                   slab: int = DEFAULT_SLAB,
+                   pipelined: Optional[bool] = None):
+    """Encode base_name.dat into base_name.ec00 .. .ec{k+m-1}.
+
+    pipelined: None = auto (pipeline when the codec is device-backed);
+    True/False forces. The synchronous path and the pipelined path produce
+    byte-identical shard files.
+    """
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    k, m = codec.k, codec.m
+    if pipelined is None:
+        pipelined = codec.backend == "tpu"
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
-    outs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
+    slabs = _dat_slabs(dat_path, dat_size, k, large_block, small_block, slab)
+    outs = [open(base_name + to_ext(i), "wb") for i in range(k + m)]
     try:
-        with open(dat_path, "rb") as f:
-            remaining = dat_size
-            processed = 0
-            large_row = large_block * DATA_SHARDS
-            while remaining > large_row:
-                _encode_row(f, codec, processed, large_block, slab, outs)
-                remaining -= large_row
-                processed += large_row
-            small_row = small_block * DATA_SHARDS
-            while remaining > 0:
-                _encode_row(f, codec, processed, small_block, slab, outs)
-                remaining -= small_row
-                processed += small_row
+        if pipelined:
+            from ..ops.pipeline import PipelinedMatmul
+            pm = PipelinedMatmul(codec.matrix[k:], max_width=slab)
+            stream = pm.stream(_coalesce_slabs(slabs, slab))
+        else:
+            stream = ((meta, data, codec.encode(data))
+                      for meta, data in slabs)
+        for _, data, parity in stream:
+            for i in range(k):
+                outs[i].write(data[i].tobytes())
+            for j in range(m):
+                outs[k + j].write(parity[j].tobytes())
     finally:
         for o in outs:
             o.close()
 
 
-def _encode_row(f, codec: ReedSolomonCodec, start: int, block_size: int,
-                slab: int, outs: List):
-    """Encode one row of 10 blocks at [start, start + 10*block_size)."""
-    step = min(slab, block_size)
-    for off in range(0, block_size, step):
-        width = min(step, block_size - off)  # final chunk may be partial
-        data = np.zeros((DATA_SHARDS, width), dtype=np.uint8)
-        for i in range(DATA_SHARDS):
-            f.seek(start + i * block_size + off)
-            chunk = f.read(width)
-            if chunk:
-                data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
-        parity = codec.encode(data)
-        for i in range(DATA_SHARDS):
-            outs[i].write(data[i].tobytes())
-        for j in range(PARITY_SHARDS):
-            outs[DATA_SHARDS + j].write(parity[j].tobytes())
-
-
 def rebuild_ec_files(base_name: str,
                      codec: Optional[ReedSolomonCodec] = None,
-                     slab: int = DEFAULT_SLAB) -> List[int]:
+                     slab: int = DEFAULT_SLAB,
+                     pipelined: Optional[bool] = None) -> List[int]:
     """Regenerate missing shard files from survivors. Returns the list of
-    rebuilt shard ids. Raises if fewer than DATA_SHARDS survive."""
+    rebuilt shard ids. Raises if fewer than k survive."""
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
-    present = [os.path.exists(base_name + to_ext(i))
-               for i in range(TOTAL_SHARDS)]
+    k, total = codec.k, codec.total
+    if pipelined is None:
+        pipelined = codec.backend == "tpu"
+    present = [os.path.exists(base_name + to_ext(i)) for i in range(total)]
     missing = [i for i, p in enumerate(present) if not p]
     if not missing:
         return []
-    if sum(present) < DATA_SHARDS:
+    if sum(present) < k:
         raise ValueError(
-            f"cannot rebuild: only {sum(present)} of {TOTAL_SHARDS} shards")
+            f"cannot rebuild: only {sum(present)} of {total} shards")
     shard_size = None
     for i, p in enumerate(present):
         if p:
@@ -108,22 +166,43 @@ def rebuild_ec_files(base_name: str,
             elif shard_size != sz:
                 raise ValueError("surviving shards differ in size")
     ins = [open(base_name + to_ext(i), "rb") if present[i] else None
-           for i in range(TOTAL_SHARDS)]
+           for i in range(total)]
     outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
-    try:
+    # only the first k survivors feed the decode plan; reading more would
+    # be dead I/O (their coefficient columns are zero by construction)
+    src = [i for i, p in enumerate(present) if p][:k]
+
+    def survivor_slabs():
         for off in range(0, shard_size, slab):
             n = min(slab, shard_size - off)
-            shards: List[Optional[np.ndarray]] = []
-            for i in range(TOTAL_SHARDS):
-                if ins[i] is None:
-                    shards.append(None)
-                else:
-                    ins[i].seek(off)
-                    shards.append(np.frombuffer(ins[i].read(n),
-                                                dtype=np.uint8))
-            rebuilt = codec.reconstruct(shards)
-            for i in missing:
-                outs[i].write(rebuilt[i].tobytes())
+            rows = []
+            for i in src:
+                ins[i].seek(off)
+                rows.append(np.frombuffer(ins[i].read(n), dtype=np.uint8))
+            yield None, np.stack(rows, axis=0)
+
+    try:
+        if pipelined:
+            from ..ops.pipeline import PipelinedMatmul
+            coeffs = _rebuild_coeffs(codec, present, missing)
+            pm = PipelinedMatmul(coeffs, max_width=slab)
+            for _, _, out in pm.stream(survivor_slabs()):
+                for r, i in enumerate(missing):
+                    outs[i].write(out[r].tobytes())
+        else:
+            for off in range(0, shard_size, slab):
+                n = min(slab, shard_size - off)
+                shards: List[Optional[np.ndarray]] = []
+                for i in range(total):
+                    if ins[i] is None:
+                        shards.append(None)
+                    else:
+                        ins[i].seek(off)
+                        shards.append(np.frombuffer(ins[i].read(n),
+                                                    dtype=np.uint8))
+                rebuilt = codec.reconstruct(shards)
+                for i in missing:
+                    outs[i].write(rebuilt[i].tobytes())
     finally:
         for h in ins:
             if h is not None:
@@ -133,15 +212,39 @@ def rebuild_ec_files(base_name: str,
     return missing
 
 
+def _rebuild_coeffs(codec: ReedSolomonCodec, present: List[bool],
+                    missing: List[int]) -> np.ndarray:
+    """(len(missing), k) GF coefficients so that
+    missing_rows = coeffs @ stack(first k surviving shards).
+
+    Derivation mirrors ReedSolomonCodec.reconstruct: data rows come from
+    the inverse of the first-k-survivors submatrix; parity rows from
+    matrix[row] @ that inverse.
+    """
+    from ..ops import gf256
+
+    src = [i for i, p in enumerate(present) if p][:codec.k]
+    sub = codec.matrix[src, :]
+    inv = gf256.mat_inv(sub)
+    rows = []
+    for i in missing:
+        if i < codec.k:
+            rows.append(inv[i])
+        else:
+            rows.append(gf256.mat_mul(codec.matrix[i:i + 1, :], inv)[0])
+    return np.stack(rows, axis=0)
+
+
 def ec_shard_base_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
-                       small_block: int = SMALL_BLOCK_SIZE) -> int:
+                       small_block: int = SMALL_BLOCK_SIZE,
+                       data_shards: int = DATA_SHARDS) -> int:
     """Size every shard file will have for a given .dat size."""
-    large_row = large_block * DATA_SHARDS
+    large_row = large_block * data_shards
     n_large = 0
     remaining = dat_size
     while remaining > large_row:
         n_large += 1
         remaining -= large_row
-    small_row = small_block * DATA_SHARDS
+    small_row = small_block * data_shards
     n_small = (remaining + small_row - 1) // small_row
     return n_large * large_block + n_small * small_block

@@ -4,5 +4,5 @@
 # has a portable scalar fallback, so this always succeeds.
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -march=native -fPIC -shared -o libseaweed_ec.so seaweed_ec.cc
+g++ -O3 -march=native -fPIC -shared -pthread -o libseaweed_ec.so seaweed_ec.cc
 echo "built $(pwd)/libseaweed_ec.so"

@@ -15,6 +15,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #if defined(__AVX2__)
 #include <immintrin.h>
@@ -160,6 +162,45 @@ void sw_ec_matmul(const uint8_t* coeffs, int r, int k, const uint8_t* data,
                   dst);
     }
   }
+}
+
+// Multi-threaded variant: the byte range [0, n) is split into per-thread
+// column slices (the reference dependency parallelizes the same way —
+// klauspost/reedsolomon splits shards across goroutines). nthreads <= 0
+// means hardware concurrency. Each slice is independent, so output is
+// bit-identical to the single-threaded path.
+void sw_ec_matmul_mt(const uint8_t* coeffs, int r, int k, const uint8_t* data,
+                     long long n, uint8_t* out, int nthreads) {
+  if (nthreads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    nthreads = hc ? static_cast<int>(hc) : 1;
+  }
+  constexpr long long kMinSlice = 64 * 1024;
+  long long max_by_size = (n + kMinSlice - 1) / kMinSlice;
+  if (max_by_size < nthreads) nthreads = static_cast<int>(max_by_size);
+  if (nthreads <= 1) {
+    sw_ec_matmul(coeffs, r, k, data, n, out);
+    return;
+  }
+  // 64-byte-aligned slice boundaries keep the AVX2 loops off split lines
+  long long step = ((n + nthreads - 1) / nthreads + 63) & ~63LL;
+  std::vector<std::thread> workers;
+  workers.reserve(nthreads);
+  for (int t = 0; t < nthreads; t++) {
+    long long lo = t * step;
+    if (lo >= n) break;
+    long long hi = lo + step < n ? lo + step : n;
+    workers.emplace_back([=] {
+      for (int i = 0; i < r; i++) {
+        uint8_t* dst = out + static_cast<long long>(i) * n + lo;
+        for (int j = 0; j < k; j++) {
+          mul_xor_row(coeffs[i * k + j],
+                      data + static_cast<long long>(j) * n + lo, hi - lo, dst);
+        }
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
 }
 
 }  // extern "C"

@@ -1,0 +1,138 @@
+"""Pipelined host↔device streaming for the TPU EC path.
+
+The reference's encode hot loop (reference ec_encoder.go:192-229) is a
+synchronous read→GF→write cycle per 256KB batch. The TPU-first design
+(SURVEY hard part #3) overlaps four stages instead:
+
+    disk read (reader thread) → h2d + MXU dispatch (async) → d2h drain →
+    shard-file write
+
+JAX dispatch is asynchronous: ``fn(bitmat, dev)`` returns a future-like
+device array immediately, so keeping a bounded deque of in-flight slabs
+means the device computes slab t+1..t+depth while the host blocks on
+fetching slab t's output and writing files. The reader thread overlaps
+disk I/O with everything else (file reads release the GIL).
+
+PipelinedMatmul computes ``coeffs @ data`` over GF(2^8) for a stream of
+data slabs with a fixed coefficient matrix — encode (coeffs = parity
+rows) and rebuild (coeffs = decode-plan rows vs survivors) both reduce to
+this. Only the r output rows round-trip back to the host; for encode that
+is m/k of the h2d traffic.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, Iterator, Tuple
+
+import numpy as np
+
+from .rs_tpu import lift_coeffs, width_bucket
+
+_SENTINEL = object()
+
+
+class PipelinedMatmul:
+    """Streams (meta, data (k, w) uint8) slabs through a device GF matmul.
+
+    stream() yields (meta, data, out (r, w)) in input order with up to
+    ``depth`` slabs in flight on the device and ``prefetch`` slabs of
+    read-ahead in the reader queue.
+    """
+
+    def __init__(self, coeffs: np.ndarray,
+                 max_width: int = 32 << 20, depth: int = 4,
+                 prefetch: int = 3, drain_threads: int = 2):
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        self.r, self.k = coeffs.shape
+        self.max_width = int(max_width)
+        self.depth = int(depth)
+        self.prefetch = int(prefetch)
+        self.drain_threads = int(drain_threads)
+        self._bitmat_np = lift_coeffs(coeffs)
+        self._bitmat_dev = None
+
+    def _fn(self, width: int):
+        from .rs_tpu import _coded_fn
+        return _coded_fn(self.k, self.r, width)
+
+    def stream(self, slabs: Iterable[Tuple[object, np.ndarray]]
+               ) -> Iterator[Tuple[object, np.ndarray, np.ndarray]]:
+        import jax.numpy as jnp
+
+        if self._bitmat_dev is None:
+            self._bitmat_dev = jnp.asarray(self._bitmat_np)
+        bitmat = self._bitmat_dev
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        err: list = []
+        stop = threading.Event()
+
+        def produce():
+            try:
+                for item in slabs:
+                    if stop.is_set():
+                        break
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001 - relay to consumer
+                err.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        reader = threading.Thread(target=produce, daemon=True)
+        reader.start()
+
+        # d2h runs in a small pool so fetches start the moment each
+        # output is dispatched instead of serializing behind the next
+        # dispatch (host↔device links degrade badly when a single thread
+        # interleaves uploads and downloads)
+        drain_pool = ThreadPoolExecutor(max_workers=self.drain_threads)
+        pending: deque = deque()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                meta, data = item
+                w = data.shape[1]
+                if w > self.max_width:
+                    raise ValueError(
+                        f"slab width {w} exceeds max_width {self.max_width}")
+                bucket = width_bucket(w, self.max_width)
+                if w < bucket:
+                    padded = np.zeros((self.k, bucket), dtype=np.uint8)
+                    padded[:, :w] = data
+                else:
+                    padded = data
+                dev = jnp.asarray(padded)            # async h2d
+                out = self._fn(bucket)(bitmat, dev)  # async dispatch
+                fut = drain_pool.submit(np.asarray, out)
+                pending.append((meta, data, fut, w))
+                if len(pending) >= self.depth:
+                    yield self._drain(pending.popleft())
+            while pending:
+                yield self._drain(pending.popleft())
+            if err:
+                raise err[0]
+        finally:
+            drain_pool.shutdown(wait=False)
+            # stop the reader (at most one more in-flight slab) and
+            # unblock it if the consumer bailed early
+            stop.set()
+            while reader.is_alive():
+                try:
+                    q.get(timeout=0.1)
+                except queue.Empty:
+                    pass
+            reader.join(timeout=10)
+
+    @staticmethod
+    def _drain(entry):
+        meta, data, fut, w = entry
+        full = fut.result()  # blocks until device + d2h complete
+        if full.shape[1] != w:
+            full = full[:, :w]
+        return meta, data, full

@@ -37,6 +37,12 @@ def _load():
             ctypes.POINTER(ctypes.c_uint8),  # out (r*n)
         ]
         lib.sw_ec_matmul.restype = None
+        try:
+            lib.sw_ec_matmul_mt.argtypes = (
+                lib.sw_ec_matmul.argtypes + [ctypes.c_int])  # nthreads
+            lib.sw_ec_matmul_mt.restype = None
+        except AttributeError:
+            pass  # pre-threading .so still on disk; rebuild to enable
         _lib = lib
     except OSError:
         _load_failed = True
@@ -48,16 +54,20 @@ def native_available() -> bool:
 
 
 class NativeCodec(ReedSolomonCodec):
+    """threads: 0 = hardware concurrency (matches the reference dependency's
+    multi-goroutine default), 1 = single-threaded, n = exactly n."""
+
     backend = "native"
 
     def __init__(self, data_shards: int, parity_shards: int,
-                 matrix_kind: str = "vandermonde"):
+                 matrix_kind: str = "vandermonde", threads: int = 0):
         super().__init__(data_shards, parity_shards, matrix_kind)
         self._lib = _load()
         if self._lib is None:
             raise RuntimeError(
                 f"native EC library not built at {_LIB_PATH}; "
                 "run seaweedfs_tpu/ops/native/build.sh")
+        self.threads = threads
 
     def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
@@ -66,8 +76,15 @@ class NativeCodec(ReedSolomonCodec):
         n = data.shape[1]
         out = np.zeros((r, n), dtype=np.uint8)
         u8p = ctypes.POINTER(ctypes.c_uint8)
-        self._lib.sw_ec_matmul(
-            coeffs.ctypes.data_as(u8p), r, k,
-            data.ctypes.data_as(u8p), n,
-            out.ctypes.data_as(u8p))
+        use_mt = self.threads != 1 and hasattr(self._lib, "sw_ec_matmul_mt")
+        if use_mt:
+            self._lib.sw_ec_matmul_mt(
+                coeffs.ctypes.data_as(u8p), r, k,
+                data.ctypes.data_as(u8p), n,
+                out.ctypes.data_as(u8p), self.threads)
+        else:
+            self._lib.sw_ec_matmul(
+                coeffs.ctypes.data_as(u8p), r, k,
+                data.ctypes.data_as(u8p), n,
+                out.ctypes.data_as(u8p))
         return out

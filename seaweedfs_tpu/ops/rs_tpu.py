@@ -67,6 +67,18 @@ def _bitmat_cached(coeff_bytes: bytes, r: int, k: int):
     return gf256.bit_matrix(coeffs).astype(np.int8)
 
 
+def lift_coeffs(coeffs: np.ndarray) -> np.ndarray:
+    """GF(2) bit-plane lift of a byte coefficient matrix, int8 for the MXU."""
+    coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+    return _bitmat_cached(coeffs.tobytes(), *coeffs.shape)
+
+
+def width_bucket(n: int, cap: int) -> int:
+    """Pad widths up to power-of-two buckets (capped) so varied payload
+    widths reuse compiled executables instead of jitting per exact n."""
+    return min(max(512, 1 << (n - 1).bit_length()), cap)
+
+
 class TpuCodec(ReedSolomonCodec):
     """JAX backend. Runs on whatever jax.devices() offers (TPU in prod,
     virtual CPU mesh in tests) — output is bit-identical everywhere."""
@@ -88,10 +100,7 @@ class TpuCodec(ReedSolomonCodec):
             return np.zeros((r, 0), dtype=np.uint8)
         bitmat = _bitmat_cached(coeffs.tobytes(), r, k)
         if n <= self.chunk_bytes:
-            # bucket to the next power of two so varied payload widths reuse
-            # compiled executables instead of jitting per exact n
-            bucket = max(512, 1 << (n - 1).bit_length())
-            bucket = min(bucket, self.chunk_bytes)
+            bucket = width_bucket(n, self.chunk_bytes)
             fn = _coded_fn(k, r, bucket)
             if n < bucket:
                 pad = np.zeros((k, bucket), dtype=np.uint8)
