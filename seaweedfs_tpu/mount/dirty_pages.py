@@ -14,9 +14,12 @@ from typing import List, Optional, Tuple
 class _Interval:
     __slots__ = ("offset", "data")
 
-    def __init__(self, offset: int, data: bytes):
+    def __init__(self, offset: int, data):
         self.offset = offset
-        self.data = data
+        # bytearray: sequential writes extend the last run in amortized
+        # O(appended) — bytes concatenation would re-copy the whole
+        # accumulated run on every 128KB FUSE write (O(n^2) total)
+        self.data = bytearray(data)
 
     @property
     def end(self) -> int:
@@ -40,11 +43,17 @@ class ContinuousIntervals:
         (reference AddInterval)."""
         if not data:
             return
-        new = _Interval(offset, bytes(data))
+        # hot path: a sequential write extends the trailing run in place
+        # (intervals are sorted and disjoint, so offset == last.end
+        # cannot overlap anything)
+        if self.intervals and offset == self.intervals[-1].end:
+            self.intervals[-1].data += data
+            return
+        new = _Interval(offset, data)
         out: List[_Interval] = []
         for iv in self.intervals:
             if iv.end <= new.offset or iv.offset >= new.end:
-                out.append(iv)                      # disjoint
+                out.append(iv)                      # disjoint: reuse
                 continue
             if iv.offset < new.offset:              # keep left remnant
                 out.append(_Interval(
@@ -60,7 +69,7 @@ class ContinuousIntervals:
             if merged and merged[-1].end == iv.offset:
                 merged[-1].data += iv.data
             else:
-                merged.append(_Interval(iv.offset, iv.data))
+                merged.append(iv)
         self.intervals = merged
 
     def read_at(self, buf: bytearray, offset: int) -> int:
@@ -92,7 +101,18 @@ class ContinuousIntervals:
                 out.append(iv)
         self.intervals = out
 
+    def pop_largest(self) -> Optional[Tuple[int, bytes]]:
+        """Remove and return the largest run (the reference's
+        saveExistingLargestPageToStorage spill policy,
+        weed/filesys/dirty_page.go)."""
+        if not self.intervals:
+            return None
+        idx = max(range(len(self.intervals)),
+                  key=lambda i: len(self.intervals[i].data))
+        iv = self.intervals.pop(idx)
+        return iv.offset, bytes(iv.data)
+
     def pop_all(self) -> List[Tuple[int, bytes]]:
-        out = [(iv.offset, iv.data) for iv in self.intervals]
+        out = [(iv.offset, bytes(iv.data)) for iv in self.intervals]
         self.intervals = []
         return out

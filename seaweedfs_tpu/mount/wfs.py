@@ -33,6 +33,9 @@ class _Handle:
         self.entry = entry
         self.dirty = ContinuousIntervals()
         self.new_size = None      # set by truncate while open
+        # chunks already uploaded by the write-path spill but not yet
+        # attached to the entry (that happens at flush)
+        self.pending_chunks = []
 
 
 class WeedFS:
@@ -89,14 +92,15 @@ class WeedFS:
         s.st_blksize = 512
         s.st_blocks = (s.st_size + 511) // 512
 
-    def _read_stored(self, entry: Entry, offset: int,
-                     size: int) -> bytes:
-        if not entry.chunks:
+    def _read_stored(self, entry: Entry, offset: int, size: int,
+                     extra_chunks=None) -> bytes:
+        chunks = list(entry.chunks) + list(extra_chunks or [])
+        if not chunks:
             return b""
-        want = min(size, max(0, total_size(entry.chunks) - offset))
+        want = min(size, max(0, total_size(chunks) - offset))
         if want <= 0:
             return b""
-        return read_chunked(entry.chunks, offset, want, self._fetch)
+        return read_chunked(chunks, offset, want, self._fetch)
 
     # -- fuse_operations ---------------------------------------------------
     def getattr(self, path, st):
@@ -223,12 +227,13 @@ class WeedFS:
         eff_size = total_size(h.entry.chunks)
         if h.new_size is not None:
             eff_size = h.new_size
-        eff_size = max(eff_size, h.dirty.size())
+        eff_size = max(eff_size, h.dirty.size(),
+                       total_size(h.pending_chunks))
         if offset >= eff_size:
             return 0
         want = min(size, eff_size - offset)
         out = bytearray(want)
-        stored = self._read_stored(h.entry, offset, want)
+        stored = self._read_stored(h.entry, offset, want, h.pending_chunks)
         out[:len(stored)] = stored
         h.dirty.read_at(out, offset)
         ctypes.memmove(buf, bytes(out), len(out))
@@ -238,14 +243,47 @@ class WeedFS:
         h = self._handle(fi)
         data = ctypes.string_at(buf, size)
         h.dirty.add(offset, data)
+        self._maybe_spill(h)
         return size
+
+    def _maybe_spill(self, h: "_Handle"):
+        """Bound the dirty-page RAM: once buffered bytes exceed one chunk,
+        upload the largest run now and attach it at flush (the reference's
+        saveExistingLargestPageToStorage, weed/filesys/dirty_page.go) —
+        without this, copying a large file through the mount holds the
+        whole file in memory."""
+        while h.dirty.total_bytes() > self.chunk_size:
+            popped = h.dirty.pop_largest()
+            if popped is None:
+                break
+            run_offset, data = popped
+            try:
+                chunks, _ = split_and_upload(
+                    self.master_url, data, h.entry.name, self.chunk_size,
+                    collection=self.collection,
+                    replication=self.replication)
+            except Exception:
+                # keep the data buffered so nothing is lost; surface the
+                # error to the writer (fuse_ll maps it to -EIO)
+                h.dirty.add(run_offset, data)
+                raise
+            for c in chunks:
+                c.offset += run_offset
+            h.pending_chunks.extend(chunks)
 
     def truncate(self, path, length):
         """Path truncate — fuse2 also routes ftruncate here (the
-        ftruncate slot is NULL), so open handles' dirty buffers and
-        size views must shrink with the entry or a later flush would
-        resurrect the cut bytes."""
+        ftruncate slot is NULL). Open handles holding buffered writes
+        (dirty runs or spilled pending chunks) are flushed first so the
+        truncate operates on the complete logical content; otherwise the
+        materialize-to-length step would read only the stored chunks and
+        overwrite the unflushed bytes with zeros (and a later flush could
+        resurrect cut bytes)."""
         p = self._path(path)
+        for h in self.handles.values():
+            if h.entry.full_path == p and (h.dirty.intervals
+                                           or h.pending_chunks):
+                self._do_flush(h)
         entry = self._entry(p)
         self._truncate_entry(entry, length)
         for h in self.handles.values():
@@ -292,13 +330,22 @@ class WeedFS:
 
     def _flush_handle(self, fi):
         h = self.handles.get(fi.contents.fh)
-        if h is None or (not h.dirty.intervals and h.new_size is None):
+        if h is None:
+            return 0
+        return self._do_flush(h)
+
+    def _do_flush(self, h: "_Handle"):
+        if (not h.dirty.intervals and not h.pending_chunks
+                and h.new_size is None):
             return 0
         # re-fetch: another writer may have updated the entry meanwhile
         try:
             entry = self.client.find_entry(h.entry.full_path)
         except (NotFoundError, HttpError):
             entry = h.entry
+        if h.pending_chunks:
+            entry.chunks = list(entry.chunks) + h.pending_chunks
+            h.pending_chunks = []
         for run_offset, data in h.dirty.pop_all():
             chunks, _ = split_and_upload(
                 self.master_url, data, entry.name, self.chunk_size,

@@ -241,7 +241,11 @@ class MasterServer:
         if self.raft is None:
             return self.topology.next_volume_id()
         with self.topology.lock:
+            # bump before proposing: two concurrent Assign/grow requests
+            # must read distinct values, not both propose max+1 (the raft
+            # apply is max(), so the optimistic local bump converges)
             value = self.topology.max_volume_id + 1
+            self.topology.max_volume_id = value
         self.raft.propose({"type": "max_volume_id", "value": value})
         return value
 

@@ -184,6 +184,7 @@ class Volume:
         with self.lock:
             if self.readonly:
                 raise VolumeError(f"volume {self.id} is read only")
+            self._reject_empty(n)
             # reject overwrites that don't present the original cookie
             # (cookies exist to stop id-guessing; reference
             # volume_read_write.go checks the stored header's cookie)
@@ -221,6 +222,19 @@ class Volume:
                 self.nm.put(n.id, offset, n.size)
             self.last_modified = int(time.time())
             return n.size
+
+    def _reject_empty(self, n: Needle):
+        """Zero-size records ARE the tombstone format on disk (v2/v3):
+        the write path never indexes them and every .dat replayer
+        (rebuild_index, tail replication, vacuum) treats size==0 as a
+        delete — matching the reference (fix.go, volume_read_write.go).
+        Reject the write loudly instead of silently storing a needle
+        that could never be read back."""
+        if len(n.data) == 0 and self.version != 1:
+            raise VolumeError(
+                f"needle {n.id}: empty data — zero-size records are "
+                "tombstones; store empty objects at the filer layer "
+                "(an entry with no chunks)")
 
     def delete_needle(self, n: Needle) -> int:
         """Append a tombstone; returns freed size (0 if absent)."""

@@ -172,6 +172,12 @@ def read_incremental(volume: Volume, since_ns: int,
         for n, offset, actual in walk_records(pread, volume.version,
                                               start, end):
             if offset + actual - start > max_bytes:
+                if cap == start:
+                    # the first pending record alone exceeds the cap:
+                    # ship it anyway, or pagination would return an
+                    # empty page forever and the follower would silently
+                    # stop advancing
+                    cap = offset + actual
                 break
             cap = offset + actual
         end = cap

@@ -361,9 +361,25 @@ class RaftNode:
                 return {"term": self.current_term, "success": False}
             entries = req["entries"]
             if entries:
-                # drop conflicting suffix, append the leader's entries
-                self.log = self.log[:prev] + entries
-                self._persist()
+                # Raft §5.3: truncate only from the first index where the
+                # terms conflict, then append the genuinely new suffix — a
+                # delayed/duplicated AppendEntries carrying an older
+                # overlapping window must not wipe entries the follower
+                # already acknowledged (possibly committed)
+                changed = False
+                for i, e in enumerate(entries):
+                    idx = prev + i  # 0-based slot of this entry
+                    if idx < len(self.log):
+                        if self.log[idx]["term"] != e["term"]:
+                            self.log = self.log[:idx] + entries[i:]
+                            changed = True
+                            break
+                    else:
+                        self.log = self.log + entries[i:]
+                        changed = True
+                        break
+                if changed:
+                    self._persist()
             if req["leader_commit"] > self.commit_index:
                 self.commit_index = min(req["leader_commit"],
                                         len(self.log))

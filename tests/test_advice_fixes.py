@@ -1,0 +1,116 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+oversized-record pagination stall, raft id-allocation race, raft log
+truncation of acknowledged entries, and the zero-size-record ambiguity.
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import volume_backup
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+from seaweedfs_tpu.topology.raft import RaftNode
+
+
+# -- ADVICE: read_incremental stalls on a record larger than max_bytes ----
+
+def test_read_incremental_oversized_record_still_ships(tmp_path):
+    v = Volume(str(tmp_path), "", 1, create=True)
+    v.write_needle(Needle(cookie=1, id=1, data=b"x" * 50))
+    cursor = volume_backup.last_append_at_ns(v)
+    big = bytes(np.random.default_rng(0).integers(0, 256, 200_000,
+                                                  dtype=np.uint8))
+    v.write_needle(Needle(cookie=2, id=2, data=big))
+    # cap far below the record size: the page must contain the whole
+    # record (previously: empty page -> follower stops advancing forever)
+    page = volume_backup.read_incremental(v, cursor, max_bytes=1000)
+    assert len(page) > len(big)
+
+    dst = Volume(str(tmp_path / "dst"), "", 1, create=True)
+    applied, _ = volume_backup.append_raw_records(dst, page, cursor)
+    assert applied == 1
+    got = dst.read_needle(2, cookie=2)
+    assert got.data == big
+
+
+def test_read_incremental_cap_still_paginates(tmp_path):
+    """Normal pagination (records smaller than the cap) is unchanged."""
+    v = Volume(str(tmp_path), "", 1, create=True)
+    for i in range(1, 6):
+        v.write_needle(Needle(cookie=i, id=i, data=b"y" * 100))
+    full = volume_backup.read_incremental(v, 0)
+    page = volume_backup.read_incremental(v, 0, max_bytes=len(full) // 2)
+    assert 0 < len(page) < len(full)
+
+
+# -- ADVICE: raft-mode volume id allocation is read-then-propose ----------
+
+class _StubRaft:
+    def __init__(self):
+        self.proposed = []
+
+    def propose(self, cmd):
+        # deliberately do NOT apply: the race window is exactly the gap
+        # between propose and commit/apply
+        self.proposed.append(cmd)
+
+
+def test_next_volume_id_distinct_before_apply():
+    from seaweedfs_tpu.server.master import MasterServer
+    ms = MasterServer(port=0)
+    ms.raft = _StubRaft()
+    a = ms._next_volume_id()
+    b = ms._next_volume_id()
+    assert a != b
+    assert ms.raft.proposed == [
+        {"type": "max_volume_id", "value": a},
+        {"type": "max_volume_id", "value": b}]
+
+
+# -- ADVICE: follower log truncation must stop at the first conflict ------
+
+def _entry(term, n):
+    return {"term": term, "command": {"n": n}}
+
+
+def _append_req(term, prev, entries, commit=0, leader="ldr:1"):
+    prev_term = 0
+    return {"term": term, "leader_id": leader, "prev_log_index": prev,
+            "prev_log_term": prev_term, "entries": entries,
+            "leader_commit": commit}
+
+
+def test_duplicate_append_does_not_truncate_acked_suffix():
+    node = RaftNode("f:1", ["f:1", "ldr:1"], lambda c: None,
+                    transport=lambda *a: {"term": 0})
+    r = node.handle_append_entries(
+        _append_req(1, 0, [_entry(1, 0), _entry(1, 1), _entry(1, 2)]))
+    assert r["success"] and len(node.log) == 3
+    # delayed retransmission of an older window
+    r = node.handle_append_entries(_append_req(1, 0, [_entry(1, 0)]))
+    assert r["success"]
+    assert len(node.log) == 3, "acked suffix was truncated"
+
+
+def test_conflicting_suffix_truncates_from_conflict():
+    node = RaftNode("f:1", ["f:1", "ldr:1"], lambda c: None,
+                    transport=lambda *a: {"term": 0})
+    node.handle_append_entries(
+        _append_req(1, 0, [_entry(1, 0), _entry(1, 1), _entry(1, 2)]))
+    # new leader at term 2 rewrites from index 1
+    r = node.handle_append_entries(
+        _append_req(2, 0, [_entry(1, 0), _entry(2, 9)]))
+    assert r["success"]
+    assert [e["term"] for e in node.log] == [1, 2]
+    assert node.log[1]["command"] == {"n": 9}
+
+
+# -- ADVICE: zero-size records are tombstones; reject empty writes --------
+
+def test_empty_needle_write_rejected(tmp_path):
+    v = Volume(str(tmp_path), "", 1, create=True)
+    with pytest.raises(VolumeError, match="empty data"):
+        v.write_needle(Needle(cookie=1, id=1, data=b""))
+    # the volume remains usable
+    v.write_needle(Needle(cookie=2, id=2, data=b"ok"))
+    assert v.read_needle(2, cookie=2).data == b"ok"
