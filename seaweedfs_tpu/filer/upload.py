@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import hashlib
 import time
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from ..client import operation
 from ..util import encrypt, gzip_data, is_compressible
@@ -26,16 +26,26 @@ def split_and_upload(master_url: str, data: bytes, filename: str,
                      replication: str = "", ttl: str = "",
                      content_type: str = "application/octet-stream",
                      cipher: bool = False, compress: bool = False,
+                     uploaded: Optional[List[FileChunk]] = None,
                      ) -> Tuple[List[FileChunk], str]:
-    """Upload `data` as one or more chunks; returns (chunks, md5hex)."""
+    """Upload `data` as one or more chunks; returns (chunks, md5hex).
+
+    Empty data uploads nothing and returns ([], md5-of-empty): zero-size
+    records are tombstones at the volume layer, so empty objects live as
+    an entry with no chunks (matching the reference, whose autoChunk loop
+    reads zero chunks from an empty body). If the caller passes an
+    ``uploaded`` list, every chunk is appended to it the moment its
+    upload succeeds, so a caller that catches a mid-stream failure can
+    queue the already-landed fids for deletion instead of leaking them.
+    """
     now_ns = time.time_ns()
-    chunks: List[FileChunk] = []
+    chunks: List[FileChunk] = [] if uploaded is None else uploaded
     md5 = hashlib.md5()
+    if not data:
+        return [], md5.hexdigest()
     want_gzip = compress and is_compressible(filename, content_type)
-    for i in range(0, max(len(data), 1), chunk_size):
+    for i in range(0, len(data), chunk_size):
         piece = data[i:i + chunk_size]
-        if not piece and i > 0:
-            break
         md5.update(piece)
         blob, is_gzipped, key = piece, False, b""
         if want_gzip and len(piece) > 128:

@@ -257,15 +257,20 @@ class WeedFS:
             if popped is None:
                 break
             run_offset, data = popped
+            landed = []
             try:
                 chunks, _ = split_and_upload(
                     self.master_url, data, h.entry.name, self.chunk_size,
                     collection=self.collection,
-                    replication=self.replication)
+                    replication=self.replication, uploaded=landed)
             except Exception:
                 # keep the data buffered so nothing is lost; surface the
-                # error to the writer (fuse_ll maps it to -EIO)
+                # error to the writer (fuse_ll maps it to -EIO). Chunks
+                # that already landed before the failing piece would be
+                # re-uploaded on retry — queue them for deletion so they
+                # don't leak on volume servers.
                 h.dirty.add(run_offset, data)
+                self._queue_deletion_quiet(landed)
                 raise
             for c in chunks:
                 c.offset += run_offset
@@ -305,20 +310,31 @@ class WeedFS:
             # model has no truncate marker
             content = self._read_stored(entry, 0, length)
             content = content.ljust(length, b"\x00")
-            chunks, _ = split_and_upload(
-                self.master_url, content, entry.name,
-                self.chunk_size, collection=self.collection,
-                replication=self.replication)
+            landed: list = []
+            try:
+                chunks, _ = split_and_upload(
+                    self.master_url, content, entry.name,
+                    self.chunk_size, collection=self.collection,
+                    replication=self.replication, uploaded=landed)
+            except Exception:
+                self._queue_deletion_quiet(landed)
+                raise
             entry.chunks = chunks
         entry.attr.mtime = time.time()
         self.client.update_entry(entry)
-        if old_chunks:
-            # replaced chunks would otherwise sit on volume servers
-            # forever (every open(.., 'w') rewrite truncates first)
-            try:
-                self.client.queue_chunk_deletion(old_chunks)
-            except HttpError:
-                pass
+        # replaced chunks would otherwise sit on volume servers forever
+        # (every open(.., 'w') rewrite truncates first)
+        self._queue_deletion_quiet(old_chunks)
+
+    def _queue_deletion_quiet(self, chunks):
+        """Best-effort chunk-deletion queueing from error/cleanup paths: a
+        filer hiccup here must not mask the original failure."""
+        if not chunks:
+            return
+        try:
+            self.client.queue_chunk_deletion(chunks)
+        except Exception:
+            pass
 
     def flush(self, path, fi):
         return self._flush_handle(fi)
@@ -343,17 +359,33 @@ class WeedFS:
             entry = self.client.find_entry(h.entry.full_path)
         except (NotFoundError, HttpError):
             entry = h.entry
+        moved_pending = []
         if h.pending_chunks:
-            entry.chunks = list(entry.chunks) + h.pending_chunks
+            moved_pending = h.pending_chunks
             h.pending_chunks = []
-        for run_offset, data in h.dirty.pop_all():
-            chunks, _ = split_and_upload(
-                self.master_url, data, entry.name, self.chunk_size,
-                collection=self.collection,
-                replication=self.replication)
+        runs = h.dirty.pop_all()
+        new_chunks: list = []
+        for idx, (run_offset, data) in enumerate(runs):
+            landed: list = []
+            try:
+                chunks, _ = split_and_upload(
+                    self.master_url, data, entry.name, self.chunk_size,
+                    collection=self.collection,
+                    replication=self.replication, uploaded=landed)
+            except Exception:
+                # nothing is lost: every popped run (finished or not) goes
+                # back into the dirty buffer and the spilled chunks back to
+                # pending, so a retried flush re-uploads from scratch;
+                # fids that already landed are queued for deletion
+                h.pending_chunks = moved_pending
+                for off2, data2 in runs:
+                    h.dirty.add(off2, data2)
+                self._queue_deletion_quiet(new_chunks + landed)
+                raise
             for c in chunks:
                 c.offset += run_offset
-            entry.chunks = list(entry.chunks) + chunks
+            new_chunks.extend(chunks)
+        entry.chunks = list(entry.chunks) + moved_pending + new_chunks
         entry.attr.mtime = time.time()
         try:
             self.client.update_entry(entry)

@@ -246,7 +246,17 @@ class MasterServer:
             # apply is max(), so the optimistic local bump converges)
             value = self.topology.max_volume_id + 1
             self.topology.max_volume_id = value
-        self.raft.propose({"type": "max_volume_id", "value": value})
+        try:
+            self.raft.propose({"type": "max_volume_id", "value": value})
+        except Exception:
+            # roll back the optimistic bump (only if no later bump landed
+            # on top) so a failed propose — e.g. NotLeaderError during a
+            # transition — doesn't leave the counter inflated and
+            # un-backed by any raft entry
+            with self.topology.lock:
+                if self.topology.max_volume_id == value:
+                    self.topology.max_volume_id = value - 1
+            raise
         return value
 
     def _grow_volumes(self, collection: str, replication: str, ttl: TTL,

@@ -76,6 +76,7 @@ class Volume:
             return
 
         if create and not os.path.exists(self.dat_path):
+            os.makedirs(dirname, exist_ok=True)
             sb = SuperBlock(
                 replica_placement=replica_placement or ReplicaPlacement(),
                 ttl=ttl or TTL())

@@ -29,7 +29,7 @@ def test_read_incremental_oversized_record_still_ships(tmp_path):
     dst = Volume(str(tmp_path / "dst"), "", 1, create=True)
     applied, _ = volume_backup.append_raw_records(dst, page, cursor)
     assert applied == 1
-    got = dst.read_needle(2, cookie=2)
+    got = dst.read_needle(Needle(id=2, cookie=2))
     assert got.data == big
 
 
@@ -113,4 +113,4 @@ def test_empty_needle_write_rejected(tmp_path):
         v.write_needle(Needle(cookie=1, id=1, data=b""))
     # the volume remains usable
     v.write_needle(Needle(cookie=2, id=2, data=b"ok"))
-    assert v.read_needle(2, cookie=2).data == b"ok"
+    assert v.read_needle(Needle(id=2, cookie=2)).data == b"ok"

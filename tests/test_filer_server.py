@@ -50,6 +50,19 @@ def test_upload_read_small(cluster):
     assert got == data
 
 
+def test_empty_upload_round_trips(cluster):
+    """PUT of an empty body stores an entry with no chunks (round-2
+    advisor: the volume layer rejects zero-size needles — tombstone
+    format — so empties must live purely at the filer layer)."""
+    _, _, filer = cluster
+    r = post_multipart(furl(filer, "/docs/empty.txt"), "empty.txt", b"",
+                       "text/plain")
+    assert r["size"] == 0
+    entry = filer.filer.find_entry("/docs/empty.txt")
+    assert entry.chunks == []
+    assert http_call("GET", furl(filer, "/docs/empty.txt")) == b""
+
+
 def test_chunked_upload_and_range(cluster):
     _, _, filer = cluster
     data = bytes(range(256)) * 20  # 5120 bytes -> 5 chunks of 1024
