@@ -30,7 +30,7 @@ from typing import Iterable, Iterator, Tuple
 
 import numpy as np
 
-from .rs_tpu import lift_coeffs, width_bucket
+from .rs_tpu import fn_and_bitmat, width_bucket
 
 _SENTINEL = object()
 
@@ -52,20 +52,23 @@ class PipelinedMatmul:
         self.depth = int(depth)
         self.prefetch = int(prefetch)
         self.drain_threads = int(drain_threads)
-        self._bitmat_np = lift_coeffs(coeffs)
+        self._coeffs = coeffs
         self._bitmat_dev = None
 
     def _fn(self, width: int):
-        from .rs_tpu import _coded_fn
-        return _coded_fn(self.k, self.r, width)
+        """Platform-appropriate kernel for this width (fused Pallas on
+        TPU, XLA elsewhere); also lazily uploads the matching bitmat on
+        first use — the choice must happen at stream time, after the
+        backend is known."""
+        fn, bitmat_np = fn_and_bitmat(self._coeffs, width)
+        if self._bitmat_dev is None:
+            import jax.numpy as jnp
+            self._bitmat_dev = jnp.asarray(bitmat_np)
+        return fn
 
     def stream(self, slabs: Iterable[Tuple[object, np.ndarray]]
                ) -> Iterator[Tuple[object, np.ndarray, np.ndarray]]:
         import jax.numpy as jnp
-
-        if self._bitmat_dev is None:
-            self._bitmat_dev = jnp.asarray(self._bitmat_np)
-        bitmat = self._bitmat_dev
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err: list = []
@@ -107,8 +110,9 @@ class PipelinedMatmul:
                     padded[:, :w] = data
                 else:
                     padded = data
+                fn = self._fn(bucket)                # also uploads bitmat
                 dev = jnp.asarray(padded)            # async h2d
-                out = self._fn(bucket)(bitmat, dev)  # async dispatch
+                out = fn(self._bitmat_dev, dev)      # async dispatch
                 fut = drain_pool.submit(np.asarray, out)
                 pending.append((meta, data, fut, w))
                 if len(pending) >= self.depth:

@@ -67,10 +67,25 @@ def _bitmat_cached(coeff_bytes: bytes, r: int, k: int):
     return gf256.bit_matrix(coeffs).astype(np.int8)
 
 
-def lift_coeffs(coeffs: np.ndarray) -> np.ndarray:
-    """GF(2) bit-plane lift of a byte coefficient matrix, int8 for the MXU."""
+def on_tpu() -> bool:
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def fn_and_bitmat(coeffs: np.ndarray, n: int):
+    """Pick the device kernel for this platform: the fused Pallas kernel
+    on real TPU (ops/rs_pallas — unpack/matmul/pack in VMEM, no HBM
+    temporaries), the plain XLA program elsewhere (the CPU test mesh,
+    where Pallas would have to interpret). Returns (jitted fn, host
+    bitmat) with matching layouts; both are bit-identical to the numpy
+    oracle."""
     coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
-    return _bitmat_cached(coeffs.tobytes(), *coeffs.shape)
+    r, k = coeffs.shape
+    if on_tpu():
+        from .rs_pallas import _fused_fn, fuse_bitmat, pick_tile
+        return (_fused_fn(k, r, n, pick_tile(k, r, n), False),
+                fuse_bitmat(coeffs))
+    return _coded_fn(k, r, n), _bitmat_cached(coeffs.tobytes(), r, k)
 
 
 def width_bucket(n: int, cap: int) -> int:
@@ -98,17 +113,16 @@ class TpuCodec(ReedSolomonCodec):
         n = data.shape[1]
         if n == 0:
             return np.zeros((r, 0), dtype=np.uint8)
-        bitmat = _bitmat_cached(coeffs.tobytes(), r, k)
         if n <= self.chunk_bytes:
             bucket = width_bucket(n, self.chunk_bytes)
-            fn = _coded_fn(k, r, bucket)
+            fn, bitmat = fn_and_bitmat(coeffs, bucket)
             if n < bucket:
                 pad = np.zeros((k, bucket), dtype=np.uint8)
                 pad[:, :n] = data
                 return np.asarray(fn(bitmat, pad))[:, :n]
             return np.asarray(fn(bitmat, data))
         out = np.empty((r, n), dtype=np.uint8)
-        fn = _coded_fn(k, r, self.chunk_bytes)
+        fn, bitmat = fn_and_bitmat(coeffs, self.chunk_bytes)
         for off in range(0, n, self.chunk_bytes):
             end = min(off + self.chunk_bytes, n)
             chunk = data[:, off:end]
@@ -125,16 +139,12 @@ class TpuCodec(ReedSolomonCodec):
 # Raw jax-level entry points (used by bench.py, __graft_entry__, parallel/)
 # ---------------------------------------------------------------------------
 
-def encode_bitmat(k: int, m: int, matrix_kind: str = "vandermonde") -> np.ndarray:
-    """The (k*8, m*8) int8 GF(2) lift of the parity rows."""
-    matrix = gf256.build_matrix(k, k + m, matrix_kind)
-    return gf256.bit_matrix(matrix[k:]).astype(np.int8)
-
-
 def make_encode_fn(k: int, m: int, n: int, matrix_kind: str = "vandermonde"):
     """Returns (jitted_fn, bitmat): jitted_fn(bitmat, data (k, n)) -> (m, n).
 
-    This is the single-device flagship kernel; parallel/sharded_ec wraps it
-    in a mesh for multi-chip encode.
+    This is the single-device flagship kernel (fused Pallas on TPU, XLA
+    elsewhere); parallel/sharded_ec wraps the XLA variant in a mesh for
+    multi-chip encode.
     """
-    return _coded_fn(k, m, n), encode_bitmat(k, m, matrix_kind)
+    matrix = gf256.build_matrix(k, k + m, matrix_kind)
+    return fn_and_bitmat(matrix[k:], n)

@@ -40,3 +40,28 @@ def test_distributed_step_alt_geometry():
     parity, rebuilt, diff = distributed_ec_step(mesh, k=6, m=3,
                                                 n_per_device=512)
     assert diff == 0
+
+
+def test_uneven_mesh_shapes():
+    """Meshes whose 'shard' axis does not divide the parity rows (3 rows
+    over shard=2 -> replicated output) and single-axis meshes."""
+    devs = jax.devices()
+    for shape, subset in [((2, 2), devs[:4]), ((3, 1), devs[:3]),
+                          ((1, 2), devs[:2])]:
+        mesh = make_mesh(shape=shape, devices=subset)
+        parity, rebuilt, diff = distributed_ec_step(mesh, k=6, m=3,
+                                                    n_per_device=256)
+        assert diff == 0, shape
+        ref = NumpyCodec(6, 3).encode(
+            np.random.default_rng(0).integers(
+                0, 256, (6, 256 * mesh.shape["data"]), dtype=np.uint8))
+        assert np.array_equal(parity, ref), shape
+
+
+def test_odd_payload_not_multiple_of_lanes():
+    """n per device not a multiple of 128 lanes — GSPMD must still give
+    bit-exact results (padding stays internal)."""
+    mesh = make_mesh()
+    parity, rebuilt, diff = distributed_ec_step(mesh, k=10, m=4,
+                                                n_per_device=333)
+    assert diff == 0
