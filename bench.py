@@ -143,8 +143,9 @@ def init_device(timeout_s: float):
 def probe_link():
     """Measure raw h2d/d2h of the host↔device link at bench time. The
     axon tunnel's bandwidth is shared and varies run to run (observed
-    h2d 74MB/s..1.4GB/s, d2h 8..43MB/s); this records the conditions the
-    e2e number was taken under so it can be interpreted."""
+    h2d 46MB/s..1.4GB/s, d2h 8..43MB/s); this records the conditions the
+    e2e number was taken under so it can be interpreted. Returns
+    (h2d, d2h) MB/s."""
     import jax.numpy as jnp
     a = np.zeros(32 << 20, dtype=np.uint8)
     t = time.perf_counter()
@@ -155,12 +156,19 @@ def probe_link():
     np.asarray(dev)
     d2h = a.nbytes / (time.perf_counter() - t) / 1e6
     log(f"link probe: h2d {h2d:.0f} MB/s, d2h {d2h:.0f} MB/s "
-        f"(e2e TPU encode is bounded by ~d2h/0.4 payload MB/s)")
+        f"(e2e TPU encode is bounded by ~min(h2d, d2h/0.4) payload MB/s)")
+    return h2d, d2h
 
 
-def measure_tpu_e2e(base: str, dat_size: int, slab_mb: int) -> float:
+def measure_tpu_e2e(base: str, dat_size: int, slab_mb: int):
+    """Returns (best MB/s, stage dict of the best trial). Each trial logs
+    a per-stage breakdown (VERDICT r2 #2) and the pipeline efficiency
+    against the link bound measured *inside* that trial (effective h2d /
+    d2h rates over the stages' busy windows — the isolated probe is a
+    different instant on a shared tunnel)."""
     from seaweedfs_tpu.ec import write_ec_files
     from seaweedfs_tpu.ops.rs_tpu import TpuCodec
+    from seaweedfs_tpu.util.profiling import StageTimer, maybe_trace
     codec = TpuCodec(K, M)
     # warm the compile cache for every power-of-two bucket the coalesced
     # stream can hit (steady-state batches are exactly slab wide; the tail
@@ -174,17 +182,39 @@ def measure_tpu_e2e(base: str, dat_size: int, slab_mb: int) -> float:
         w >>= 1
     list(warm.stream(iter(
         [(0, np.zeros((K, wi), dtype=np.uint8)) for wi in widths])))
-    best = 0.0
+    best, best_stages = 0.0, {}
     for trial in range(TRIALS):
         os.sync()  # settle prior-pass writeback so timing starts clean
+        timer = StageTimer()
         t = time.perf_counter()
-        write_ec_files(base, codec=codec, slab=slab_mb << 20, pipelined=True)
+        with maybe_trace(f"tpu_e2e_encode_t{trial}"):
+            write_ec_files(base, codec=codec, slab=slab_mb << 20,
+                           pipelined=True, timer=timer)
         dt = time.perf_counter() - t
-        best = max(best, dat_size / dt / 1e6)
+        mbps = dat_size / dt / 1e6
         log(f"tpu e2e encode trial {trial} (disk+h2d+mxu+d2h+write): "
-            f"{dat_size / dt / 1e6:.0f} MB/s ({dt:.1f}s, "
+            f"{mbps:.0f} MB/s ({dt:.1f}s, "
             f"{slab_mb}MB coalesced batches per device call)")
-    return best
+        log(f"  stages: {timer.summary()}")
+        h2d_eff = timer.rate_mbps("h2d", use_busy=True)
+        d2h_eff = timer.rate_mbps("d2h+mxu", use_busy=True)
+        stages = {
+            "h2d_eff_mbps": round(h2d_eff, 1),
+            "d2h_eff_mbps": round(d2h_eff, 1),
+            "d2h_busy_frac": round(timer.busy_time("d2h+mxu") / dt, 2),
+            "disk_read_mbps": round(timer.rate_mbps("disk_read", True), 1),
+            "shard_write_mbps": round(
+                timer.rate_mbps("shard_write", True), 1),
+        }
+        if h2d_eff and d2h_eff:
+            bound = min(h2d_eff, d2h_eff / (M / K))
+            stages["in_run_link_bound_mbps"] = round(bound, 1)
+            stages["e2e_vs_link_bound"] = round(mbps / bound, 2)
+            log(f"  in-run link bound min(h2d, d2h/{M / K}) = "
+                f"{bound:.0f} MB/s -> e2e at {mbps / bound:.0%} of bound")
+        if mbps > best:
+            best, best_stages = mbps, stages
+    return best, best_stages
 
 
 def measure_tpu_rebuild(base: str, dat_size: int, slab_mb: int):
@@ -209,9 +239,31 @@ def measure_tpu_rebuild(base: str, dat_size: int, slab_mb: int):
         f"({dt:.1f}s, dropped {dropped}, digests verified)")
 
 
+def measure_cpu_inmem(slab_mb: int, iters: int = 6) -> float:
+    """Like-for-like denominator for the device-resident figure: the
+    native AVX2-style codec on in-memory buffers, no file I/O."""
+    from seaweedfs_tpu.ops.codec import get_codec
+    if not ensure_native():
+        return 0.0
+    codec = get_codec(K, M, backend="native")
+    n = slab_mb << 20
+    rng = np.random.default_rng(2)
+    bufs = [rng.integers(0, 256, (K, n), dtype=np.uint8) for _ in range(3)]
+    codec.encode(bufs[0])  # warm threads
+    times = []
+    for i in range(iters):
+        t = time.perf_counter()
+        codec.encode(bufs[i % len(bufs)])
+        times.append(time.perf_counter() - t)
+    best = (K * n) / min(times) / 1e6
+    log(f"cpu[native] in-memory encode (no I/O): best {best:.0f} MB/s")
+    return best
+
+
 def measure_device_resident(slab_mb: int, iters: int = 8):
     """Honest device-resident figure: per-iteration sync, rotating fresh
-    buffers so no result can be served from an unexecuted cached launch."""
+    buffers so no result can be served from an unexecuted cached launch.
+    Returns (median, best, pipelined) MB/s."""
     import jax.numpy as jnp
     from seaweedfs_tpu.ops.rs_tpu import make_encode_fn
     n = slab_mb << 20
@@ -242,12 +294,15 @@ def measure_device_resident(slab_mb: int, iters: int = 8):
     thr = (K * n * iters) / (time.perf_counter() - t) / 1e6
     log(f"tpu device-resident encode (pipelined dispatch, one sync): "
         f"{thr:.0f} MB/s")
+    return med, best, thr
 
 
-def emit(value: float, vs_baseline: float):
-    print(json.dumps({"metric": "ec_encode_e2e_rs10_4_mbps",
-                      "value": round(value, 1), "unit": "MB/s",
-                      "vs_baseline": round(vs_baseline, 2)}))
+def emit(value: float, vs_baseline: float, **extras):
+    line = {"metric": "ec_encode_e2e_rs10_4_mbps",
+            "value": round(value, 1), "unit": "MB/s",
+            "vs_baseline": round(vs_baseline, 2)}
+    line.update(extras)
+    print(json.dumps(line))
 
 
 def main():
@@ -271,8 +326,8 @@ def main():
             return
         log(f"devices: {devices}")
         try:
-            probe_link()
-            tpu_mbps = measure_tpu_e2e(base, dat_size, slab_mb)
+            h2d, d2h = probe_link()
+            tpu_mbps, stages = measure_tpu_e2e(base, dat_size, slab_mb)
         except Exception as e:  # noqa: BLE001 - tunnel flakiness: fall back
             log(f"tpu bench failed: {e!r}")
             emit(cpu_mbps, 1.0)
@@ -283,11 +338,22 @@ def main():
             raise AssertionError("TPU shards != native shards")
         log("all 14 shard digests identical to the native path")
         measure_tpu_rebuild(base, dat_size, slab_mb)
+        extras = {"link_probe_mbps": {"h2d": round(h2d), "d2h": round(d2h)},
+                  "stages": stages,
+                  "note": ("e2e is bounded by the shared axon tunnel "
+                           "(environmental); device_resident vs "
+                           "cpu_inmem is the like-for-like kernel "
+                           "comparison")}
         try:
-            measure_device_resident(slab_mb)
+            med, best, thr = measure_device_resident(slab_mb)
+            cpu_inmem = measure_cpu_inmem(slab_mb)
+            extras["device_resident_mbps"] = round(thr)
+            extras["cpu_inmem_mbps"] = round(cpu_inmem)
+            if cpu_inmem:
+                extras["device_vs_cpu_inmem"] = round(thr / cpu_inmem, 1)
         except Exception as e:  # noqa: BLE001 - secondary metric only
             log(f"device-resident measurement failed: {e!r}")
-        emit(tpu_mbps, tpu_mbps / cpu_mbps)
+        emit(tpu_mbps, tpu_mbps / cpu_mbps, **extras)
     finally:
         if not os.environ.get("SW_BENCH_KEEP"):
             if user_dir:

@@ -26,12 +26,14 @@ are identical across all backends.
 from __future__ import annotations
 
 import os
+import time
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from ..ops.codec import ReedSolomonCodec, get_codec
 from ..storage.needle_map import MemDb
+from ..util.profiling import StageTimer
 from .constants import (DATA_SHARDS, LARGE_BLOCK_SIZE, PARITY_SHARDS,
                         SMALL_BLOCK_SIZE, to_ext)
 
@@ -44,23 +46,29 @@ def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx"):
     db.save_to_idx(base_name + ext)
 
 
-def _row_slabs(f, k: int, start: int, block_size: int, slab: int
+def _row_slabs(f, k: int, start: int, block_size: int, slab: int,
+               timer: Optional[StageTimer] = None
                ) -> Iterator[Tuple[None, np.ndarray]]:
     """Yield the slabs of one row of k blocks at [start, start+k*block)."""
     step = min(slab, block_size)
     for off in range(0, block_size, step):
         width = min(step, block_size - off)  # final chunk may be partial
+        t0 = time.perf_counter()
         data = np.zeros((k, width), dtype=np.uint8)
         for i in range(k):
             f.seek(start + i * block_size + off)
             chunk = f.read(width)
             if chunk:
                 data[i, :len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+        if timer is not None:
+            end = time.perf_counter()
+            timer.add("disk_read", end - t0, k * width, interval=(t0, end))
         yield None, data
 
 
 def _dat_slabs(dat_path: str, dat_size: int, k: int, large_block: int,
-               small_block: int, slab: int
+               small_block: int, slab: int,
+               timer: Optional[StageTimer] = None
                ) -> Iterator[Tuple[None, np.ndarray]]:
     """All slabs of a .dat in shard-file order (large rows, then small)."""
     with open(dat_path, "rb") as f:
@@ -68,12 +76,12 @@ def _dat_slabs(dat_path: str, dat_size: int, k: int, large_block: int,
         processed = 0
         large_row = large_block * k
         while remaining > large_row:
-            yield from _row_slabs(f, k, processed, large_block, slab)
+            yield from _row_slabs(f, k, processed, large_block, slab, timer)
             remaining -= large_row
             processed += large_row
         small_row = small_block * k
         while remaining > 0:
-            yield from _row_slabs(f, k, processed, small_block, slab)
+            yield from _row_slabs(f, k, processed, small_block, slab, timer)
             remaining -= small_row
             processed += small_row
 
@@ -107,12 +115,14 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                    large_block: int = LARGE_BLOCK_SIZE,
                    small_block: int = SMALL_BLOCK_SIZE,
                    slab: int = DEFAULT_SLAB,
-                   pipelined: Optional[bool] = None):
+                   pipelined: Optional[bool] = None,
+                   timer: Optional[StageTimer] = None):
     """Encode base_name.dat into base_name.ec00 .. .ec{k+m-1}.
 
     pipelined: None = auto (pipeline when the codec is device-backed);
     True/False forces. The synchronous path and the pipelined path produce
-    byte-identical shard files.
+    byte-identical shard files. ``timer`` collects a per-stage breakdown
+    (disk_read / h2d / d2h+mxu / shard_write / waits) for bench/profiling.
     """
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
     k, m = codec.k, codec.m
@@ -120,21 +130,28 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
         pipelined = codec.backend == "tpu"
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
-    slabs = _dat_slabs(dat_path, dat_size, k, large_block, small_block, slab)
+    slabs = _dat_slabs(dat_path, dat_size, k, large_block, small_block, slab,
+                       timer)
     outs = [open(base_name + to_ext(i), "wb") for i in range(k + m)]
     try:
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
-            pm = PipelinedMatmul(codec.matrix[k:], max_width=slab)
+            pm = PipelinedMatmul(codec.matrix[k:], max_width=slab,
+                                 timer=timer)
             stream = pm.stream(_coalesce_slabs(slabs, slab))
         else:
             stream = ((meta, data, codec.encode(data))
                       for meta, data in slabs)
         for _, data, parity in stream:
+            t0 = time.perf_counter()
             for i in range(k):
                 outs[i].write(data[i].tobytes())
             for j in range(m):
                 outs[k + j].write(parity[j].tobytes())
+            if timer is not None:
+                end = time.perf_counter()
+                timer.add("shard_write", end - t0,
+                          data.nbytes + parity.nbytes, interval=(t0, end))
     finally:
         for o in outs:
             o.close()

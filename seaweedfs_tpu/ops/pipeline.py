@@ -24,13 +24,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterable, Iterator, Tuple
+from typing import Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
 from .rs_tpu import fn_and_bitmat, width_bucket
+from ..util.profiling import StageTimer
 
 _SENTINEL = object()
 
@@ -45,13 +47,15 @@ class PipelinedMatmul:
 
     def __init__(self, coeffs: np.ndarray,
                  max_width: int = 32 << 20, depth: int = 4,
-                 prefetch: int = 3, drain_threads: int = 2):
+                 prefetch: int = 3, drain_threads: int = 2,
+                 timer: Optional[StageTimer] = None):
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         self.r, self.k = coeffs.shape
         self.max_width = int(max_width)
         self.depth = int(depth)
         self.prefetch = int(prefetch)
         self.drain_threads = int(drain_threads)
+        self.timer = timer  # optional per-stage breakdown (bench/profiling)
         self._coeffs = coeffs
         self._bitmat_dev = None
 
@@ -94,9 +98,23 @@ class PipelinedMatmul:
         # interleaves uploads and downloads)
         drain_pool = ThreadPoolExecutor(max_workers=self.drain_threads)
         pending: deque = deque()
+        timer = self.timer
+
+        def fetch(out, nbytes):
+            if timer is None:
+                return np.asarray(out)
+            t = time.perf_counter()
+            host = np.asarray(out)
+            end = time.perf_counter()
+            timer.add("d2h+mxu", end - t, nbytes, interval=(t, end))
+            return host
+
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                if timer is not None:
+                    timer.add("read_wait", time.perf_counter() - t0)
                 if item is _SENTINEL:
                     break
                 meta, data = item
@@ -111,9 +129,14 @@ class PipelinedMatmul:
                 else:
                     padded = data
                 fn = self._fn(bucket)                # also uploads bitmat
-                dev = jnp.asarray(padded)            # async h2d
+                t0 = time.perf_counter()
+                dev = jnp.asarray(padded)            # h2d (blocking copy)
+                if timer is not None:
+                    end = time.perf_counter()
+                    timer.add("h2d", end - t0, padded.nbytes,
+                              interval=(t0, end))
                 out = fn(self._bitmat_dev, dev)      # async dispatch
-                fut = drain_pool.submit(np.asarray, out)
+                fut = drain_pool.submit(fetch, out, self.r * bucket)
                 pending.append((meta, data, fut, w))
                 if len(pending) >= self.depth:
                     yield self._drain(pending.popleft())
@@ -133,10 +156,12 @@ class PipelinedMatmul:
                     pass
             reader.join(timeout=10)
 
-    @staticmethod
-    def _drain(entry):
+    def _drain(self, entry):
         meta, data, fut, w = entry
+        t0 = time.perf_counter()
         full = fut.result()  # blocks until device + d2h complete
+        if self.timer is not None:
+            self.timer.add("drain_wait", time.perf_counter() - t0)
         if full.shape[1] != w:
             full = full[:, :w]
         return meta, data, full

@@ -101,6 +101,9 @@ class VolumeServer:
         from ..security.guard import Guard
         self.guard = Guard(whitelist)
         self._lookup_cache: Dict[int, tuple] = {}
+        from ..ec.shard_cache import EcShardLocationCache
+        self._ec_loc_cache = EcShardLocationCache(
+            self._fetch_ec_shard_locations)
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
@@ -226,7 +229,7 @@ class VolumeServer:
         try:
             blob = ev.read_needle_blob(
                 key,
-                remote_fetch=self._fetch_remote_shard,
+                remote_fetch=self._read_shard_from_holders,
                 reconstruct_fetch=self._reconstruct_shard_range)
         except KeyError:
             raise HttpError(404, f"{fid} not found") from None
@@ -698,19 +701,23 @@ class VolumeServer:
         # under-replicated
         if req.query.get("type") != "replicate":
             from ..security.jwt import jwt_from_request
+            from ..util.fanout import fan_out
+            from .http_util import post_multipart
             token = jwt_from_request(req.headers, req.query) \
                 if self.jwt_signing_key else None
             jwt_q = f"&jwt={token}" if token else ""
-            failed = []
-            for node_url in self._other_replicas(vid):
-                from .http_util import post_multipart
-                try:
-                    post_multipart(
-                        f"http://{node_url}{req.path}?type=replicate"
-                        f"{jwt_q}",
-                        filename, data, ctype or "application/octet-stream")
-                except HttpError as e:
-                    failed.append(f"{node_url}: {e.message or e.status}")
+
+            def replicate(node_url: str):
+                post_multipart(
+                    f"http://{node_url}{req.path}?type=replicate{jwt_q}",
+                    filename, data, ctype or "application/octet-stream")
+
+            failed = [
+                f"{node_url}: {exc.message or exc.status}"
+                if isinstance(exc, HttpError) else f"{node_url}: {exc}"
+                for node_url, _, exc in fan_out(replicate,
+                                                self._other_replicas(vid))
+                if exc is not None]
             if failed:
                 raise HttpError(
                     500, "replication failed on " + "; ".join(failed))
@@ -799,7 +806,7 @@ class VolumeServer:
         got = self._read_needle_local(vid, key, cookie, f"{vid},{key:x}")
         return self._needle_response(got, req)
 
-    def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
+    def _fetch_ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
         try:
             out = get_json(f"http://{self.master_url}/cluster/ec_lookup"
                            f"?volumeId={vid}", timeout=10)
@@ -807,7 +814,15 @@ class VolumeServer:
         except HttpError:
             return {}
 
-    def _fetch_remote_shard(self, vid, sid, offset, size) -> Optional[bytes]:
+    def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
+        """Cached with tiered freshness + invalidate-on-failure
+        (reference store_ec.go:218-259); raw master hits only on expiry."""
+        return self._ec_loc_cache.lookup(vid)
+
+    def _read_shard_from_holders(self, vid: int, sid: int, offset: int,
+                                 size: int) -> Optional[bytes]:
+        """Try each cached holder of one shard; forget holders that fail
+        (reference forgetShardId, store_ec.go:211)."""
         for holder in self._ec_shard_locations(vid).get(sid, []):
             if holder == self.url:
                 continue
@@ -817,42 +832,39 @@ class VolumeServer:
                     f"http://{holder}/admin/ec/shard_read?volume={vid}"
                     f"&shard={sid}&offset={offset}&size={size}", timeout=30)
             except HttpError:
+                self._ec_loc_cache.forget(vid, sid, holder)
                 continue
         return None
 
     def _reconstruct_shard_range(self, vid, sid, offset, size) -> bytes:
-        """Fetch the same range of >=DATA_SHARDS sibling shards and decode
-        (reference recoverOneRemoteEcShardInterval)."""
+        """Fetch the same range of sibling shards — all remote fetches in
+        parallel, one RTT total (reference store_ec.go:329-362 launches a
+        goroutine per sibling) — and decode the missing shard."""
+        from ..util.fanout import fan_out
         ev = self.store.find_ec_volume(vid)
-        locations = self._ec_shard_locations(vid)
         shards: List[Optional[np.ndarray]] = [None] * TOTAL_SHARDS
-        have = 0
+
+        def pad(data: bytes) -> np.ndarray:
+            if len(data) < size:  # shard tail: zero-pad like local reads
+                data = data + b"\x00" * (size - len(data))
+            return np.frombuffer(data, dtype=np.uint8)
+
+        remote = []
         for other in range(TOTAL_SHARDS):
-            if other == sid or have >= DATA_SHARDS:
+            if other == sid:
                 continue
-            data = None
             if ev is not None and other in ev.shards:
-                data = ev.shards[other].read_at(offset, size)
-                if len(data) < size:
-                    data = data + b"\x00" * (size - len(data))
+                shards[other] = pad(ev.shards[other].read_at(offset, size))
             else:
-                for holder in locations.get(other, []):
-                    if holder == self.url:
-                        continue
-                    try:
-                        data = http_call(
-                            "GET",
-                            f"http://{holder}/admin/ec/shard_read"
-                            f"?volume={vid}&shard={other}&offset={offset}"
-                            f"&size={size}", timeout=30)
-                        break
-                    except HttpError:
-                        continue
-            if data is not None:
-                if len(data) < size:  # shard tail: zero-pad like local reads
-                    data = data + b"\x00" * (size - len(data))
-                shards[other] = np.frombuffer(data, dtype=np.uint8)
-                have += 1
+                remote.append(other)
+        have = sum(s is not None for s in shards)
+        if have < DATA_SHARDS:
+            for other, data, exc in fan_out(
+                    lambda o: self._read_shard_from_holders(
+                        vid, o, offset, size), remote):
+                if exc is None and data is not None:
+                    shards[other] = pad(data)
+        have = sum(s is not None for s in shards)
         if have < DATA_SHARDS:
             raise HttpError(
                 503, f"cannot reconstruct {vid}.{sid}: {have} shards")
@@ -865,19 +877,37 @@ class VolumeServer:
         other shard holder (reference store_ec_delete.go:15-110)."""
         found = ev.delete_needle(key)
         if req.query.get("type") != "replicate":
+            from ..security.jwt import jwt_from_request
+            from ..util.fanout import fan_out
+            token = jwt_from_request(req.headers, req.query) \
+                if self.jwt_signing_key else None
+            jwt_q = f"&jwt={token}" if token else ""
             notified = {self.url}
+            targets = []
             for holders in self._ec_shard_locations(vid).values():
                 for holder in holders:
-                    if holder in notified:
-                        continue
-                    notified.add(holder)
-                    try:
-                        http_call(
-                            "DELETE",
-                            f"http://{holder}{req.path}?type=replicate")
-                        found = True
-                    except HttpError:
-                        pass
+                    if holder not in notified:
+                        notified.add(holder)
+                        targets.append(holder)
+
+            def broadcast(holder: str):
+                http_call("DELETE",
+                          f"http://{holder}{req.path}?type=replicate"
+                          f"{jwt_q}")
+
+            # a holder that misses the delete would silently resurrect the
+            # needle on a read redirect — fail loudly like writes do; 404
+            # (holder no longer has the volume) is benign
+            failed = []
+            for holder, _, exc in fan_out(broadcast, targets):
+                if exc is None:
+                    found = True
+                elif not (isinstance(exc, HttpError) and exc.status == 404):
+                    failed.append(f"{holder}: {exc}")
+            if failed:
+                raise HttpError(
+                    500, "ec delete replication failed on "
+                    + "; ".join(failed))
         if not found:
             raise HttpError(404, f"needle {key} not in ec volume {vid}")
         return {"size": 0}
@@ -896,15 +926,27 @@ class VolumeServer:
             raise HttpError(500, str(e)) from None
         if req.query.get("type") != "replicate":
             from ..security.jwt import jwt_from_request
+            from ..util.fanout import fan_out
             token = jwt_from_request(req.headers, req.query) \
                 if self.jwt_signing_key else None
             jwt_q = f"&jwt={token}" if token else ""
-            for node_url in self._other_replicas(vid):
-                try:
-                    http_call(
-                        "DELETE",
-                        f"http://{node_url}{req.path}?type=replicate"
-                        f"{jwt_q}")
-                except HttpError:
-                    pass
+
+            def replicate(node_url: str):
+                http_call("DELETE",
+                          f"http://{node_url}{req.path}?type=replicate"
+                          f"{jwt_q}")
+
+            # deletes must fail-on-any-replica like writes (reference
+            # ReplicatedDelete, store_replicate.go): a replica that keeps
+            # the needle resurrects it via read redirects. 404 = already
+            # gone there, which is the goal state.
+            failed = []
+            for node_url, _, exc in fan_out(replicate,
+                                            self._other_replicas(vid)):
+                if exc is not None and not (
+                        isinstance(exc, HttpError) and exc.status == 404):
+                    failed.append(f"{node_url}: {exc}")
+            if failed:
+                raise HttpError(
+                    500, "delete replication failed on " + "; ".join(failed))
         return {"size": freed}

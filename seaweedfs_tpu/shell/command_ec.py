@@ -108,12 +108,17 @@ def do_ec_encode(env: CommandEnv, vid: int):
     env.node_post(source, f"/admin/ec/generate?volume={vid}"
                           f"&collection={collection}")
     env.write(f"volume {vid}: generated 14 shards on {source}")
-    # 3. spread
+    # 3. spread — every target pulls + mounts its shards concurrently
+    # (reference parallelCopyEcShardsFromSource,
+    # command_ec_encode.go:200-235: goroutine per target server)
+    from ..util.fanout import fan_out_must_succeed
     assignment = balanced_ec_distribution(_free_nodes(env))
     by_node: Dict[str, List[int]] = {}
     for sid, url in enumerate(assignment):
         by_node.setdefault(url, []).append(sid)
-    for url, shards in by_node.items():
+
+    def spread(target):
+        url, shards = target
         s = ",".join(map(str, shards))
         if url != source:
             env.node_post(url, f"/admin/ec/copy?volume={vid}"
@@ -121,6 +126,12 @@ def do_ec_encode(env: CommandEnv, vid: int):
                                f"&shards={s}")
         env.node_post(url, f"/admin/ec/mount?volume={vid}"
                            f"&collection={collection}&shards={s}")
+        return s
+
+    for (url, _), s in zip(
+            by_node.items(),
+            fan_out_must_succeed(spread, list(by_node.items()),
+                                 what=f"ec shard spread for volume {vid}")):
         env.write(f"volume {vid}: shards {s} -> {url}")
     # 4. delete source's unassigned shard files
     source_keeps = set(by_node.get(source, []))
@@ -160,19 +171,24 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
     # command_ec_rebuild.go: pick by free slot count)
     rebuilder = _free_nodes(env)[0]["url"]
     local = {s for s, urls in shards.items() if rebuilder in urls}
-    # copy surviving shards the rebuilder lacks
-    copied = []
-    need_ecx = not local
-    for sid, urls in shards.items():
-        if sid in local:
-            continue
-        src = urls[0]
+    # copy surviving shards the rebuilder lacks — pulls from distinct
+    # sources run concurrently (reference prepareDataToRecover +
+    # goroutine fan-out); the .ecx rides along with exactly one copy
+    from ..util.fanout import fan_out_must_succeed
+    to_copy = [(sid, urls[0]) for sid, urls in shards.items()
+               if sid not in local]
+    copied = [sid for sid, _ in to_copy]
+
+    def pull(job):
+        (sid, src), with_ecx = job
         env.node_post(rebuilder,
                       f"/admin/ec/copy?volume={vid}&collection={collection}"
                       f"&source={src}&shards={sid}"
-                      f"&copy_ecx={'true' if need_ecx else 'false'}")
-        need_ecx = False
-        copied.append(sid)
+                      f"&copy_ecx={'true' if with_ecx else 'false'}")
+
+    jobs = [(item, (not local) and i == 0) for i, item in enumerate(to_copy)]
+    fan_out_must_succeed(pull, jobs,
+                         what=f"survivor shard copy for volume {vid}")
     # rebuild + mount only the previously-missing shards
     out = env.node_post(rebuilder,
                         f"/admin/ec/rebuild?volume={vid}"

@@ -1,0 +1,80 @@
+"""Parallel fan-out over cluster peers.
+
+The reference fans maintenance and replication traffic out with a
+goroutine per target and an all-must-succeed barrier
+(reference weed/topology/store_replicate.go:137-152 distributedOperation,
+weed/shell/command_ec_encode.go:200-235 parallelCopyEcShardsFromSource,
+weed/storage/store_ec.go:329-362 parallel sibling-interval fetches). The
+Python analog is a bounded thread pool: every target runs concurrently
+and the caller gets (result | exception) per target, in input order.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, List, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+_MAX_WORKERS = 32
+_pool = None
+_pool_lock = __import__("threading").Lock()
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    """One long-lived pool — fan_out sits on the per-request write/delete
+    hot path, so per-call executor spawn/teardown would tax every
+    replicated PUT. Callables must not recursively fan_out."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=_MAX_WORKERS,
+                                       thread_name_prefix="fanout")
+        return _pool
+
+
+def fan_out(fn: Callable[[T], R], items: Sequence[T]
+            ) -> List[Tuple[T, R, Exception]]:
+    """Run ``fn(item)`` for every item concurrently.
+
+    Returns [(item, result, None) | (item, None, exc)] in input order.
+    With zero or one item there is no pool overhead.
+    """
+    items = list(items)
+    if not items:
+        return []
+    if len(items) == 1:
+        try:
+            return [(items[0], fn(items[0]), None)]
+        except Exception as e:  # noqa: BLE001 - relayed to caller
+            return [(items[0], None, e)]
+    out: List[Tuple[T, R, Exception]] = [None] * len(items)  # type: ignore
+
+    def run(i: int):
+        try:
+            out[i] = (items[i], fn(items[i]), None)
+        except Exception as e:  # noqa: BLE001 - relayed to caller
+            out[i] = (items[i], None, e)
+
+    list(_shared_pool().map(run, range(len(items))))
+    return out
+
+
+def fan_out_must_succeed(fn: Callable[[T], R], items: Sequence[T],
+                         what: str = "operation",
+                         ok: Callable[[Exception], bool] = None
+                         ) -> List[R]:
+    """All-must-succeed barrier (reference distributedOperation): raises
+    RuntimeError naming every failed target; ``ok(exc)`` may whitelist
+    benign failures (e.g. 404 on a replica delete — already gone)."""
+    failed = []
+    results = []
+    for item, result, exc in fan_out(fn, items):
+        if exc is not None and not (ok is not None and ok(exc)):
+            failed.append(f"{item}: {exc}")
+        else:
+            results.append(result)
+    if failed:
+        raise RuntimeError(f"{what} failed on " + "; ".join(failed))
+    return results

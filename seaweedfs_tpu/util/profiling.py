@@ -1,0 +1,132 @@
+"""Profiling hooks (reference §5.1 analog: weed/util/pprof.go).
+
+The reference wires Go pprof behind -cpuprofile/-memprofile flags
+(reference weed/command/volume.go:71-72, weed/util/pprof.go). The TPU
+build's equivalents:
+
+  * ``maybe_trace(label)`` — a context manager that captures a JAX/XLA
+    profiler trace (viewable in TensorBoard / Perfetto) when
+    ``SW_PROFILE_DIR`` is set, and is free when it is not. Wrap device
+    call sites (the EC pipeline does this around its stream loop).
+  * ``cpu_profile(path)`` — cProfile for host-side Python, used by the
+    server CLIs behind a ``-cpuprofile`` flag.
+
+Both are no-ops unless explicitly enabled, so they can stay in the
+serving path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import cProfile
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+@contextlib.contextmanager
+def maybe_trace(label: str = "trace", profile_dir: Optional[str] = None):
+    """Capture a jax.profiler trace into ``$SW_PROFILE_DIR/<label>`` (or
+    ``profile_dir``) when configured; otherwise do nothing."""
+    out = profile_dir or os.environ.get("SW_PROFILE_DIR")
+    if not out:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(out, label)):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in a captured device trace (no-op outside tracing)."""
+    try:
+        import jax.profiler as jp
+        with jp.TraceAnnotation(name):
+            yield
+    except Exception:  # noqa: BLE001 - tracing must never break the op
+        yield
+
+
+@contextlib.contextmanager
+def cpu_profile(path: Optional[str]):
+    """cProfile the enclosed block into ``path`` (pstats format)."""
+    if not path:
+        yield
+        return
+    prof = cProfile.Profile()
+    prof.enable()
+    try:
+        yield
+    finally:
+        prof.disable()
+        prof.dump_stats(path)
+
+
+class StageTimer:
+    """Accumulates wall time per named stage plus timestamped intervals
+    for stages whose concurrency matters (d2h drains overlap each other;
+    the interesting figure is the union of their busy windows, which is
+    the link's effective busy time)."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.bytes: Dict[str, int] = {}
+        self.intervals: Dict[str, List[Tuple[float, float]]] = {}
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()  # stages report from worker threads
+
+    def add(self, stage: str, dt: float, nbytes: int = 0,
+            interval: Optional[Tuple[float, float]] = None):
+        with self._lock:
+            self.totals[stage] = self.totals.get(stage, 0.0) + dt
+            if nbytes:
+                self.bytes[stage] = self.bytes.get(stage, 0) + nbytes
+            if interval is not None:
+                self.intervals.setdefault(stage, []).append(interval)
+
+    @contextlib.contextmanager
+    def stage(self, name: str, nbytes: int = 0):
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            self.add(name, end - t, nbytes, interval=(t, end))
+
+    def busy_time(self, stage: str) -> float:
+        """Union length of the stage's intervals (overlaps collapsed)."""
+        ivs = sorted(self.intervals.get(stage, []))
+        total, cur_start, cur_end = 0.0, None, None
+        for s, e in ivs:
+            if cur_end is None or s > cur_end:
+                if cur_end is not None:
+                    total += cur_end - cur_start
+                cur_start, cur_end = s, e
+            else:
+                cur_end = max(cur_end, e)
+        if cur_end is not None:
+            total += cur_end - cur_start
+        return total
+
+    def rate_mbps(self, stage: str, use_busy: bool = False) -> float:
+        t = self.busy_time(stage) if use_busy else self.totals.get(stage, 0.0)
+        if t <= 0:
+            return 0.0
+        return self.bytes.get(stage, 0) / t / 1e6
+
+    def summary(self) -> str:
+        wall = time.perf_counter() - self._t0
+        parts = [f"wall {wall:.1f}s"]
+        for name in sorted(self.totals):
+            line = f"{name} {self.totals[name]:.1f}s"
+            if name in self.intervals:
+                busy = self.busy_time(name)
+                if abs(busy - self.totals[name]) > 0.05:
+                    line += f" (busy {busy:.1f}s)"
+            if self.bytes.get(name):
+                line += f" @{self.rate_mbps(name, name in self.intervals):.0f}MB/s"
+            parts.append(line)
+        return ", ".join(parts)
