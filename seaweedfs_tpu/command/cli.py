@@ -47,6 +47,7 @@ def cmd_volume(args):
                       pulse_seconds=args.pulseSeconds,
                       ec_backend=args.ec_backend,
                       jwt_signing_key=args.jwtKey,
+                      index_kind=args.index,
                       whitelist=[w for w in args.whiteList.split(",")
                                  if w]).start()
     print(f"volume server listening on {vs.url}, "
@@ -363,6 +364,11 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-pulseSeconds", type=int, default=5)
     v.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
+    v.add_argument("-index", default="memory",
+                   choices=["memory", "compact", "sortedfile"],
+                   help="needle map variant (reference -index flag): "
+                        "memory dict, 16B/needle compact arrays, or "
+                        "mmap'd sorted file")
     v.add_argument("-jwtKey", default="")
     v.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs allowed to call")

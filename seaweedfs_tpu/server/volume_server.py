@@ -34,7 +34,7 @@ class VolumeServer:
                  max_volume_counts=None, pulse_seconds: int = 5,
                  public_url: str = "", read_redirect: bool = True,
                  ec_backend: str = "auto", jwt_signing_key: str = "",
-                 whitelist=()):
+                 whitelist=(), index_kind: str = "memory"):
         router = Router()
         router.add("*", "/status", self.status)
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
@@ -95,7 +95,8 @@ class VolumeServer:
             max_volume_counts=max_volume_counts,
             ip=host, port=self.port,
             public_url=public_url or f"{host}:{self.port}",
-            data_center=data_center, rack=rack, codec=codec)
+            data_center=data_center, rack=rack, codec=codec,
+            index_kind=index_kind)
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self.jwt_signing_key = jwt_signing_key
         from ..security.guard import Guard
@@ -861,7 +862,7 @@ class VolumeServer:
         if have < DATA_SHARDS:
             for other, data, exc in fan_out(
                     lambda o: self._read_shard_from_holders(
-                        vid, o, offset, size), remote):
+                        vid, o, offset, size), remote, dedicated=True):
                 if exc is None and data is not None:
                     shards[other] = pad(data)
         have = sum(s is not None for s in shards)
@@ -884,7 +885,13 @@ class VolumeServer:
             jwt_q = f"&jwt={token}" if token else ""
             notified = {self.url}
             targets = []
-            for holders in self._ec_shard_locations(vid).values():
+            # fresh master lookup, NOT the tiered cache: a holder that
+            # mounted shards after the cache filled (ec.balance/rebuild)
+            # would otherwise miss the delete and resurrect the needle —
+            # the exact failure this broadcast exists to prevent
+            locations = self._fetch_ec_shard_locations(vid) or \
+                self._ec_shard_locations(vid)
+            for holders in locations.values():
                 for holder in holders:
                     if holder not in notified:
                         notified.add(holder)

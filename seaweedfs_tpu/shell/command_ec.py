@@ -131,7 +131,8 @@ def do_ec_encode(env: CommandEnv, vid: int):
     for (url, _), s in zip(
             by_node.items(),
             fan_out_must_succeed(spread, list(by_node.items()),
-                                 what=f"ec shard spread for volume {vid}")):
+                                 what=f"ec shard spread for volume {vid}",
+                                 dedicated=True)):
         env.write(f"volume {vid}: shards {s} -> {url}")
     # 4. delete source's unassigned shard files
     source_keeps = set(by_node.get(source, []))
@@ -188,7 +189,8 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
 
     jobs = [(item, (not local) and i == 0) for i, item in enumerate(to_copy)]
     fan_out_must_succeed(pull, jobs,
-                         what=f"survivor shard copy for volume {vid}")
+                         what=f"survivor shard copy for volume {vid}",
+                         dedicated=True)
     # rebuild + mount only the previously-missing shards
     out = env.node_post(rebuilder,
                         f"/admin/ec/rebuild?volume={vid}"

@@ -22,9 +22,11 @@ _EC_SHARD_RE = re.compile(
 
 
 class DiskLocation:
-    def __init__(self, directory: str, max_volume_count: int = 7):
+    def __init__(self, directory: str, max_volume_count: int = 7,
+                 index_kind: str = "memory"):
         self.directory = os.path.abspath(directory)
         self.max_volume_count = max_volume_count
+        self.index_kind = index_kind  # needle-map variant for new loads
         self.volumes: Dict[int, Volume] = {}
         self.ec_volumes: Dict[int, EcVolume] = {}
         self.lock = threading.RLock()
@@ -48,7 +50,8 @@ class DiskLocation:
                 if vid not in self.volumes:
                     try:
                         self.volumes[vid] = Volume(
-                            self.directory, collection, vid)
+                            self.directory, collection, vid,
+                            index_kind=self.index_kind)
                     except Exception:
                         continue  # quarantine unloadable volumes
 
@@ -82,6 +85,7 @@ class DiskLocation:
         with self.lock:
             if vid in self.volumes:
                 return self.volumes[vid]
+            kwargs.setdefault("index_kind", self.index_kind)
             v = Volume(self.directory, collection, vid, create=True, **kwargs)
             self.volumes[vid] = v
             return v

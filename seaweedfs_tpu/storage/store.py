@@ -25,11 +25,12 @@ class Store:
     def __init__(self, directories: List[str], max_volume_counts=None,
                  ip: str = "127.0.0.1", port: int = 8080,
                  public_url: str = "", data_center: str = "",
-                 rack: str = "", codec: Optional[ReedSolomonCodec] = None):
+                 rack: str = "", codec: Optional[ReedSolomonCodec] = None,
+                 index_kind: str = "memory"):
         if isinstance(directories, str):
             directories = [directories]
         max_volume_counts = max_volume_counts or [7] * len(directories)
-        self.locations = [DiskLocation(d, m)
+        self.locations = [DiskLocation(d, m, index_kind=index_kind)
                           for d, m in zip(directories, max_volume_counts)]
         self.ip = ip
         self.port = port

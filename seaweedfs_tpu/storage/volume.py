@@ -16,7 +16,8 @@ import time
 from typing import Optional
 
 from .needle import Needle, get_actual_size
-from .needle_map import NeedleMap, walk_index_file
+from .compact_map import load_needle_map
+from .needle_map import walk_index_file
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 from .types import (NEEDLE_PADDING_SIZE, TOMBSTONE_FILE_SIZE, TTL,
                     ReplicaPlacement)
@@ -39,10 +40,13 @@ class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: Optional[ReplicaPlacement] = None,
                  ttl: Optional[TTL] = None, create: bool = False,
-                 version: int = None):
+                 version: int = None, index_kind: str = "memory"):
         self.dir = dirname
         self.collection = collection or ""
         self.id = vid
+        # needle-map variant (reference volume -index flag): memory |
+        # compact (16B/needle sorted arrays) | sortedfile (mmap'd .sdx)
+        self.index_kind = index_kind
         self.readonly = False
         self.lock = threading.RLock()
         self.last_modified = 0
@@ -71,7 +75,7 @@ class Volume:
             self.super_block = SuperBlock.from_bytes(
                 self.dat.read(SUPER_BLOCK_SIZE))
             self.readonly = True
-            self.nm = NeedleMap.load(self.idx_path)
+            self.nm = load_needle_map(self.idx_path, self.index_kind)
             self.last_modified = remote_info.get("modified_at", 0)
             return
 
@@ -93,7 +97,7 @@ class Volume:
 
         self.dat = open(self.dat_path, "r+b")
         self.check_integrity()
-        self.nm = NeedleMap.load(self.idx_path)
+        self.nm = load_needle_map(self.idx_path, self.index_kind)
         self.last_modified = int(os.path.getmtime(self.dat_path))
         # a keep-local tier upload leaves .dat + .vif side by side; the
         # volume serves locally but must stay frozen or the parked
@@ -355,11 +359,16 @@ class Volume:
             self.nm.close()
             os.replace(cpd, self.dat_path)
             os.replace(cpx, self.idx_path)
+            # the compacted .idx can coincidentally match a stale .sdx
+            # watermark size — drop the sidecar so sortedfile maps rebuild
+            for ext in (".sdx", ".sdx.meta"):
+                if os.path.exists(prefix + ext):
+                    os.remove(prefix + ext)
             with open(self.dat_path, "rb") as f:
                 self.super_block = SuperBlock.from_bytes(
                     f.read(SUPER_BLOCK_SIZE))
             self.dat = open(self.dat_path, "r+b")
-            self.nm = NeedleMap.load(self.idx_path)
+            self.nm = load_needle_map(self.idx_path, self.index_kind)
 
     def _makeup_diff(self, cpd: str, cpx: str):
         """Replay .idx entries appended after compact()'s snapshot onto the

@@ -34,12 +34,17 @@ def _shared_pool() -> ThreadPoolExecutor:
         return _pool
 
 
-def fan_out(fn: Callable[[T], R], items: Sequence[T]
-            ) -> List[Tuple[T, R, Exception]]:
+def fan_out(fn: Callable[[T], R], items: Sequence[T],
+            dedicated: bool = False) -> List[Tuple[T, R, Exception]]:
     """Run ``fn(item)`` for every item concurrently.
 
     Returns [(item, result, None) | (item, None, exc)] in input order.
     With zero or one item there is no pool overhead.
+
+    ``dedicated=True`` spins a private pool for this call — use it for
+    rare long-timeout fan-outs (degraded-read shard fetches, shell
+    maintenance copies) so they cannot head-of-line block the shared
+    pool serving the per-request replication hot path.
     """
     items = list(items)
     if not items:
@@ -57,20 +62,25 @@ def fan_out(fn: Callable[[T], R], items: Sequence[T]
         except Exception as e:  # noqa: BLE001 - relayed to caller
             out[i] = (items[i], None, e)
 
-    list(_shared_pool().map(run, range(len(items))))
+    if dedicated:
+        with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS,
+                                                len(items))) as ex:
+            list(ex.map(run, range(len(items))))
+    else:
+        list(_shared_pool().map(run, range(len(items))))
     return out
 
 
 def fan_out_must_succeed(fn: Callable[[T], R], items: Sequence[T],
                          what: str = "operation",
-                         ok: Callable[[Exception], bool] = None
-                         ) -> List[R]:
+                         ok: Callable[[Exception], bool] = None,
+                         dedicated: bool = False) -> List[R]:
     """All-must-succeed barrier (reference distributedOperation): raises
     RuntimeError naming every failed target; ``ok(exc)`` may whitelist
     benign failures (e.g. 404 on a replica delete — already gone)."""
     failed = []
     results = []
-    for item, result, exc in fan_out(fn, items):
+    for item, result, exc in fan_out(fn, items, dedicated=dedicated):
         if exc is not None and not (ok is not None and ok(exc)):
             failed.append(f"{item}: {exc}")
         else:
