@@ -59,15 +59,29 @@ def upload_data(master_url: str, data: bytes, filename: str = "",
 
 
 class VidCache:
-    """Volume-id -> locations cache with TTL
-    (reference lookup_vid_cache.go / vid_map.go)."""
+    """Volume-id -> locations cache (reference lookup_vid_cache.go /
+    vid_map.go).
 
-    def __init__(self, master_url: str, ttl_seconds: float = 10.0):
+    With ``watch=True`` the cache rides the master's push channel
+    (client/vid_map.py long-polling /cluster/watch) — routes are never
+    staler than one master pulse, and the TTL'd /dir/lookup below is
+    only the fallback while the map warms up or the master is away."""
+
+    def __init__(self, master_url: str, ttl_seconds: float = 10.0,
+                 watch: bool = False):
         self.master_url = master_url
         self.ttl = ttl_seconds
         self._cache: Dict[int, tuple] = {}
+        self._vid_map = None
+        if watch:
+            from .vid_map import shared_vid_map
+            self._vid_map = shared_vid_map(master_url)
 
     def lookup(self, vid: int) -> List[str]:
+        if self._vid_map is not None:
+            urls = self._vid_map.lookup(vid)
+            if urls is not None:
+                return urls
         hit = self._cache.get(vid)
         if hit and time.time() - hit[0] < self.ttl:
             return hit[1]

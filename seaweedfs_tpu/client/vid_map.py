@@ -1,0 +1,117 @@
+"""Client-side push-updated volume-location map.
+
+Reference weed/wdclient/masterclient.go:45-121 (KeepConnected loop) +
+vid_map.go:23-28: the client holds a live stream from the master and
+applies VolumeLocation new/deleted deltas, so routing never serves a
+location more stale than one master pulse — unlike the 10s TTL'd
+lookup cache it replaces as the primary source.
+
+One daemon poller per master URL is shared process-wide
+(``shared_vid_map``); every VidCache(watch=True) rides the same map.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class VidMap:
+    POLL_TIMEOUT = 20.0
+    MAX_CONSECUTIVE_FAILURES = 15  # then park until a lookup revives us
+
+    def __init__(self, master_url: str):
+        self.master_url = master_url
+        self._locations: Dict[int, List[dict]] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._ready = threading.Event()  # first snapshot applied
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_start = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "VidMap":
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._last_start = time.monotonic()
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"vidmap-{self.master_url}")
+                self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    # -- lookup ------------------------------------------------------------
+    def lookup(self, vid: int) -> Optional[List[str]]:
+        """Pushed locations, or None when the map isn't live (caller
+        falls back to a /dir/lookup). A parked poller is revived here."""
+        if not self._ready.is_set():
+            if self._thread is None or not self._thread.is_alive():
+                if time.monotonic() - self._last_start > 5:
+                    self.start()
+            return None
+        with self._lock:
+            locs = self._locations.get(vid)
+            return [l["url"] for l in locs] if locs else None
+
+    def known(self, vid: int) -> bool:
+        with self._lock:
+            return vid in self._locations
+
+    # -- poll loop ---------------------------------------------------------
+    def _apply(self, out: dict):
+        with self._lock:
+            if out.get("reset"):
+                self._locations = {
+                    int(v): list(locs)
+                    for v, locs in (out.get("locations") or {}).items()}
+            for ev in out.get("events") or []:
+                vid = int(ev["vid"])
+                entry = {"url": ev["url"],
+                         "publicUrl": ev.get("publicUrl", ev["url"])}
+                locs = self._locations.setdefault(vid, [])
+                if ev["type"] == "new":
+                    if all(l["url"] != entry["url"] for l in locs):
+                        locs.append(entry)
+                else:
+                    locs[:] = [l for l in locs if l["url"] != entry["url"]]
+                    if not locs:
+                        del self._locations[vid]
+            self._seq = int(out.get("seq", self._seq))
+        self._ready.set()
+
+    def _loop(self):
+        from ..server.http_util import get_json
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                out = get_json(
+                    f"http://{self.master_url}/cluster/watch"
+                    f"?since={self._seq}&timeout={self.POLL_TIMEOUT}",
+                    timeout=self.POLL_TIMEOUT + 10)
+                self._apply(out)
+                failures = 0
+            except Exception:  # noqa: BLE001 - master down/unreachable
+                failures += 1
+                self._ready.clear()  # stale map must not serve routes
+                self._seq = 0        # resync with a snapshot on recovery
+                if failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    return           # park; a later lookup() revives us
+                self._stop.wait(min(2.0, 0.2 * failures))
+
+
+_shared: Dict[str, VidMap] = {}
+_shared_lock = threading.Lock()
+
+
+def shared_vid_map(master_url: str) -> VidMap:
+    with _shared_lock:
+        vm = _shared.get(master_url)
+        if vm is None:
+            vm = _shared[master_url] = VidMap(master_url)
+        return vm.start()

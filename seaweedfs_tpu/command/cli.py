@@ -22,7 +22,7 @@ def cmd_master(args):
                      jwt_signing_key=args.jwtKey,
                      peers=args.peers, raft_dir=args.mdir).start()
     print(f"master listening on {m.url}")
-    _wait()
+    _wait(m)
 
 
 def _load_tier_config(path: str):
@@ -52,7 +52,7 @@ def cmd_volume(args):
                                  if w]).start()
     print(f"volume server listening on {vs.url}, "
           f"heartbeating to {args.mserver}")
-    _wait()
+    _wait(vs)
 
 
 def cmd_server(args):
@@ -73,6 +73,7 @@ def cmd_server(args):
                       ec_backend=args.ec_backend,
                       jwt_signing_key=args.jwtKey).start()
     print(f"master on {m.url}, volume server on {vs.url}")
+    stoppables = [vs]
     if args.filer or args.s3 or args.webdav:
         from ..server.filer_server import FilerServer
         f = FilerServer(port=args.filerPort, host=args.ip,
@@ -82,12 +83,16 @@ def cmd_server(args):
         if args.s3:
             s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
             print(f"s3 gateway on {s3.url}")
+            stoppables.append(s3)
         if args.webdav:
             from ..server.webdav_server import WebDavServer
             w = WebDavServer(f.filer, m.url, port=args.webdavPort,
                              host=args.ip).start()
             print(f"webdav on {w.url}")
-    _wait()
+            stoppables.append(w)
+        stoppables.append(f)
+    stoppables.append(m)
+    _wait(*stoppables)
 
 
 def _start_s3(filer_server, port: int, host: str, config_path: str):
@@ -116,7 +121,7 @@ def cmd_filer(args):
     if args.s3:
         s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
         print(f"s3 gateway on {s3.url}")
-    _wait()
+    _wait(f)
 
 
 def cmd_s3(args):
@@ -134,7 +139,7 @@ def cmd_s3(args):
     s3 = S3ApiServer(client, master, port=args.port, host=args.ip,
                      iam=iam).start()
     print(f"s3 gateway on {s3.url}, filer {args.filer}")
-    _wait()
+    _wait(s3)
 
 
 def cmd_webdav(args):
@@ -147,7 +152,7 @@ def cmd_webdav(args):
                      collection=args.collection,
                      chunk_size=args.maxMB << 20).start()
     print(f"webdav on {w.url}, filer {args.filer}")
-    _wait()
+    _wait(w)
 
 
 def _filer_master(filer_url: str) -> str:
@@ -309,7 +314,7 @@ def cmd_msg_broker(args):
     from ..server.msg_broker import MsgBrokerServer
     b = MsgBrokerServer(port=args.port, host=args.ip).start()
     print(f"message broker on {b.url}")
-    _wait()
+    _wait(b)
 
 
 def cmd_scaffold(args):
@@ -322,12 +327,30 @@ def cmd_version(args):
     print(f"seaweedfs_tpu {VERSION}")
 
 
-def _wait():
+def _wait(*stoppables):
+    """Park until SIGTERM/SIGINT, then stop servers gracefully
+    (reference util/signal_handling.go OnInterrupt) — a clean volume
+    server shutdown sends /cluster/goodbye so watch subscribers reroute
+    immediately instead of waiting out heartbeat expiry."""
+    done = __import__("threading").Event()
+
+    def on_signal(signum, frame):
+        done.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, on_signal)
+        except (ValueError, OSError):
+            pass
     try:
-        signal.pause()
-    except (KeyboardInterrupt, AttributeError):
-        while True:
-            time.sleep(3600)
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    for s_ in stoppables:
+        try:
+            s_.stop()
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -17,7 +17,7 @@ def default_fetcher(master_url: str):
     from ..client.operation import VidCache
     from ..server.http_util import HttpError, http_call
     from ..storage.types import parse_file_id
-    cache = VidCache(master_url)
+    cache = VidCache(master_url, watch=True)
 
     def fetch(fid: str, offset: int, size: int) -> bytes:
         vid, _, _ = parse_file_id(fid)

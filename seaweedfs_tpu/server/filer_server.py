@@ -77,7 +77,7 @@ class FilerServer:
         self.log_buffer = LogBuffer()
         self.notify_publisher = notify_publisher
         self.filer.on_update(self._on_meta_update)
-        self.vid_cache = operation.VidCache(master_url)
+        self.vid_cache = operation.VidCache(master_url, watch=True)
         self._fetch = None
         self._stop = threading.Event()
         self._deleter = threading.Thread(target=self._deletion_loop,

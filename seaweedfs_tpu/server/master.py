@@ -47,11 +47,19 @@ class MasterServer:
         router.add("*", "/col/delete", self.col_delete)
         router.add("POST", "/submit", self.submit)
         router.add("POST", "/cluster/heartbeat", self.cluster_heartbeat)
+        router.add("POST", "/cluster/goodbye", self.cluster_goodbye)
         router.add("*", "/cluster/status", self.cluster_status)
         router.add("*", "/cluster/ec_lookup", self.ec_lookup)
         router.add("*", "/cluster/ec_status", self.ec_status)
         router.add("*", "/cluster/volumes", self.cluster_volumes)
+        router.add("GET", "/cluster/watch", self.cluster_watch)
         router.add("GET", "/metrics", self.metrics_handler)
+        # volume-location push channel (reference KeepConnected,
+        # master_grpc_server.go:180-234): heartbeat deltas and node
+        # deaths publish here; clients long-poll /cluster/watch
+        from .watch_hub import WatchHub
+        self.watch_hub = WatchHub(self._location_snapshot)
+        self.topology.location_listener = self.watch_hub.publish
         from ..stats.metrics import MASTER_REQUEST_COUNTER
 
         def observe(label, seconds, ok):
@@ -196,6 +204,19 @@ class MasterServer:
         return {"volume_size_limit": self.topology.volume_size_limit,
                 "leader": self.leader_url() or self.url}
 
+    def cluster_goodbye(self, req: Request):
+        """Clean volume-server shutdown: unregister immediately and push
+        the deletions, instead of waiting for heartbeat expiry (the
+        reference gets this for free from gRPC stream breakage,
+        master_grpc_server.go:24-50)."""
+        if not self.is_leader():
+            return {"not_leader": True, "leader": self.leader_url()}
+        url = req.json().get("url", "")
+        node = self.topology.find_node(url)
+        if node is not None:
+            self.topology.unregister_node(node)
+        return {"removed": node is not None}
+
     def dir_assign(self, req: Request):
         fwd = self._leader_forward(req)
         if fwd is not None:
@@ -302,6 +323,23 @@ class MasterServer:
                                        req.query.get("dataCenter", ""),
                                        count)
         return {"count": grown}
+
+    def _location_snapshot(self):
+        with self.topology.lock:
+            out = {}
+            for node in self.topology.all_nodes():
+                for vid in node.volumes:
+                    out.setdefault(str(vid), []).append(
+                        {"url": node.url, "publicUrl": node.public_url})
+            return out
+
+    def cluster_watch(self, req: Request):
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
+        since = int(req.query.get("since", 0))
+        timeout = min(float(req.query.get("timeout", 20)), 25.0)
+        return self.watch_hub.wait(since, timeout)
 
     def dir_lookup(self, req: Request):
         fwd = self._leader_forward(req)

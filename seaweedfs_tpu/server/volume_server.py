@@ -102,6 +102,8 @@ class VolumeServer:
         from ..security.guard import Guard
         self.guard = Guard(whitelist)
         self._lookup_cache: Dict[int, tuple] = {}
+        from ..client.vid_map import shared_vid_map
+        self._vid_map = shared_vid_map(self.master_url)
         from ..ec.shard_cache import EcShardLocationCache
         self._ec_loc_cache = EcShardLocationCache(
             self._fetch_ec_shard_locations)
@@ -125,6 +127,13 @@ class VolumeServer:
 
     def stop(self):
         self._stop.set()
+        try:
+            # clean shutdown: tell the master now so watch subscribers
+            # reroute immediately instead of after heartbeat expiry
+            post_json(f"http://{self.master_url}/cluster/goodbye",
+                      {"url": self.url}, timeout=2)
+        except Exception:  # noqa: BLE001 - master may already be gone
+            pass
         self.server.stop()
         self.store.close()
 
@@ -725,17 +734,23 @@ class VolumeServer:
         return {"name": filename, "size": size, "eTag": n.etag}
 
     def _other_replicas(self, vid: int) -> List[str]:
-        cached = self._lookup_cache.get(vid)
-        if cached and time.time() - cached[0] < 10:
-            urls = cached[1]
-        else:
-            try:
-                out = get_json(f"http://{self.master_url}/dir/lookup"
-                               f"?volumeId={vid}", timeout=10)
-                urls = [l["url"] for l in out.get("locations", [])]
-            except HttpError:
-                urls = []
-            self._lookup_cache[vid] = (time.time(), urls)
+        # push-updated vid map first (stale-by-at-most-one-pulse;
+        # reference vidMap), TTL'd lookup as warm-up/outage fallback
+        urls = None
+        if self._vid_map is not None:
+            urls = self._vid_map.lookup(vid)
+        if urls is None:
+            cached = self._lookup_cache.get(vid)
+            if cached and time.time() - cached[0] < 10:
+                urls = cached[1]
+            else:
+                try:
+                    out = get_json(f"http://{self.master_url}/dir/lookup"
+                                   f"?volumeId={vid}", timeout=10)
+                    urls = [l["url"] for l in out.get("locations", [])]
+                except HttpError:
+                    urls = []
+                self._lookup_cache[vid] = (time.time(), urls)
         return [u for u in urls if u != self.url]
 
     def read_needle(self, req: Request, vid, key, cookie):

@@ -1,0 +1,75 @@
+"""Master-side volume-location push channel.
+
+The reference holds a gRPC stream per client (KeepConnected,
+weed/server/master_grpc_server.go:180-234) and pushes VolumeLocation
+new/deleted deltas the moment heartbeats or node death change the
+topology; clients fold them into a vidMap (weed/wdclient/vid_map.go).
+The HTTP/JSON control plane here uses a long-poll hub instead: clients
+GET /cluster/watch?since=<seq> and the master answers immediately with
+any newer events, or parks the request until one arrives (or the poll
+times out and returns empty — the client just re-polls).
+
+A client whose `since` has fallen off the bounded event buffer (or a
+fresh client with since=0) gets a full snapshot with reset=True.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List
+
+
+class WatchHub:
+    def __init__(self, snapshot_fn: Callable[[], Dict[str, List[dict]]],
+                 maxlen: int = 8192):
+        self._snapshot_fn = snapshot_fn
+        self._events: deque = deque(maxlen=maxlen)  # (seq, event dict)
+        # the epoch starts at 1 so a just-snapshotted client (since=1)
+        # parks on the next poll instead of re-triggering the since=0
+        # snapshot path in a hot loop
+        self._seq = 1
+        self._cond = threading.Condition()
+
+    def publish(self, etype: str, vid: int, url: str, public_url: str = ""):
+        """Emit one VolumeLocation delta (etype: 'new' | 'deleted')."""
+        with self._cond:
+            self._seq += 1
+            self._events.append((self._seq, {
+                "type": etype, "vid": vid, "url": url,
+                "publicUrl": public_url or url}))
+            self._cond.notify_all()
+
+    def wait(self, since: int, timeout: float = 20.0) -> dict:
+        """Long-poll: events newer than `since`, a reset snapshot when
+        `since` predates the buffer OR comes from another hub epoch
+        (a restarted/failed-over master has a smaller seq — without the
+        reset the client would silently keep its stale map), or {} after
+        `timeout` idle."""
+        with self._cond:
+            oldest = self._events[0][0] if self._events else self._seq + 1
+            need_reset = (since == 0 or since < oldest - 1
+                          or since > self._seq)
+            seq = self._seq
+        if need_reset:
+            # snapshot OUTSIDE the condition: snapshot_fn takes
+            # topology.lock, and topology calls publish() (which takes
+            # the condition) while holding that lock — nesting them here
+            # is a lock-order inversion that deadlocks the master. The
+            # seq captured before the snapshot may lag it; replaying
+            # those deltas onto the newer snapshot is harmless because
+            # new/deleted are idempotent set ops.
+            return {"reset": True, "seq": seq,
+                    "locations": self._snapshot_fn()}
+        with self._cond:
+            if since >= self._seq:
+                self._cond.wait(timeout)
+            if since >= self._seq:
+                return {"seq": self._seq, "events": []}
+            if self._events and since < self._events[0][0] - 1:
+                need_reset = True  # buffer rolled while we parked
+            else:
+                return {"seq": self._seq,
+                        "events": [e for s, e in self._events if s > since]}
+        return {"reset": True, "seq": self._seq,
+                "locations": self._snapshot_fn()}

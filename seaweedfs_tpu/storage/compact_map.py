@@ -294,6 +294,11 @@ class SortedFileNeedleMap(_SortedBase):
             if os.path.exists(self.meta_path):
                 os.remove(self.meta_path)
             return
+        if isinstance(self._base, np.memmap):
+            # the watermark asserts the .sdx covers the .idx — in-place
+            # tombstones must be durable BEFORE the meta says so, or a
+            # crash resurrects the needle on the no-replay fast path
+            self._base.flush()
         self._idx_file.flush()
         state = {"idx_size": os.path.getsize(self.idx_path),
                  "file_counter": self.file_counter,

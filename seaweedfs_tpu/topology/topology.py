@@ -50,6 +50,9 @@ class Topology:
         self.ec_shard_map: Dict[int, List[List[DataNode]]] = {}
         self.ec_collections: Dict[int, str] = {}
         self.max_volume_id = 0
+        # optional ("new"|"deleted", vid, url, public_url) callback — the
+        # master wires its watch hub here to push location deltas
+        self.location_listener = None
         self.lock = threading.RLock()
 
     # -- tree --------------------------------------------------------------
@@ -111,6 +114,15 @@ class Topology:
             for vid in old_vids - new_vids:
                 for layout in self.layouts.values():
                     layout.unregister_volume(vid, node)
+            # push VolumeLocation deltas to watch subscribers (reference
+            # master_grpc_server.go:94-152 heartbeat delta broadcast)
+            if self.location_listener is not None:
+                for vid in new_vids - old_vids:
+                    self.location_listener("new", vid, node.url,
+                                           node.public_url)
+                for vid in old_vids - new_vids:
+                    self.location_listener("deleted", vid, node.url,
+                                           node.public_url)
 
             if ec_shards is not None:
                 node.update_ec_shards(ec_shards, ec_collections or {})
@@ -147,6 +159,12 @@ class Topology:
             for layout in self.layouts.values():
                 for vid in list(node.volumes):
                     layout.set_volume_unavailable(vid, node)
+            # broadcast the dead node's locations as deleted (reference
+            # master_grpc_server.go:24-50 onDisconnect)
+            if self.location_listener is not None:
+                for vid in list(node.volumes):
+                    self.location_listener("deleted", vid, node.url,
+                                           node.public_url)
             for per_shard in self.ec_shard_map.values():
                 for holders in per_shard:
                     if node in holders:

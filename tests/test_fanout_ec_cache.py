@@ -127,7 +127,10 @@ def test_failed_replica_delete_surfaces(cluster):
     replica = vs1 if primary is vs0 else vs0
     # prime the primary's lookup cache while both replicas are alive
     assert len(primary._other_replicas(vid)) == 1
-    replica.stop()
+    # simulate a CRASH (no /cluster/goodbye, heartbeats just stop): the
+    # master still routes to the dead replica, so the fan-out must fail
+    replica._stop.set()
+    replica.server.stop()
     with pytest.raises(HttpError) as ei:
         http_call("DELETE", f"http://{primary.url}/{a['fid']}")
     assert ei.value.status == 500
