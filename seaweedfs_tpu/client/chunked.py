@@ -1,0 +1,121 @@
+"""Client-side chunk-manifest large files.
+
+Reference weed/operation/submit.go:114-230 (SubmitFiles splitting a
+>maxMB upload into chunk needles + a manifest needle flagged
+FlagIsChunkManifest) and weed/operation/chunked_file.go (the manifest
+codec + chunked reader). The raw volume path caps a needle at 4GB and a
+volume's free space bounds a single blob; the manifest indirection
+stripes one logical file over many fids — potentially many volumes —
+while keeping a single public fid.
+
+Manifest JSON (stored as the flagged needle's payload):
+    {"name": ..., "mime": ..., "size": N,
+     "chunks": [{"fid": ..., "offset": N, "size": N}, ...]}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..server.http_util import HttpError, http_call, post_multipart
+from . import operation as op
+
+
+class ChunkInfo:
+    __slots__ = ("fid", "offset", "size")
+
+    def __init__(self, fid: str, offset: int, size: int):
+        self.fid = fid
+        self.offset = offset
+        self.size = size
+
+
+class ChunkManifest:
+    def __init__(self, name: str = "", mime: str = "", size: int = 0,
+                 chunks: Optional[List[ChunkInfo]] = None):
+        self.name = name
+        self.mime = mime
+        self.size = size
+        self.chunks = chunks or []
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "name": self.name, "mime": self.mime, "size": self.size,
+            "chunks": [{"fid": c.fid, "offset": c.offset, "size": c.size}
+                       for c in self.chunks]}).encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "ChunkManifest":
+        d = json.loads(blob.decode())
+        return cls(d.get("name", ""), d.get("mime", ""),
+                   int(d.get("size", 0)),
+                   [ChunkInfo(c["fid"], int(c["offset"]), int(c["size"]))
+                    for c in d.get("chunks", [])])
+
+
+def submit_chunked(master_url: str, data: bytes, filename: str = "",
+                   collection: str = "", replication: str = "",
+                   ttl: str = "", chunk_size: int = 32 << 20,
+                   content_type: str = "") -> str:
+    """Split ``data`` into chunk needles and store a manifest needle;
+    returns the manifest's fid (the file's public id). Chunks that
+    landed before a failure are deleted on the way out."""
+    manifest = ChunkManifest(name=filename, mime=content_type,
+                             size=len(data))
+    uploaded: List[str] = []
+    try:
+        for off in range(0, len(data), chunk_size) or [0]:
+            piece = data[off:off + chunk_size]
+            a = op.assign(master_url, collection=collection,
+                          replication=replication, ttl=ttl)
+            op.upload(a["url"], a["fid"], piece,
+                      filename=f"{filename}_chunk_{off}",
+                      content_type="application/octet-stream",
+                      ttl=ttl, jwt=a.get("auth", ""))
+            uploaded.append(a["fid"])
+            manifest.chunks.append(ChunkInfo(a["fid"], off, len(piece)))
+        main = op.assign(master_url, collection=collection,
+                         replication=replication, ttl=ttl)
+        target = f"http://{main['url']}/{main['fid']}?cm=true"
+        if ttl:
+            target += f"&ttl={ttl}"
+        headers = {"Authorization": f"Bearer {main['auth']}"} \
+            if main.get("auth") else None
+        post_multipart(target, filename or "manifest",
+                       manifest.to_json(), "application/json",
+                       headers=headers)
+        return main["fid"]
+    except Exception:
+        for fid in uploaded:  # don't leak chunk needles on failure
+            try:
+                op.delete_file(master_url, fid)
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+        raise
+
+
+def read_chunked_file(master_url: str, fid: str,
+                      cache: Optional["op.VidCache"] = None) -> bytes:
+    """Fetch a manifest fid and reassemble the logical file (the volume
+    server also resolves manifests server-side; this is the client-side
+    reader the reference keeps in chunked_file.go)."""
+    manifest = ChunkManifest.from_json(_raw_read(master_url, fid, cache))
+    out = bytearray(manifest.size)
+    for c in manifest.chunks:
+        piece = op.read_file(master_url, c.fid, cache=cache)
+        out[c.offset:c.offset + len(piece)] = piece
+    return bytes(out)
+
+
+def _raw_read(master_url: str, fid: str, cache=None) -> bytes:
+    from ..storage.types import parse_file_id
+    vid, _, _ = parse_file_id(fid)
+    urls = cache.lookup(vid) if cache else op.lookup(master_url, vid)
+    last: Optional[Exception] = None
+    for u in urls:
+        try:
+            return http_call("GET", f"http://{u}/{fid}?cm=false")
+        except HttpError as e:
+            last = e
+    raise last or HttpError(404, f"no locations for {fid}")

@@ -188,12 +188,21 @@ def cmd_benchmark(args):
 
 def cmd_upload(args):
     from ..client import operation as op
+    max_bytes = args.maxMB << 20
     for path in args.files:
         with open(path, "rb") as f:
             data = f.read()
-        fid = op.upload_data(args.master, data, filename=path,
-                             collection=args.collection,
-                             replication=args.replication, ttl=args.ttl)
+        if max_bytes and len(data) > max_bytes:
+            from ..client.chunked import submit_chunked
+            fid = submit_chunked(args.master, data, filename=path,
+                                 collection=args.collection,
+                                 replication=args.replication,
+                                 ttl=args.ttl, chunk_size=max_bytes)
+        else:
+            fid = op.upload_data(args.master, data, filename=path,
+                                 collection=args.collection,
+                                 replication=args.replication,
+                                 ttl=args.ttl)
         print(f"{path} -> {fid}")
 
 
@@ -489,6 +498,9 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("-collection", default="")
     u.add_argument("-replication", default="")
     u.add_argument("-ttl", default="")
+    u.add_argument("-maxMB", type=int, default=32,
+                   help="files above this split into chunk needles "
+                        "behind a manifest fid (reference submit.go)")
     u.add_argument("files", nargs="+")
     u.set_defaults(fn=cmd_upload)
 

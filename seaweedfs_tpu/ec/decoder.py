@@ -19,11 +19,13 @@ from ..storage.types import NEEDLE_ENTRY_SIZE, NEEDLE_ID_SIZE, \
 from .constants import DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
 
 
-def iterate_ecx_file(base_name: str):
+def iterate_ecx_file(base_name: str, offset_width: int = 4):
+    from ..storage.types import entry_size
+    rec_size = entry_size(offset_width)
     with open(base_name + ".ecx", "rb") as f:
         while True:
-            rec = f.read(NEEDLE_ENTRY_SIZE)
-            if len(rec) < NEEDLE_ENTRY_SIZE:
+            rec = f.read(rec_size)
+            if len(rec) < rec_size:
                 break
             yield bytes_to_entry(rec)
 
@@ -42,23 +44,29 @@ def iterate_ecj_file(base_name: str):
 
 def write_idx_file_from_ec_index(base_name: str):
     """.ecx + .ecj -> .idx (reference WriteIdxFileFromEcIndex)."""
+    width = read_ec_volume_superblock(base_name).offset_width
     shutil.copyfile(base_name + ".ecx", base_name + ".idx")
     with open(base_name + ".idx", "ab") as idx:
         for nid in iterate_ecj_file(base_name):
-            idx.write(entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE))
+            idx.write(entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE, width))
+
+
+def read_ec_volume_superblock(base_name: str) -> SuperBlock:
+    """The volume superblock rides at the start of .ec00 (data shards carry
+    the original bytes verbatim) — version AND flags (offset width)."""
+    with open(base_name + to_ext(0), "rb") as f:
+        return SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
 
 
 def read_ec_volume_version(base_name: str) -> int:
-    """The volume superblock rides at the start of .ec00 (data shards carry
-    the original bytes verbatim)."""
-    with open(base_name + to_ext(0), "rb") as f:
-        return SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE)).version
+    return read_ec_volume_superblock(base_name).version
 
 
 def find_dat_file_size(base_name: str) -> int:
-    version = read_ec_volume_version(base_name)
+    sb = read_ec_volume_superblock(base_name)
+    version = sb.version
     dat_size = 0
-    for nid, offset, size in iterate_ecx_file(base_name):
+    for nid, offset, size in iterate_ecx_file(base_name, sb.offset_width):
         if size == TOMBSTONE_FILE_SIZE:
             continue
         end = offset + get_actual_size(size, version)

@@ -32,19 +32,24 @@ class EcShardNotFound(Exception):
 
 
 def search_needle_from_sorted_index(f, file_size: int, needle_id: int,
-                                    on_found: Optional[Callable] = None
+                                    on_found: Optional[Callable] = None,
+                                    offset_width: int = 4
                                     ) -> Tuple[int, int]:
-    """Binary search a sorted 16B-record index stream for needle_id.
-    Returns (offset, size); on_found(file, record_pos) runs before return
-    (the delete path passes the tombstoning writer). Raises KeyError."""
-    lo, hi = 0, file_size // NEEDLE_ENTRY_SIZE - 1
+    """Binary search a sorted fixed-record index stream (16B records for
+    4-byte offsets, 17B for 5-byte) for needle_id. Returns
+    (offset, size); on_found(file, record_pos, record_size) runs before
+    return (the delete path passes the tombstoning writer). Raises
+    KeyError."""
+    from ..storage.types import entry_size
+    rec_size = entry_size(offset_width)
+    lo, hi = 0, file_size // rec_size - 1
     while lo <= hi:
         mid = (lo + hi) // 2
-        f.seek(mid * NEEDLE_ENTRY_SIZE)
-        rec_id, offset, size = bytes_to_entry(f.read(NEEDLE_ENTRY_SIZE))
+        f.seek(mid * rec_size)
+        rec_id, offset, size = bytes_to_entry(f.read(rec_size))
         if rec_id == needle_id:
             if on_found is not None:
-                on_found(f, mid * NEEDLE_ENTRY_SIZE)
+                on_found(f, mid * rec_size, rec_size)
             return offset, size
         if rec_id < needle_id:
             lo = mid + 1
@@ -53,15 +58,15 @@ def search_needle_from_sorted_index(f, file_size: int, needle_id: int,
     raise KeyError(needle_id)
 
 
-def mark_needle_deleted(f, record_pos: int):
+def mark_needle_deleted(f, record_pos: int, record_size: int = 16):
     """Overwrite the Size field of the record at record_pos with the
     tombstone value (reference MarkNeedleDeleted)."""
-    f.seek(record_pos + 8 + 4)  # NeedleId + Offset
+    f.seek(record_pos + record_size - 4)  # size is the trailing 4 bytes
     f.write(struct.pack(">I", TOMBSTONE_FILE_SIZE))
     f.flush()
 
 
-def rebuild_ecx_file(base_name: str):
+def rebuild_ecx_file(base_name: str, offset_width: int = 4):
     """Replay .ecj tombstones into .ecx, then remove the journal."""
     ecj = base_name + ".ecj"
     if not os.path.exists(ecj):
@@ -75,7 +80,8 @@ def rebuild_ecx_file(base_name: str):
             nid = int.from_bytes(rec, "big")
             try:
                 search_needle_from_sorted_index(
-                    ecx_f, ecx_size, nid, mark_needle_deleted)
+                    ecx_f, ecx_size, nid, mark_needle_deleted,
+                    offset_width)
             except KeyError:
                 pass
     os.remove(ecj)
@@ -130,22 +136,28 @@ class EcVolume:
         self.shard_locations_refreshed_at = 0.0
         self.created_at = time.time()
         self.version = None
+        self.offset_width = None
         vif = self.base_name + ".vif"
         if os.path.exists(vif):
             try:
                 with open(vif) as f:
-                    self.version = json.load(f).get("version")
+                    info = json.load(f)
+                self.version = info.get("version")
+                self.offset_width = info.get("offset_width")
             except (ValueError, OSError):
                 pass
-        if self.version is None:
-            # no .vif: the real version sits in the volume superblock, which
-            # rides verbatim at the start of .ec00 (data shards hold the
-            # original bytes)
+        if self.version is None or self.offset_width is None:
+            # no .vif: the real version+flags sit in the volume superblock,
+            # which rides verbatim at the start of .ec00 (data shards hold
+            # the original bytes)
             try:
-                from .decoder import read_ec_volume_version
-                self.version = read_ec_volume_version(self.base_name)
+                from .decoder import read_ec_volume_superblock
+                sb = read_ec_volume_superblock(self.base_name)
+                self.version = self.version or sb.version
+                self.offset_width = self.offset_width or sb.offset_width
             except Exception:
-                self.version = 3
+                self.version = self.version or 3
+                self.offset_width = self.offset_width or 4
 
     # -- shard management --------------------------------------------------
     def add_shard(self, shard_id: int) -> bool:
@@ -166,7 +178,8 @@ class EcVolume:
         """-> (dat offset, size, intervals). KeyError if absent or deleted."""
         with self.ecx_lock:
             offset, size = search_needle_from_sorted_index(
-                self.ecx_file, self.ecx_size, needle_id)
+                self.ecx_file, self.ecx_size, needle_id,
+                offset_width=self.offset_width)
         if size == TOMBSTONE_FILE_SIZE:
             raise KeyError(needle_id)
         from ..storage.needle import get_actual_size
@@ -236,7 +249,7 @@ class EcVolume:
             with self.ecx_lock:
                 search_needle_from_sorted_index(
                     self.ecx_file, self.ecx_size, needle_id,
-                    mark_needle_deleted)
+                    mark_needle_deleted, self.offset_width)
         except KeyError:
             return False
         with self.ecj_lock:
@@ -247,7 +260,8 @@ class EcVolume:
 
     def write_vif(self, version: int = None):
         with open(self.base_name + ".vif", "w") as f:
-            json.dump({"version": version or self.version}, f)
+            json.dump({"version": version or self.version,
+                       "offset_width": self.offset_width or 4}, f)
 
     def close(self):
         self.ecx_file.close()

@@ -41,8 +41,18 @@ DEFAULT_SLAB = 8 << 20  # bytes per shard per device call
 
 
 def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx"):
-    """Build the sorted EC index next to the volume files."""
-    db = MemDb.load_from_idx(base_name + ".idx")
+    """Build the sorted EC index next to the volume files. Record width
+    follows the volume's offset width (superblock flag; 5-byte-offset
+    volumes have 17B .idx/.ecx records)."""
+    width = 4
+    try:
+        from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+        with open(base_name + ".dat", "rb") as f:
+            width = SuperBlock.from_bytes(
+                f.read(SUPER_BLOCK_SIZE)).offset_width
+    except Exception:  # noqa: BLE001 - no/short .dat: default width
+        pass
+    db = MemDb.load_from_idx(base_name + ".idx", width)
     db.save_to_idx(base_name + ext)
 
 

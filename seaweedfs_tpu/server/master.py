@@ -208,9 +208,12 @@ class MasterServer:
         """Clean volume-server shutdown: unregister immediately and push
         the deletions, instead of waiting for heartbeat expiry (the
         reference gets this for free from gRPC stream breakage,
-        master_grpc_server.go:24-50)."""
-        if not self.is_leader():
-            return {"not_leader": True, "leader": self.leader_url()}
+        master_grpc_server.go:24-50). Leader-forwarded like every other
+        topology mutation — a goodbye swallowed by a follower would
+        leave the dead node routed until expiry."""
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
         url = req.json().get("url", "")
         node = self.topology.find_node(url)
         if node is not None:

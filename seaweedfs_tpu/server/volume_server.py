@@ -696,6 +696,10 @@ class VolumeServer:
         if ctype and ctype != "application/octet-stream":
             n.set_mime(ctype.encode())
         n.set_last_modified()
+        if req.query.get("cm") == "true":
+            # payload is a chunk-manifest JSON (reference
+            # needle_parse_upload.go: FormValue("cm") sets the flag)
+            n.set_is_chunk_manifest()
         from ..storage.types import TTL
         ttl = TTL.parse(req.query.get("ttl", ""))
         if ttl.to_uint32():
@@ -717,9 +721,19 @@ class VolumeServer:
                 if self.jwt_signing_key else None
             jwt_q = f"&jwt={token}" if token else ""
 
+            # payload-shaping params must survive the hop: cm marks the
+            # manifest flag (a replica missing it would serve raw JSON
+            # and never cascade deletes), ttl stamps per-needle expiry
+            extra_q = ""
+            if req.query.get("cm") == "true":
+                extra_q += "&cm=true"
+            if req.query.get("ttl"):
+                extra_q += f"&ttl={req.query['ttl']}"
+
             def replicate(node_url: str):
                 post_multipart(
-                    f"http://{node_url}{req.path}?type=replicate{jwt_q}",
+                    f"http://{node_url}{req.path}?type=replicate{jwt_q}"
+                    f"{extra_q}",
                     filename, data, ctype or "application/octet-stream")
 
             failed = [
@@ -778,6 +792,12 @@ class VolumeServer:
 
     def _needle_response(self, got: Needle,
                          req: Optional[Request] = None) -> Response:
+        # chunk-manifest resolution (reference
+        # volume_server_handlers_read.go: unless ?cm=false, a flagged
+        # needle is resolved to the chunk needles it lists)
+        if got.is_chunk_manifest() and (
+                req is None or req.query.get("cm") != "false"):
+            return self._chunk_manifest_response(got, req)
         ctype = got.mime.decode() if got.has_mime() \
             else "application/octet-stream"
         headers = {"Etag": f'"{got.etag}"',
@@ -816,6 +836,98 @@ class VolumeServer:
             return Response(body[start:start + length], 206, ctype,
                             headers)
         return Response(body, 200, ctype, headers)
+
+    def _chunk_manifest_response(self, got: Needle,
+                                 req: Optional[Request]) -> Response:
+        """Assemble a chunked file window for the reader (reference
+        chunked_file.go ChunkedFileReader): chunk slices are fetched in
+        parallel with sub-range requests (a 16-byte Range read moves 16
+        bytes, not whole chunks), routed through the push-updated vid
+        map instead of per-chunk master lookups. A full GET of a file
+        bigger than RAM should go through the filer's streaming path;
+        like every raw-needle response here, this one is buffered."""
+        from ..client.chunked import ChunkManifest
+        from ..util.fanout import fan_out
+        from .http_util import parse_range
+        manifest = ChunkManifest.from_json(got.data)
+        ctype = manifest.mime or "application/octet-stream"
+        headers = {"Accept-Ranges": "bytes"}
+        if manifest.name:
+            headers["Content-Disposition"] = \
+                f'inline; filename="{manifest.name}"'
+        rng = req.headers.get("Range") if req is not None else None
+        parsed = parse_range(rng or "", manifest.size)
+        want_start, want_len = (parsed if parsed is not None
+                                else (0, manifest.size))
+        jobs = []
+        for c in manifest.chunks:
+            lo = max(c.offset, want_start)
+            hi = min(c.offset + c.size, want_start + want_len)
+            if lo < hi:
+                jobs.append((c, lo, hi))
+
+        def fetch(job):
+            c, lo, hi = job
+            return self._fetch_fid_range(c.fid, lo - c.offset,
+                                         hi - lo)
+
+        out = bytearray(want_len)
+        for (c, lo, hi), seg, exc in fan_out(fetch, jobs, dedicated=True):
+            if exc is not None:
+                raise HttpError(
+                    502, f"chunk {c.fid} unavailable: {exc}")
+            out[lo - want_start:lo - want_start + len(seg)] = seg
+        if parsed is not None:
+            headers["Content-Range"] = (
+                f"bytes {want_start}-{want_start + want_len - 1}"
+                f"/{manifest.size}")
+            return Response(bytes(out), 206, ctype, headers)
+        return Response(bytes(out), 200, ctype, headers)
+
+    def _fetch_fid_range(self, fid: str, offset: int, size: int) -> bytes:
+        """Range-read one fid from whichever server holds it, using the
+        push-updated vid map (fallback: lookup) for routing."""
+        from ..storage.types import parse_file_id
+        vid, _, _ = parse_file_id(fid)
+        urls = self._vid_map.lookup(vid) if self._vid_map else None
+        if not urls:
+            from ..client.operation import lookup
+            urls = lookup(self.master_url, vid)
+        headers = {"Range": f"bytes={offset}-{offset + size - 1}"}
+        last = None
+        for u in urls:
+            try:
+                return http_call("GET", f"http://{u}/{fid}",
+                                 headers=headers)
+            except HttpError as e:
+                last = e
+        raise last or HttpError(404, f"no locations for {fid}")
+
+    def _cascade_chunk_manifest_delete(self, vid: int, n: Needle):
+        """Deleting a manifest deletes its chunk needles first
+        (reference volume_server_handlers_write.go DeleteHandler +
+        operation.DeleteChunks) — orphaned chunks are unreachable
+        garbage otherwise. The flag is probed with two tiny preads so
+        ordinary deletes never pay a full payload read."""
+        from ..client.chunked import ChunkManifest
+        from ..client.operation import delete_file
+        from ..storage.needle import FLAG_IS_CHUNK_MANIFEST
+        from ..util.fanout import fan_out
+        try:
+            flags = self.store.read_needle_flags(
+                vid, Needle(id=n.id, cookie=n.cookie))
+            if not flags & FLAG_IS_CHUNK_MANIFEST:
+                return
+            got = self.store.read_needle(vid, Needle(id=n.id,
+                                                     cookie=n.cookie))
+        except (NotFound, VolumeError):
+            return
+        try:
+            manifest = ChunkManifest.from_json(got.data)
+        except Exception:  # noqa: BLE001 - corrupt manifest: nothing to do
+            return
+        fan_out(lambda c: delete_file(self.master_url, c.fid),
+                manifest.chunks, dedicated=True)
 
     # -- EC degraded read (reference store_ec.go:119-373) ------------------
     def _read_ec_needle(self, req: Request, ev, vid, key, cookie):
@@ -942,6 +1054,9 @@ class VolumeServer:
             if ev is not None:
                 return self._delete_ec_needle(req, ev, vid, key)
             raise HttpError(404, f"volume {vid} not found")
+        if req.query.get("type") != "replicate" and \
+                req.query.get("cm") != "false":
+            self._cascade_chunk_manifest_delete(vid, n)
         try:
             freed = self.store.delete_needle(vid, n)
         except VolumeError as e:

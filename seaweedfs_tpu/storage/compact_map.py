@@ -332,9 +332,19 @@ class SortedFileNeedleMap(_SortedBase):
 NEEDLE_MAP_KINDS = {"memory", "compact", "sortedfile"}
 
 
-def load_needle_map(idx_path: str, kind: str = "memory"):
+def load_needle_map(idx_path: str, kind: str = "memory",
+                    offset_width: int = 4):
     """Factory selecting the needle-map variant, like the reference's
-    volume -index flag (memory | compact | sortedfile)."""
+    volume -index flag (memory | compact | sortedfile).
+
+    5-byte-offset volumes (17B .idx records) always use the dict map:
+    the numpy fast paths here are wired for the 16B layout, and >32GB
+    volumes are expected to be EC-bound (whose .ecx index is searched
+    on file, not held in RAM) rather than long-lived dict residents.
+    """
+    if offset_width != 4:
+        from .needle_map import NeedleMap
+        return NeedleMap.load(idx_path, offset_width)
     if kind == "memory":
         from .needle_map import NeedleMap
         return NeedleMap.load(idx_path)

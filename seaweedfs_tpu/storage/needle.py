@@ -115,6 +115,12 @@ class Needle:
     def set_gzipped(self):
         self.flags |= FLAG_GZIP
 
+    def set_is_chunk_manifest(self):
+        """Mark the payload as a chunk-manifest JSON (reference
+        needle_read_write.go:22 FlagIsChunkManifest): readers resolve it
+        to the chunk needles it lists, deletes cascade to them."""
+        self.flags |= FLAG_IS_CHUNK_MANIFEST
+
     @property
     def etag(self) -> str:
         return struct.pack(">I", self.checksum).hex()

@@ -22,18 +22,21 @@ import os
 import struct
 from typing import Iterator, Optional, Tuple
 
-from .types import (NEEDLE_ENTRY_SIZE, TOMBSTONE_FILE_SIZE, bytes_to_offset,
-                    bytes_to_needle_id, needle_id_to_bytes, offset_to_bytes)
+from .types import (NEEDLE_ENTRY_SIZE, OFFSET_SIZE, TOMBSTONE_FILE_SIZE,
+                    bytes_to_offset, bytes_to_needle_id, entry_size,
+                    needle_id_to_bytes, offset_to_bytes)
 
 
-def entry_to_bytes(nid: int, offset: int, size: int) -> bytes:
-    return needle_id_to_bytes(nid) + offset_to_bytes(offset) \
+def entry_to_bytes(nid: int, offset: int, size: int,
+                   offset_width: int = OFFSET_SIZE) -> bytes:
+    return needle_id_to_bytes(nid) + offset_to_bytes(offset, offset_width) \
         + struct.pack(">I", size)
 
 
 def bytes_to_entry(b: bytes) -> Tuple[int, int, int]:
-    return (bytes_to_needle_id(b[0:8]), bytes_to_offset(b[8:12]),
-            struct.unpack(">I", b[12:16])[0])
+    """Record width implies the offset width (16 -> 4B, 17 -> 5B)."""
+    return (bytes_to_needle_id(b[0:8]), bytes_to_offset(b[8:-4]),
+            struct.unpack(">I", b[-4:])[0])
 
 
 class NeedleValue:
@@ -47,9 +50,11 @@ class NeedleValue:
 class NeedleMap:
     """Write-through needle map: in-memory dict + append-only .idx log."""
 
-    def __init__(self, idx_path: Optional[str] = None):
+    def __init__(self, idx_path: Optional[str] = None,
+                 offset_width: int = OFFSET_SIZE):
         self._m: dict = {}
         self.idx_path = idx_path
+        self.offset_width = offset_width
         self._idx_file = None
         self.file_counter = 0
         self.file_byte_counter = 0
@@ -61,15 +66,18 @@ class NeedleMap:
 
     # -- loading -----------------------------------------------------------
     @classmethod
-    def load(cls, idx_path: str) -> "NeedleMap":
+    def load(cls, idx_path: str,
+             offset_width: int = OFFSET_SIZE) -> "NeedleMap":
         nm = cls.__new__(cls)
         nm._m = {}
         nm.idx_path = idx_path
+        nm.offset_width = offset_width
         nm.file_counter = nm.file_byte_counter = 0
         nm.deletion_counter = nm.deletion_byte_counter = 0
         nm.maximum_file_key = 0
         if os.path.exists(idx_path):
-            for nid, offset, size in walk_index_file(idx_path):
+            for nid, offset, size in walk_index_file(idx_path,
+                                                     offset_width):
                 nm._apply(nid, offset, size)
         nm._idx_file = open(idx_path, "ab")
         return nm
@@ -94,7 +102,8 @@ class NeedleMap:
     def put(self, nid: int, offset: int, size: int):
         self._apply(nid, offset, size)
         if self._idx_file is not None:
-            self._idx_file.write(entry_to_bytes(nid, offset, size))
+            self._idx_file.write(
+                entry_to_bytes(nid, offset, size, self.offset_width))
             self._idx_file.flush()
 
     def delete(self, nid: int):
@@ -106,7 +115,8 @@ class NeedleMap:
             self.deletion_byte_counter += old.size
         if self._idx_file is not None:
             self._idx_file.write(
-                entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE))
+                entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE,
+                               self.offset_width))
             self._idx_file.flush()
 
     def get(self, nid: int) -> Optional[NeedleValue]:
@@ -138,8 +148,9 @@ class NeedleMap:
 class MemDb:
     """Sorted needle db for building .ecx files (reference memdb.go)."""
 
-    def __init__(self):
+    def __init__(self, offset_width: int = OFFSET_SIZE):
         self._m: dict = {}
+        self.offset_width = offset_width
 
     def set(self, nid: int, offset: int, size: int):
         self._m[nid] = (offset, size)
@@ -156,9 +167,10 @@ class MemDb:
             yield nid, offset, size
 
     @classmethod
-    def load_from_idx(cls, idx_path: str) -> "MemDb":
-        db = cls()
-        for nid, offset, size in walk_index_file(idx_path):
+    def load_from_idx(cls, idx_path: str,
+                      offset_width: int = OFFSET_SIZE) -> "MemDb":
+        db = cls(offset_width)
+        for nid, offset, size in walk_index_file(idx_path, offset_width):
             if size != TOMBSTONE_FILE_SIZE and offset != 0:
                 db.set(nid, offset, size)
             else:
@@ -168,17 +180,19 @@ class MemDb:
     def save_to_idx(self, path: str):
         with open(path, "wb") as f:
             for nid, offset, size in self.ascending_visit():
-                f.write(entry_to_bytes(nid, offset, size))
+                f.write(entry_to_bytes(nid, offset, size,
+                                       self.offset_width))
 
 
-def walk_index_file(idx_path: str):
-    """Stream (needle_id, offset, size) from a .idx file
+def walk_index_file(idx_path: str, offset_width: int = OFFSET_SIZE):
+    """Stream (needle_id, offset, size) from a .idx file — 16B records
+    with 4-byte offsets, 17B with 5-byte
     (reference weed/storage/idx/walk.go:14)."""
+    rec = entry_size(offset_width)
     with open(idx_path, "rb") as f:
         while True:
-            chunk = f.read(NEEDLE_ENTRY_SIZE * 1024)
+            chunk = f.read(rec * 1024)
             if not chunk:
                 break
-            for i in range(0, len(chunk) - NEEDLE_ENTRY_SIZE + 1,
-                           NEEDLE_ENTRY_SIZE):
-                yield bytes_to_entry(chunk[i:i + NEEDLE_ENTRY_SIZE])
+            for i in range(0, len(chunk) - rec + 1, rec):
+                yield bytes_to_entry(chunk[i:i + rec])

@@ -108,6 +108,12 @@ class Store:
             raise VolumeError(f"volume {vid} not found")
         return v.read_needle(n)
 
+    def read_needle_flags(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.read_needle_flags(n)
+
     def delete_needle(self, vid: int, n: Needle) -> int:
         v = self.find_volume(vid)
         if v is None:
@@ -127,7 +133,11 @@ class Store:
         ec_encoder.write_ec_files(base, codec=self.codec)
         import json
         with open(base + ".vif", "w") as f:
-            json.dump({"version": v.version}, f)
+            # offset_width must ride along: a shard receiver holding only
+            # parity shards has no .ec00 superblock to infer the .ecx
+            # record width from
+            json.dump({"version": v.version,
+                       "offset_width": v.offset_width}, f)
         return base
 
     def mount_ec_shards(self, vid: int, collection: str,
@@ -176,7 +186,9 @@ class Store:
             base = volume_file_prefix(loc.directory, collection, vid)
             if os.path.exists(base + ".ecx"):
                 rebuilt = ec_encoder.rebuild_ec_files(base, codec=self.codec)
-                rebuild_ecx_file(base)
+                from ..ec.decoder import read_ec_volume_superblock
+                rebuild_ecx_file(
+                    base, read_ec_volume_superblock(base).offset_width)
                 return rebuilt
         raise VolumeError(f"ec volume {vid} not found")
 

@@ -2,7 +2,12 @@
 
 Byte-compatible with the reference (weed/storage/super_block/super_block.go):
 byte 0 version, byte 1 replica placement, bytes 2-3 TTL, bytes 4-5
-compaction revision, bytes 6-7 extra-size (unused here).
+compaction revision. Byte 6 (unused in the reference) carries volume
+flags here: bit 0 marks 5-byte offsets (the reference makes that a
+whole-binary build tag, types/offset_5bytes.go + Makefile:15; a
+per-volume flag lets 8TB volumes coexist with wire-compatible 32GB
+ones). Reference-written volumes have 0 there, so compatibility is
+one-way safe.
 """
 
 from __future__ import annotations
@@ -10,9 +15,12 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
-from .types import CURRENT_VERSION, ReplicaPlacement, TTL
+from .types import CURRENT_VERSION, OFFSET_SIZE, OFFSET_SIZE_5, \
+    ReplicaPlacement, TTL
 
 SUPER_BLOCK_SIZE = 8
+
+FLAG_5_BYTE_OFFSETS = 0x01
 
 
 class InvalidSuperBlock(Exception):
@@ -25,13 +33,19 @@ class SuperBlock:
     replica_placement: ReplicaPlacement = field(default_factory=ReplicaPlacement)
     ttl: TTL = field(default_factory=TTL)
     compaction_revision: int = 0
+    flags: int = 0
+
+    @property
+    def offset_width(self) -> int:
+        return OFFSET_SIZE_5 if self.flags & FLAG_5_BYTE_OFFSETS \
+            else OFFSET_SIZE
 
     def to_bytes(self) -> bytes:
         return bytes([self.version & 0xFF,
                       self.replica_placement.to_byte()]) \
             + self.ttl.to_bytes() \
             + struct.pack(">H", self.compaction_revision) \
-            + b"\x00\x00"
+            + bytes([self.flags & 0xFF, 0])
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "SuperBlock":
@@ -45,4 +59,5 @@ class SuperBlock:
             replica_placement=ReplicaPlacement.from_byte(b[1]),
             ttl=TTL.from_bytes(b[2:4]),
             compaction_revision=struct.unpack(">H", b[4:6])[0],
+            flags=b[6],
         )

@@ -31,6 +31,22 @@ TIMESTAMP_SIZE = 8
 TOMBSTONE_FILE_SIZE = 0xFFFFFFFF
 MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB (4B offsets * 8)
 
+# 5-byte offsets (reference types/offset_5bytes.go, a build tag there):
+# here a per-volume property carried in the superblock, widening .idx
+# entries to 17 bytes and the max volume to 8TB
+OFFSET_SIZE_5 = 5
+MAX_POSSIBLE_VOLUME_SIZE_5 = (1 << 40) * 8  # 8TB
+
+
+def entry_size(offset_width: int = OFFSET_SIZE) -> int:
+    """.idx record width for a volume's offset width (16 or 17)."""
+    return NEEDLE_ID_SIZE + offset_width + SIZE_SIZE
+
+
+def max_volume_size(offset_width: int = OFFSET_SIZE) -> int:
+    return MAX_POSSIBLE_VOLUME_SIZE_5 if offset_width == OFFSET_SIZE_5 \
+        else MAX_POSSIBLE_VOLUME_SIZE
+
 VERSION1 = 1
 VERSION2 = 2
 VERSION3 = 3
@@ -45,15 +61,21 @@ def bytes_to_needle_id(b: bytes) -> int:
     return struct.unpack(">Q", b[:8])[0]
 
 
-def offset_to_bytes(offset: int) -> bytes:
-    """offset is the real byte offset; stored /8."""
+def offset_to_bytes(offset: int, offset_width: int = OFFSET_SIZE) -> bytes:
+    """offset is the real byte offset; stored /8 in 4 or 5 big-endian
+    bytes (reference offset_4bytes.go / offset_5bytes.go)."""
     if offset % NEEDLE_PADDING_SIZE:
         raise ValueError(f"offset {offset} not {NEEDLE_PADDING_SIZE}B aligned")
-    return struct.pack(">I", offset // NEEDLE_PADDING_SIZE)
+    stored = offset // NEEDLE_PADDING_SIZE
+    if stored >> (8 * offset_width):
+        raise ValueError(
+            f"offset {offset} exceeds {offset_width}-byte addressing")
+    return stored.to_bytes(offset_width, "big")
 
 
 def bytes_to_offset(b: bytes) -> int:
-    return struct.unpack(">I", b[:4])[0] * NEEDLE_PADDING_SIZE
+    """Width inferred from the slice length (4 or 5 bytes)."""
+    return int.from_bytes(b, "big") * NEEDLE_PADDING_SIZE
 
 
 def format_needle_id_cookie(key: int, cookie: int) -> str:

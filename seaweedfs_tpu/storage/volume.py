@@ -40,7 +40,8 @@ class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: Optional[ReplicaPlacement] = None,
                  ttl: Optional[TTL] = None, create: bool = False,
-                 version: int = None, index_kind: str = "memory"):
+                 version: int = None, index_kind: str = "memory",
+                 offset_width: int = 4):
         self.dir = dirname
         self.collection = collection or ""
         self.id = vid
@@ -75,15 +76,18 @@ class Volume:
             self.super_block = SuperBlock.from_bytes(
                 self.dat.read(SUPER_BLOCK_SIZE))
             self.readonly = True
-            self.nm = load_needle_map(self.idx_path, self.index_kind)
+            self.nm = load_needle_map(self.idx_path, self.index_kind,
+                                  self.offset_width)
             self.last_modified = remote_info.get("modified_at", 0)
             return
 
         if create and not os.path.exists(self.dat_path):
             os.makedirs(dirname, exist_ok=True)
+            from .super_block import FLAG_5_BYTE_OFFSETS
             sb = SuperBlock(
                 replica_placement=replica_placement or ReplicaPlacement(),
-                ttl=ttl or TTL())
+                ttl=ttl or TTL(),
+                flags=FLAG_5_BYTE_OFFSETS if offset_width == 5 else 0)
             if version:
                 sb.version = version
             with open(self.dat_path, "wb") as f:
@@ -97,7 +101,8 @@ class Volume:
 
         self.dat = open(self.dat_path, "r+b")
         self.check_integrity()
-        self.nm = load_needle_map(self.idx_path, self.index_kind)
+        self.nm = load_needle_map(self.idx_path, self.index_kind,
+                                  self.offset_width)
         self.last_modified = int(os.path.getmtime(self.dat_path))
         # a keep-local tier upload leaves .dat + .vif side by side; the
         # volume serves locally but must stay frozen or the parked
@@ -112,6 +117,12 @@ class Volume:
     @property
     def version(self) -> int:
         return self.super_block.version
+
+    @property
+    def offset_width(self) -> int:
+        """4 (32GB max, reference-compatible) or 5 (8TB volumes);
+        carried by the superblock flag byte."""
+        return self.super_block.offset_width
 
     def file_name(self) -> str:
         return volume_file_prefix(self.dir, self.collection, self.id)
@@ -170,18 +181,20 @@ class Volume:
         if os.path.exists(self.idx_path):
             from .needle_map import bytes_to_entry
             from .needle import get_actual_size
+            from .types import entry_size
+            rec = entry_size(self.super_block.offset_width)
             idx_size = os.path.getsize(self.idx_path)
-            idx_size -= idx_size % 16
+            idx_size -= idx_size % rec
             dat_end = self.dat.seek(0, os.SEEK_END)
             version = self.super_block.version
             with open(self.idx_path, "r+b") as f:
-                while idx_size >= 16:
-                    f.seek(idx_size - 16)
-                    nid, offset, size = bytes_to_entry(f.read(16))
+                while idx_size >= rec:
+                    f.seek(idx_size - rec)
+                    nid, offset, size = bytes_to_entry(f.read(rec))
                     if size == TOMBSTONE_FILE_SIZE or offset == 0 or \
                             offset + get_actual_size(size, version) <= dat_end:
                         break
-                    idx_size -= 16
+                    idx_size -= rec
                 f.truncate(idx_size)
 
     # -- write -------------------------------------------------------------
@@ -216,6 +229,14 @@ class Volume:
             if not n.append_at_ns:
                 n.append_at_ns = time.time_ns()
             blob = n.to_bytes(self.version)
+            # hard addressing ceiling for this volume's offset width
+            # (32GB / 8TB); checked BEFORE the append so a too-far write
+            # can't land in the .dat and then fail to index
+            from .types import max_volume_size
+            if offset + len(blob) > max_volume_size(self.offset_width):
+                raise VolumeError(
+                    f"volume {self.id}: write at {offset} exceeds the "
+                    f"{self.offset_width}-byte-offset ceiling")
             try:
                 self.dat.seek(offset)
                 self.dat.write(blob)
@@ -286,6 +307,29 @@ class Volume:
                 raise NotFound(f"needle {n.id} expired")
         return got
 
+    def read_needle_flags(self, n: Needle) -> int:
+        """Flags byte of a stored needle via two tiny preads — no payload
+        read (the delete path probes FLAG_IS_CHUNK_MANIFEST this way; a
+        full read_needle would drag the whole blob off disk first).
+        v1 needles carry no flags byte -> 0. NotFound if absent."""
+        import struct
+        with self.lock:
+            nv = self.nm.get(n.id)
+            if nv is None or nv.offset == 0 or \
+                    nv.size == TOMBSTONE_FILE_SIZE:
+                raise NotFound(
+                    f"needle {n.id} not found in volume {self.id}")
+            if self.version == 1 or nv.size == 0:
+                return 0
+            self.dat.seek(nv.offset + 16)
+            raw = self.dat.read(4)
+            if len(raw) < 4:
+                return 0
+            data_size = struct.unpack(">I", raw)[0]
+            self.dat.seek(nv.offset + 16 + 4 + data_size)
+            b = self.dat.read(1)
+            return b[0] if b else 0
+
     def _read_blob(self, offset: int, size: int) -> bytes:
         want = get_actual_size(size, self.version)
         self.dat.seek(offset)
@@ -331,8 +375,10 @@ class Volume:
                 replica_placement=self.super_block.replica_placement,
                 ttl=self.super_block.ttl,
                 compaction_revision=(
-                    self.super_block.compaction_revision + 1) & 0xFFFF)
+                    self.super_block.compaction_revision + 1) & 0xFFFF,
+                flags=self.super_block.flags)
             from .needle_map import entry_to_bytes
+            width = self.offset_width
             live = sorted(self.nm.items(), key=lambda kv: kv[1].offset)
             with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
                 dat_out.write(new_sb.to_bytes())
@@ -341,7 +387,8 @@ class Volume:
                         continue
                     new_off = dat_out.tell()
                     dat_out.write(self._read_blob(nv.offset, nv.size))
-                    idx_out.write(entry_to_bytes(nid, new_off, nv.size))
+                    idx_out.write(entry_to_bytes(nid, new_off, nv.size,
+                                                 width))
             # remember where the live .idx stood so commit_compact can
             # replay writes/deletes that land in the window (the
             # reference's makeupDiff, volume_vacuum.go:181)
@@ -368,7 +415,8 @@ class Volume:
                 self.super_block = SuperBlock.from_bytes(
                     f.read(SUPER_BLOCK_SIZE))
             self.dat = open(self.dat_path, "r+b")
-            self.nm = load_needle_map(self.idx_path, self.index_kind)
+            self.nm = load_needle_map(self.idx_path, self.index_kind,
+                                  self.offset_width)
 
     def _makeup_diff(self, cpd: str, cpx: str):
         """Replay .idx entries appended after compact()'s snapshot onto the
@@ -380,20 +428,23 @@ class Volume:
         if idx_size <= watermark:
             return
         from .needle_map import bytes_to_entry, entry_to_bytes
+        from .types import entry_size
+        width = self.offset_width
+        rec = entry_size(width)
         with open(self.idx_path, "rb") as f:
             f.seek(watermark)
             delta = f.read(idx_size - watermark)
         new_off = os.path.getsize(cpd)
         with open(cpd, "ab") as dat_out, open(cpx, "ab") as idx_out:
-            for i in range(0, len(delta) - 15, 16):
-                nid, offset, size = bytes_to_entry(delta[i:i + 16])
+            for i in range(0, len(delta) - rec + 1, rec):
+                nid, offset, size = bytes_to_entry(delta[i:i + rec])
                 if size == TOMBSTONE_FILE_SIZE or offset == 0:
                     idx_out.write(
-                        entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE))
+                        entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE, width))
                     continue
                 blob = self._read_blob(offset, size)
                 dat_out.write(blob)
-                idx_out.write(entry_to_bytes(nid, new_off, size))
+                idx_out.write(entry_to_bytes(nid, new_off, size, width))
                 new_off += len(blob)
         self._compact_idx_watermark = None
 

@@ -76,15 +76,18 @@ def _record_end(volume: Volume, offset: int, idx_size: int) -> int:
 
 
 class _IdxReader:
-    """One open .idx handle for a whole search (probes are 16B preads)."""
+    """One open .idx handle for a whole search (probes are record-sized
+    preads; 16B for 4-byte-offset volumes, 17B for 5-byte)."""
 
     def __init__(self, volume: Volume):
+        from .types import entry_size
+        self.rec = entry_size(volume.offset_width)
         self.f = open(volume.idx_path, "rb")
-        self.total = os.path.getsize(volume.idx_path) // IDX_ENTRY_SIZE
+        self.total = os.path.getsize(volume.idx_path) // self.rec
 
     def entry(self, slot: int):
-        self.f.seek(slot * IDX_ENTRY_SIZE)
-        return bytes_to_entry(self.f.read(IDX_ENTRY_SIZE))
+        self.f.seek(slot * self.rec)
+        return bytes_to_entry(self.f.read(self.rec))
 
     def close(self):
         self.f.close()
@@ -252,15 +255,17 @@ def rebuild_index(dat_path: str, idx_path: str) -> int:
             f.seek(off)
             return f.read(size)
 
+        width = sb.offset_width
         count = 0
         tmp = idx_path + ".tmp"
         with open(tmp, "wb") as idx:
             for n, offset, actual in walk_records(pread, version,
                                                   SUPER_BLOCK_SIZE, end):
                 if n.size > 0:
-                    idx.write(entry_to_bytes(n.id, offset, n.size))
+                    idx.write(entry_to_bytes(n.id, offset, n.size, width))
                 else:
-                    idx.write(entry_to_bytes(n.id, 0, TOMBSTONE_FILE_SIZE))
+                    idx.write(entry_to_bytes(n.id, 0, TOMBSTONE_FILE_SIZE,
+                                             width))
                 count += 1
     os.replace(tmp, idx_path)
     return count
