@@ -108,7 +108,17 @@ def _start_s3(filer_server, port: int, host: str, config_path: str):
 
 def cmd_filer(args):
     from ..server.filer_server import FilerServer
-    store_options = {"path": args.db} if args.store == "sqlite" else {}
+    db = args.db
+    if args.store == "sharded":
+        # the sharded store wants a DIRECTORY of shard dbs; don't reuse
+        # the sqlite single-file default as a directory name
+        if db == "./filer.db":
+            db = "./filer_meta"
+        store_options = {"path": db, "shards": args.storeShards}
+    elif args.store == "sqlite":
+        store_options = {"path": db}
+    else:
+        store_options = {}
     f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
                     store=args.store, store_options=store_options,
                     collection=args.collection,
@@ -440,9 +450,14 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-ip", default="127.0.0.1")
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
-                   choices=["memory", "sqlite"])
+                   choices=["memory", "sqlite", "sharded"])
     f.add_argument("-db", default="./filer.db",
-                   help="sqlite metadata path")
+                   help="metadata path: a sqlite file, or a directory "
+                        "of shard dbs for -store sharded (default "
+                        "./filer_meta there)")
+    f.add_argument("-storeShards", type=int, default=8,
+                   help="shard count for -store sharded (sticky per "
+                        "store directory)")
     f.add_argument("-collection", default="")
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,

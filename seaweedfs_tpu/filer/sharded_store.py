@@ -1,0 +1,99 @@
+"""Sharded embedded filer store (reference weed/filer2/leveldb2).
+
+The reference's leveldb2 store splits the namespace across 8 embedded
+leveldb instances by md5(directory) so one hot directory (or one huge
+db) never serializes the whole filer; keys are md5(dir)+name so a
+directory's children colocate in exactly one shard and listings stay a
+single range scan. The same design over the stdlib's sqlite: N
+independent database files, shard = md5(dir) % N.
+
+Cross-shard operations: only recursive folder deletion spans shards
+(descendant directories hash elsewhere); it broadcasts the prefix
+delete to every shard, exactly as cheap as the single-db case in
+aggregate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import posixpath
+from typing import List, Optional
+
+from .entry import Entry
+from .filerstore import FilerStore, register_store
+from .sqlite_store import SqliteStore
+
+DEFAULT_SHARDS = 8
+
+
+@register_store
+class ShardedStore(FilerStore):
+    name = "sharded"
+
+    def initialize(self, path: str = "", shards: int = DEFAULT_SHARDS,
+                   **options):
+        """``path`` is a directory holding filer_00.db .. filer_NN.db
+        (empty/':memory:' -> per-shard in-memory dbs, for tests).
+
+        The shard count is sticky: it is recorded in a SHARDS marker on
+        first open and re-used afterwards — reopening with a different
+        ``shards`` value would re-route md5(dir) % N and silently hide
+        every existing entry."""
+        self._n = int(shards)
+        self._shards: List[SqliteStore] = []
+        if path and path != ":memory:":
+            os.makedirs(path, exist_ok=True)
+            marker = os.path.join(path, "SHARDS")
+            if os.path.exists(marker):
+                with open(marker) as f:
+                    self._n = int(f.read().strip())
+            else:
+                existing = [p for p in os.listdir(path)
+                            if p.startswith("filer_") and p.endswith(".db")]
+                if existing and len(existing) != self._n:
+                    self._n = len(existing)
+                with open(marker, "w") as f:
+                    f.write(str(self._n))
+        for i in range(self._n):
+            s = SqliteStore()
+            if path and path != ":memory:":
+                s.initialize(path=os.path.join(path, f"filer_{i:02d}.db"))
+            else:
+                s.initialize(path=":memory:")
+            self._shards.append(s)
+
+    def _shard_for_dir(self, dir_path: str) -> SqliteStore:
+        digest = hashlib.md5(
+            (dir_path.rstrip("/") or "/").encode()).digest()
+        return self._shards[digest[0] % self._n]
+
+    def _shard(self, full_path: str) -> SqliteStore:
+        return self._shard_for_dir(posixpath.dirname(full_path) or "/")
+
+    def insert_entry(self, entry: Entry) -> None:
+        self._shard(entry.full_path).insert_entry(entry)
+
+    def update_entry(self, entry: Entry) -> None:
+        self._shard(entry.full_path).update_entry(entry)
+
+    def find_entry(self, full_path: str) -> Optional[Entry]:
+        return self._shard(full_path).find_entry(full_path)
+
+    def delete_entry(self, full_path: str) -> None:
+        self._shard(full_path).delete_entry(full_path)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        # descendants' directories hash to arbitrary shards: broadcast
+        # (reference leveldb2 walks its per-shard prefix the same way)
+        for s in self._shards:
+            s.delete_folder_children(full_path)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               inclusive: bool, limit: int) -> List[Entry]:
+        return self._shard_for_dir(dir_path).list_directory_entries(
+            dir_path, start_file_name, inclusive, limit)
+
+    def close(self):
+        for s in self._shards:
+            s.close()

@@ -74,6 +74,60 @@ class MemoryPublisher(Publisher):
             fn(key, event)
 
 
+@register
+class WebhookPublisher(Publisher):
+    """POST each metadata event as JSON to an HTTP endpoint — the
+    broker-neutral external integration (any Kafka/SQS bridge, serverless
+    consumer, or audit collector can sit behind a URL). Plays the role of
+    the reference's external notification backends
+    (weed/notification/) without requiring their cloud SDKs.
+
+    Options: url (required), timeout (s), retries (attempts per event),
+    hmac_key (optional — adds an X-Seaweed-Signature hex-HMAC-SHA256 of
+    the body so the receiver can authenticate the sender).
+    """
+
+    name = "webhook"
+
+    def initialize(self, url: str = "", timeout: float = 10.0,
+                   retries: int = 3, hmac_key: str = "", **options):
+        if not url:
+            raise ValueError("webhook publisher needs a url")
+        self.url = url
+        self.timeout = float(timeout)
+        self.retries = max(1, int(retries))
+        self.hmac_key = hmac_key
+
+    def send(self, key: str, event: dict) -> None:
+        import hashlib
+        import hmac
+        import json
+        import time as _time
+        from ..server.http_util import HttpError, http_call
+        body = json.dumps({"key": key, "event": event}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.hmac_key:
+            headers["X-Seaweed-Signature"] = hmac.new(
+                self.hmac_key.encode(), body, hashlib.sha256).hexdigest()
+        last = None
+        for attempt in range(self.retries):
+            try:
+                http_call("POST", self.url, body, headers,
+                          timeout=self.timeout)
+                return
+            except HttpError as e:
+                last = e
+                # 4xx (bar 429) can never succeed on retry
+                if 400 <= e.status < 500 and e.status != 429:
+                    break
+            except Exception as e:  # noqa: BLE001 - network: retried
+                last = e
+            if attempt + 1 < self.retries:
+                _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+        raise RuntimeError(f"webhook {self.url} failed after "
+                           f"{attempt + 1} attempts: {last}")
+
+
 class StubPublisher(Publisher):
     """Placeholder for cloud brokers not present in this environment
     (kafka/aws_sqs/google_pub_sub/gocdk_pub_sub). Configuring one fails

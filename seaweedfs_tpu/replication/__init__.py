@@ -8,7 +8,7 @@ on demand.
 """
 
 from .replicator import Replicator  # noqa: F401
-from .sink import (AzureSink, B2Sink, FilerSink, GcsSink,  # noqa: F401
+from .sink import (B2Sink, FilerSink, GcsSink,  # noqa: F401
                    ReplicationSink, SinkError, make_sink)
 from .source import FilerSource  # noqa: F401
 from .sub import EventSubscriber  # noqa: F401

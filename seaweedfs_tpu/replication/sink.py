@@ -128,37 +128,57 @@ class S3Sink(ReplicationSink):
                 raise SinkError(str(e)) from None
 
 
-class _UnavailableSink(ReplicationSink):
-    """Config-compatible placeholder for sinks whose cloud SDKs are not
-    in this build (reference gcssink/azuresink/b2sink)."""
+class GcsSink(S3Sink):
+    """Google Cloud Storage via its S3-interoperability XML API
+    (storage.googleapis.com speaks SigV4 with HMAC interop keys) — a
+    real sink over the same from-scratch S3 client, covering the
+    reference's gcssink without the GCS SDK."""
 
-    def __init__(self, *a, **kw):
-        raise SinkError(
-            f"{self.kind} sink requires its cloud SDK, which is not "
-            f"available in this build; use the filer or s3 sink")
-
-
-class GcsSink(_UnavailableSink):
     kind = "gcs"
 
+    def __init__(self, bucket: str, access_key: str = "",
+                 secret_key: str = "", directory: str = "",
+                 endpoint: str = "https://storage.googleapis.com",
+                 region: str = "auto"):
+        super().__init__(endpoint, bucket, access_key=access_key,
+                         secret_key=secret_key, directory=directory,
+                         region=region)
 
-class AzureSink(_UnavailableSink):
-    kind = "azure"
 
+class B2Sink(S3Sink):
+    """Backblaze B2 via its S3-compatible API (reference b2sink)."""
 
-class B2Sink(_UnavailableSink):
     kind = "b2"
 
+    def __init__(self, bucket: str, access_key: str = "",
+                 secret_key: str = "", directory: str = "",
+                 region: str = "us-west-004", endpoint: str = ""):
+        endpoint = endpoint or f"https://s3.{region}.backblazeb2.com"
+        super().__init__(endpoint, bucket, access_key=access_key,
+                         secret_key=secret_key, directory=directory,
+                         region=region)
 
-_SINKS = {"filer": FilerSink, "s3": S3Sink, "gcs": GcsSink,
-          "azure": AzureSink, "b2": B2Sink}
+
+_SINKS = {"filer": FilerSink, "s3": S3Sink, "gcs": GcsSink, "b2": B2Sink}
 
 
 def make_sink(cfg: dict) -> ReplicationSink:
     """cfg = {"type": "filer", ...kwargs} (reference replication.toml
     [sink.<type>] sections)."""
     kind = cfg.get("type")
+    if kind == "azure":
+        # the lone sink with no S3-compatible endpoint; its SDK is not
+        # in this build (reference azuresink wraps azure-storage-blob)
+        raise SinkError(
+            "azure sink requires the Azure Blob SDK, which is not "
+            "available in this build; use the filer, s3, gcs or b2 sink")
     if kind not in _SINKS:
         raise SinkError(f"unknown sink type {kind!r}")
     kwargs = {k: v for k, v in cfg.items() if k != "type"}
-    return _SINKS[kind](**kwargs)
+    try:
+        return _SINKS[kind](**kwargs)
+    except TypeError as e:
+        # config errors (missing bucket, reference-toml key names this
+        # build doesn't take) must surface as SinkError, not TypeError —
+        # callers validate configs by catching SinkError
+        raise SinkError(f"{kind} sink config: {e}") from None

@@ -104,8 +104,27 @@ class FilerServer:
         event = event_notification(old, new, delete_chunks)
         self.log_buffer.append(event)
         if self.notify_publisher is not None:
+            # external brokers are slow/fallible and the mutation has
+            # already committed — dispatch off the write path, never
+            # fail the client (reference filer_notify.go fires into the
+            # broker client's own buffering the same way)
             key = (new or old).full_path
-            self.notify_publisher.send(key, event)
+            self._notify_pool_submit(key, event)
+
+    def _notify_pool_submit(self, key, event):
+        from concurrent.futures import ThreadPoolExecutor
+        if not hasattr(self, "_notify_pool"):
+            self._notify_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="filer-notify")
+
+        def run():
+            try:
+                self.notify_publisher.send(key, event)
+            except Exception as e:  # noqa: BLE001 - must not kill the pool
+                from ..util import glog
+                glog.V(0).infof("notification for %s failed: %s", key, e)
+
+        self._notify_pool.submit(run)
 
     def _deletion_loop(self):
         """Drain the filer's chunk-deletion queue against the cluster

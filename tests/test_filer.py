@@ -13,6 +13,7 @@ from seaweedfs_tpu.filer import (
     FileChunk,
     Filer,
     MemoryStore,
+    ShardedStore,
     SqliteStore,
     compact_file_chunks,
     minus_chunks,
@@ -130,7 +131,8 @@ class TestReadChunked:
         assert out == b"\0" * 5 + b"x" * 10 + b"\0" * 5
 
 
-@pytest.mark.parametrize("store_cls", [MemoryStore, SqliteStore])
+@pytest.mark.parametrize("store_cls",
+                         [MemoryStore, SqliteStore, ShardedStore])
 class TestStores:
     def make(self, store_cls):
         s = store_cls()
