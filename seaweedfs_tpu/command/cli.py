@@ -13,14 +13,45 @@ import sys
 import time
 
 
+def _apply_security_config(args):
+    """Flag -> security.toml/json -> WEED_* env fallback for the JWT key
+    (reference three-tier config, util/config.go + scaffold.go)."""
+    from ..util.config import config_get, load_config
+    cfg = load_config("security")
+    if not getattr(args, "jwtKey", ""):
+        args.jwtKey = config_get(cfg, "jwt.signing.key", "") or ""
+
+
+def _apply_tls_config(args):
+    """TLS material (reference security/tls.go) applies to EVERY
+    command: servers present cert/key, and pure clients (upload,
+    download, shell, benchmark) still need the client context to reach
+    a TLS cluster."""
+    from ..util.config import config_get, load_config
+    cfg = load_config("security")
+    cert = getattr(args, "tlsCert", "") or \
+        config_get(cfg, "https.cert", "") or ""
+    key = getattr(args, "tlsKey", "") or \
+        config_get(cfg, "https.key", "") or ""
+    ca = getattr(args, "tlsCa", "") or \
+        config_get(cfg, "https.ca", "") or ""
+    if cert or ca:
+        from ..server.http_util import configure_tls
+        configure_tls(cert, key, ca)
+
+
 def cmd_master(args):
+    _apply_security_config(args)
     from ..server.master import MasterServer
     m = MasterServer(port=args.port, host=args.ip,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds,
                      jwt_signing_key=args.jwtKey,
-                     peers=args.peers, raft_dir=args.mdir).start()
+                     peers=args.peers, raft_dir=args.mdir,
+                     maintenance_scripts=args.maintenanceScripts,
+                     maintenance_interval=args.maintenanceIntervalSeconds
+                     ).start()
     print(f"master listening on {m.url}")
     _wait(m)
 
@@ -35,6 +66,7 @@ def _load_tier_config(path: str):
 
 
 def cmd_volume(args):
+    _apply_security_config(args)
     from ..server.volume_server import VolumeServer
     _load_tier_config(args.tierConfig)
     dirs = args.dir.split(",")
@@ -48,6 +80,7 @@ def cmd_volume(args):
                       ec_backend=args.ec_backend,
                       jwt_signing_key=args.jwtKey,
                       index_kind=args.index,
+                      compaction_mbps=args.compactionMBps,
                       whitelist=[w for w in args.whiteList.split(",")
                                  if w]).start()
     print(f"volume server listening on {vs.url}, "
@@ -58,6 +91,7 @@ def cmd_volume(args):
 def cmd_server(args):
     """Combined master + volume (+ filer) in one process
     (reference `weed server`)."""
+    _apply_security_config(args)
     from ..server.master import MasterServer
     from ..server.volume_server import VolumeServer
     _load_tier_config(getattr(args, "tierConfig", ""))
@@ -107,6 +141,7 @@ def _start_s3(filer_server, port: int, host: str, config_path: str):
 
 
 def cmd_filer(args):
+    _apply_security_config(args)
     from ..server.filer_server import FilerServer
     db = args.db
     if args.store == "sharded":
@@ -388,11 +423,20 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-pulseSeconds", type=int, default=5)
     m.add_argument("-jwtKey", default="",
                    help="HS256 key for per-fid write tokens")
+    m.add_argument("-tlsCert", default="")
+    m.add_argument("-tlsKey", default="")
+    m.add_argument("-tlsCa", default="")
     m.add_argument("-peers", default="",
                    help="comma-separated master peers for raft HA, "
                         "e.g. host1:9333,host2:9333,host3:9333")
     m.add_argument("-mdir", default="",
                    help="directory for raft state persistence")
+    m.add_argument("-maintenanceScripts", default="",
+                   help="';'-separated shell command lines cron'd on "
+                        "the leader (reference master.maintenance), "
+                        'e.g. "volume.vacuum; ec.rebuild"')
+    m.add_argument("-maintenanceIntervalSeconds", type=float,
+                   default=17 * 60)
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")
@@ -406,12 +450,18 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-pulseSeconds", type=int, default=5)
     v.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
+    v.add_argument("-compactionMBps", type=int, default=0,
+                   help="throttle vacuum/compaction writes (MB/s, "
+                        "0 = unthrottled; reference compactionMBps)")
     v.add_argument("-index", default="memory",
                    choices=["memory", "compact", "sortedfile"],
                    help="needle map variant (reference -index flag): "
                         "memory dict, 16B/needle compact arrays, or "
                         "mmap'd sorted file")
     v.add_argument("-jwtKey", default="")
+    v.add_argument("-tlsCert", default="")
+    v.add_argument("-tlsKey", default="")
+    v.add_argument("-tlsCa", default="")
     v.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs allowed to call")
     v.add_argument("-tierConfig", default="",
@@ -442,6 +492,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu"])
     s.add_argument("-jwtKey", default="")
+    s.add_argument("-tlsCert", default="")
+    s.add_argument("-tlsKey", default="")
+    s.add_argument("-tlsCa", default="")
     s.add_argument("-tierConfig", default="")
     s.set_defaults(fn=cmd_server)
 
@@ -466,6 +519,9 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-s3Port", type=int, default=8333)
     f.add_argument("-s3Config", default="")
     f.add_argument("-jwtKey", default="")
+    f.add_argument("-tlsCert", default="")
+    f.add_argument("-tlsKey", default="")
+    f.add_argument("-tlsCa", default="")
     f.add_argument("-encryptVolumeData", action="store_true",
                    help="AES-256-GCM encrypt chunk data; volume servers "
                         "only see ciphertext (reference filer.toml "
@@ -601,6 +657,7 @@ def main(argv=None):
     glog.set_verbosity(args.v)
     if args.vmodule:
         glog.set_vmodule(args.vmodule)
+    _apply_tls_config(args)
     args.fn(args)
 
 

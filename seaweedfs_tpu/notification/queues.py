@@ -113,7 +113,7 @@ class WebhookPublisher(Publisher):
         for attempt in range(self.retries):
             try:
                 http_call("POST", self.url, body, headers,
-                          timeout=self.timeout)
+                          timeout=self.timeout, external=True)
                 return
             except HttpError as e:
                 last = e

@@ -82,16 +82,25 @@ class FilerServer:
         self._stop = threading.Event()
         self._deleter = threading.Thread(target=self._deletion_loop,
                                          daemon=True)
+        import queue as _queue
+        self._notify_queue: "_queue.Queue" = _queue.Queue(maxsize=1024)
+        self._notifier = threading.Thread(target=self._notify_loop,
+                                          daemon=True) \
+            if notify_publisher is not None else None
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self):
         self.server.start()
         self._deleter.start()
+        if self._notifier is not None:
+            self._notifier.start()
         return self
 
     def stop(self):
         self._stop.set()
+        if self._notifier is not None:
+            self._notify_queue.put(None)  # drain sentinel
         self.log_buffer.close()
         self.server.stop()
         self.filer.store.close()
@@ -106,25 +115,37 @@ class FilerServer:
         if self.notify_publisher is not None:
             # external brokers are slow/fallible and the mutation has
             # already committed — dispatch off the write path, never
-            # fail the client (reference filer_notify.go fires into the
-            # broker client's own buffering the same way)
+            # fail the client. Bounded drop-oldest buffer: a dead
+            # endpoint under sustained ingest must not grow an
+            # unbounded backlog of stale events (the durable record is
+            # the metadata event log; this channel is best-effort).
             key = (new or old).full_path
-            self._notify_pool_submit(key, event)
+            try:
+                self._notify_queue.put_nowait((key, event))
+            except __import__("queue").Full:
+                from ..util import glog
+                try:
+                    dropped = self._notify_queue.get_nowait()
+                    glog.V(0).infof("notification buffer full; dropped "
+                                    "event for %s", dropped[0])
+                except Exception:  # noqa: BLE001 - raced a drain
+                    pass
+                try:
+                    self._notify_queue.put_nowait((key, event))
+                except Exception:  # noqa: BLE001 - raced a refill
+                    pass
 
-    def _notify_pool_submit(self, key, event):
-        from concurrent.futures import ThreadPoolExecutor
-        if not hasattr(self, "_notify_pool"):
-            self._notify_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="filer-notify")
-
-        def run():
+    def _notify_loop(self):
+        from ..util import glog
+        while True:
+            item = self._notify_queue.get()
+            if item is None:
+                return
+            key, event = item
             try:
                 self.notify_publisher.send(key, event)
-            except Exception as e:  # noqa: BLE001 - must not kill the pool
-                from ..util import glog
+            except Exception as e:  # noqa: BLE001 - must not kill the loop
                 glog.V(0).infof("notification for %s failed: %s", key, e)
-
-        self._notify_pool.submit(run)
 
     def _deletion_loop(self):
         """Drain the filer's chunk-deletion queue against the cluster

@@ -265,10 +265,60 @@ class Response:
                 src.close()
 
 
+# -- transport security ------------------------------------------------------
+# Reference weed/security/tls.go: optional TLS on every surface. One
+# process-wide configuration (cert/key for servers, CA for clients) so
+# the hundreds of "http://{host}" call sites need no changes: when TLS
+# is on, http_call/http_download upgrade the scheme, and every
+# HttpServer wraps its socket. Single-scheme by design, like the
+# reference's all-or-nothing grpc TLS config.
+_TLS = {"cert": "", "key": "", "ca": "", "client_ctx": None,
+        "server_ctx": None}
+
+
+def configure_tls(cert_file: str = "", key_file: str = "",
+                  ca_file: str = ""):
+    """Enable TLS: servers present cert/key; clients verify against ca
+    (or the cert itself for self-signed deployments). A cert without a
+    key (or vice versa) is refused outright — the half-configured
+    alternative serves plaintext while rewriting outbound URLs to
+    https, which only surfaces as baffling handshake errors later."""
+    import ssl
+    if bool(cert_file) != bool(key_file):
+        raise ValueError("TLS needs BOTH cert and key (got only one); "
+                         "pass just ca for a client-only configuration")
+    _TLS["cert"], _TLS["key"], _TLS["ca"] = cert_file, key_file, ca_file
+    if cert_file and key_file:
+        sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        sctx.load_cert_chain(cert_file, key_file)
+        _TLS["server_ctx"] = sctx
+    cctx = ssl.create_default_context(cafile=ca_file or cert_file or None)
+    cctx.check_hostname = False  # cluster peers are addressed by ip:port
+    _TLS["client_ctx"] = cctx
+
+
+def reset_tls():
+    _TLS.update({"cert": "", "key": "", "ca": "", "client_ctx": None,
+                 "server_ctx": None})
+
+
+def tls_enabled() -> bool:
+    return _TLS["server_ctx"] is not None
+
+
+def _client_url(url: str) -> str:
+    if _TLS["client_ctx"] is not None and url.startswith("http://"):
+        return "https://" + url[len("http://"):]
+    return url
+
+
 class HttpServer:
     def __init__(self, port: int, router: Router, host: str = "127.0.0.1"):
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(router))
         self.httpd.daemon_threads = True
+        if _TLS["server_ctx"] is not None:
+            self.httpd.socket = _TLS["server_ctx"].wrap_socket(
+                self.httpd.socket, server_side=True)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: Optional[threading.Thread] = None
@@ -320,11 +370,21 @@ def parse_range(rng: str, size: int) -> Optional[Tuple[int, int]]:
 # -- client helpers ---------------------------------------------------------
 
 def http_call(method: str, url: str, body: bytes = None,
-              headers: dict = None, timeout: float = 30.0) -> bytes:
+              headers: dict = None, timeout: float = 30.0,
+              external: bool = False) -> bytes:
+    """``external=True`` marks a non-cluster endpoint (webhooks, third
+    parties): the URL keeps its scheme and https uses the default
+    verified context — the cluster TLS rewrite must not break plain-HTTP
+    externals nor weaken hostname checks on real ones."""
+    ctx = None
+    if not external:
+        url = _client_url(url)
+        ctx = _TLS["client_ctx"]
     req = urllib.request.Request(url, data=body, method=method,
                                  headers=headers or {})
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=ctx) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
         detail = e.read().decode("utf-8", "replace")[:500]
@@ -336,9 +396,11 @@ def http_call(method: str, url: str, body: bytes = None,
 def http_download(url: str, path: str, timeout: float = 600.0) -> int:
     """Stream a GET response straight to a file (volume-sized pulls must
     not transit RAM). Returns bytes written."""
+    url = _client_url(url)
     req = urllib.request.Request(url, method="GET")
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp, \
+        with urllib.request.urlopen(req, timeout=timeout,
+                                    context=_TLS["client_ctx"]) as resp, \
                 open(path, "wb") as out:
             total = 0
             while True:

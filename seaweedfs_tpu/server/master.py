@@ -28,7 +28,9 @@ class MasterServer:
                  pulse_seconds: int = 5,
                  garbage_threshold: float = 0.3,
                  jwt_signing_key: str = "",
-                 peers: str = "", raft_dir: str = ""):
+                 peers: str = "", raft_dir: str = "",
+                 maintenance_scripts: str = "",
+                 maintenance_interval: float = 17 * 60):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -54,6 +56,8 @@ class MasterServer:
         router.add("*", "/cluster/volumes", self.cluster_volumes)
         router.add("GET", "/cluster/watch", self.cluster_watch)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/", self.ui_handler)
+        router.add("GET", "/ui", self.ui_handler)
         # volume-location push channel (reference KeepConnected,
         # master_grpc_server.go:180-234): heartbeat deltas and node
         # deaths publish here; clients long-poll /cluster/watch
@@ -69,6 +73,18 @@ class MasterServer:
         self.port = self.server.port
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._stop = threading.Event()
+        # cron'd embedded shell (reference startAdminScripts,
+        # master_server.go:187-253): ';'-separated command lines run
+        # against this master on an interval, leader-only
+        self.maintenance_scripts = [
+            line.strip() for line in maintenance_scripts.split(";")
+            if line.strip()]
+        self.maintenance_interval = float(maintenance_interval)
+        self._maintenance_runs = 0
+        self._maintenance_thread = None
+        if self.maintenance_scripts:
+            self._maintenance_thread = threading.Thread(
+                target=self._maintenance_loop, daemon=True)
 
         # raft HA (reference weed/server/raft_server.go): multi-master
         # when -peers is set; single-master otherwise (no raft at all)
@@ -153,12 +169,21 @@ class MasterServer:
         return Response(MASTER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
+    def ui_handler(self, req: Request):
+        """HTML status dashboard (reference master_ui/templates.go)."""
+        from .http_util import Response
+        from .status_ui import master_status_page
+        return Response(master_status_page(self),
+                        content_type="text/html; charset=utf-8")
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         self.server.start()
         self._pruner.start()
         if self.raft is not None:
             self.raft.start()
+        if self._maintenance_thread is not None:
+            self._maintenance_thread.start()
         return self
 
     def stop(self):
@@ -174,6 +199,23 @@ class MasterServer:
     def _prune_loop(self):
         while not self._stop.wait(self.topology.pulse_seconds):
             self.topology.prune_dead_nodes()
+
+    def _maintenance_loop(self):
+        """Run the configured shell scripts every interval (leader-only,
+        like the reference's masterClient-gated script runner)."""
+        import seaweedfs_tpu.shell  # noqa: F401 (registers commands)
+        from ..shell.command_env import CommandEnv, run_command
+        from ..util import glog
+        while not self._stop.wait(self.maintenance_interval):
+            if not self.is_leader():
+                continue
+            env = CommandEnv(self.url)
+            for line in self.maintenance_scripts:
+                try:
+                    run_command(env, line)
+                except Exception as e:  # noqa: BLE001 - keep the cron alive
+                    glog.V(0).infof("maintenance %r failed: %s", line, e)
+            self._maintenance_runs += 1
 
     # -- handlers ----------------------------------------------------------
     def cluster_heartbeat(self, req: Request):

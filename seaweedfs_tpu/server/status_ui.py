@@ -1,0 +1,85 @@
+"""Minimal HTML status dashboards (reference weed/server/master_ui/ +
+volume_server_ui/ templates). Plain stdlib string templating — these
+pages are operator glances, not apps."""
+
+from __future__ import annotations
+
+import html
+import time
+
+_PAGE = """<!doctype html><html><head><title>{title}</title><style>
+body{{font-family:sans-serif;margin:2em;color:#222}}
+table{{border-collapse:collapse;margin:1em 0}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
+th{{background:#f4f4f4}} h1{{font-size:1.3em}} .muted{{color:#888}}
+</style></head><body><h1>{title}</h1>{body}
+<p class="muted">seaweedfs_tpu &middot; {now}</p></body></html>"""
+
+
+def _table(headers, rows) -> str:
+    head = "".join(f"<th>{html.escape(str(h))}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{html.escape(str(c))}</td>" for c in row)
+        + "</tr>" for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def render_page(title: str, sections) -> bytes:
+    body = ""
+    for heading, headers, rows in sections:
+        body += f"<h2>{html.escape(heading)}</h2>"
+        body += _table(headers, rows)
+    return _PAGE.format(title=html.escape(title), body=body,
+                        now=time.strftime("%Y-%m-%d %H:%M:%S")).encode()
+
+
+def master_status_page(master) -> bytes:
+    topo = master.topology
+    nodes = []
+    with topo.lock:
+        for n in topo.all_nodes():
+            nodes.append((n.url, n.rack.id if n.rack else "",
+                          len(n.volumes), len(n.ec_shards),
+                          n.max_volume_count,
+                          f"{time.time() - n.last_seen:.0f}s ago"))
+        vols = []
+        for node in topo.all_nodes():
+            for vid, vi in sorted(node.volumes.items()):
+                vols.append((vid, vi.collection or "-", node.url,
+                             f"{vi.size / 1e6:.1f} MB",
+                             vi.file_count, vi.delete_count))
+    sections = [
+        ("Cluster", ["leader", "peers", "volume size limit"],
+         [(master.leader_url() or master.url,
+           ", ".join(master.raft.peers) if master.raft else "-",
+           f"{topo.volume_size_limit >> 20} MB")]),
+        ("Volume servers", ["url", "rack", "volumes", "ec shards",
+                            "max", "last heartbeat"], nodes),
+        ("Volumes", ["id", "collection", "server", "size", "files",
+                     "deleted"], vols[:200]),
+    ]
+    return render_page(f"Master {master.url}", sections)
+
+
+def volume_status_page(vs) -> bytes:
+    vols, ecs = [], []
+    for loc in vs.store.locations:
+        with loc.lock:  # mounts/deletes mutate these dicts concurrently
+            for vid, v in sorted(loc.volumes.items()):
+                vols.append((vid, v.collection or "-", loc.directory,
+                             f"{v.size() / 1e6:.1f} MB", v.file_count(),
+                             v.deleted_count(),
+                             "ro" if v.readonly else "rw",
+                             v.index_kind, v.offset_width))
+            for vid, ev in sorted(loc.ec_volumes.items()):
+                ecs.append((vid, ev.collection or "-",
+                            ",".join(map(str, ev.shard_ids()))))
+    sections = [
+        ("Server", ["url", "master", "data center", "rack"],
+         [(vs.url, vs.master_url, vs.store.data_center or "-",
+           vs.store.rack or "-")]),
+        ("Volumes", ["id", "collection", "dir", "size", "files",
+                     "deleted", "mode", "index", "offw"], vols),
+        ("EC volumes", ["id", "collection", "shards"], ecs),
+    ]
+    return render_page(f"Volume server {vs.url}", sections)

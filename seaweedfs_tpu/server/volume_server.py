@@ -34,7 +34,8 @@ class VolumeServer:
                  max_volume_counts=None, pulse_seconds: int = 5,
                  public_url: str = "", read_redirect: bool = True,
                  ec_backend: str = "auto", jwt_signing_key: str = "",
-                 whitelist=(), index_kind: str = "memory"):
+                 whitelist=(), index_kind: str = "memory",
+                 compaction_mbps: int = 0):
         router = Router()
         router.add("*", "/status", self.status)
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
@@ -65,6 +66,7 @@ class VolumeServer:
         router.add("POST", "/admin/volume/tail_receive",
                    self.admin_volume_tail_receive)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/ui", self.ui_handler)
         router.add("POST", "/query", self.query_handler)
         router.set_fallback(self.data_handler)
         router.before = self._guard_check
@@ -98,6 +100,8 @@ class VolumeServer:
             data_center=data_center, rack=rack, codec=codec,
             index_kind=index_kind)
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        # compaction write throttle (reference -compactionMBps)
+        self.compaction_bps = int(compaction_mbps) << 20
         self.jwt_signing_key = jwt_signing_key
         from ..security.guard import Guard
         self.guard = Guard(whitelist)
@@ -250,6 +254,12 @@ class VolumeServer:
             raise HttpError(404, "cookie mismatch")
         return got
 
+    def ui_handler(self, req: Request):
+        """HTML status dashboard (reference volume_server_ui/)."""
+        from .status_ui import volume_status_page
+        return Response(volume_status_page(self),
+                        content_type="text/html; charset=utf-8")
+
     def metrics_handler(self, req: Request):
         """Prometheus text exposition; volume/disk gauges refresh from
         the store on scrape (the reference sets them during heartbeat
@@ -325,7 +335,10 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise HttpError(404, f"volume {vid} not found")
-        v.compact()
+        # per-request override, else the server's configured rate
+        bps = int(req.query.get("bytesPerSecond",
+                                self.compaction_bps) or 0)
+        v.compact(bytes_per_second=bps)
         return {"volume": vid, "compacted": True}
 
     def admin_vacuum_commit(self, req: Request):

@@ -360,13 +360,26 @@ class Volume:
                 offset += actual
 
     # -- vacuum (reference volume_vacuum.go) -------------------------------
-    def compact(self) -> int:
+    def compact(self, bytes_per_second: int = 0) -> int:
         """Copy live needles to .cpd/.cpx. Returns reclaimed byte estimate.
 
         Iterates the needle map (not a raw .dat scan) so garbage records in
         the .dat — e.g. a torn-but-aligned write followed by later appends —
         can never cause live needles to be silently dropped; this matches
-        the reference's Compact2, which copies from the index."""
+        the reference's Compact2, which copies from the index.
+
+        bytes_per_second > 0 throttles the copy (reference
+        compactionBytePerSecond + util.WriteThrottler) so compaction
+        doesn't starve live reads on the same disk."""
+        from ..util.throttler import WriteThrottler
+        throttler = WriteThrottler(bytes_per_second)
+        # snapshot under the lock, then copy WITHOUT it: the lock is
+        # only re-taken per blob read, so live reads/writes interleave
+        # with the (possibly throttled, minutes-long) copy. Anything
+        # that lands after the snapshot is replayed by commit_compact's
+        # makeup_diff — that replay is the whole reason the watermark
+        # exists (holding the lock throughout would make it dead code
+        # and stall the volume for the copy's duration).
         with self.lock:
             prefix = self.file_name()
             cpd, cpx = prefix + ".cpd", prefix + ".cpx"
@@ -377,23 +390,24 @@ class Volume:
                 compaction_revision=(
                     self.super_block.compaction_revision + 1) & 0xFFFF,
                 flags=self.super_block.flags)
-            from .needle_map import entry_to_bytes
             width = self.offset_width
             live = sorted(self.nm.items(), key=lambda kv: kv[1].offset)
-            with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
-                dat_out.write(new_sb.to_bytes())
-                for nid, nv in live:
-                    if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
-                        continue
-                    new_off = dat_out.tell()
-                    dat_out.write(self._read_blob(nv.offset, nv.size))
-                    idx_out.write(entry_to_bytes(nid, new_off, nv.size,
-                                                 width))
-            # remember where the live .idx stood so commit_compact can
-            # replay writes/deletes that land in the window (the
-            # reference's makeupDiff, volume_vacuum.go:181)
             self._compact_idx_watermark = os.path.getsize(self.idx_path)
-            return self.nm.deleted_size
+            deleted_size = self.nm.deleted_size
+        from .needle_map import entry_to_bytes
+        with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
+            dat_out.write(new_sb.to_bytes())
+            for nid, nv in live:
+                if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
+                    continue
+                new_off = dat_out.tell()
+                with self.lock:
+                    blob = self._read_blob(nv.offset, nv.size)
+                dat_out.write(blob)
+                idx_out.write(entry_to_bytes(nid, new_off, nv.size,
+                                             width))
+                throttler.maybe_slowdown(len(blob))
+        return deleted_size
 
     def commit_compact(self):
         with self.lock:

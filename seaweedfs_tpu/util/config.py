@@ -1,0 +1,77 @@
+"""Config-file loading with search path + env override tiers.
+
+Reference weed/util/config.go: viper loads <name>.toml from ".",
+"$HOME/.seaweedfs", "/etc/seaweedfs", and every key is overridable via
+WEED_<SECTION>_<KEY> environment variables
+(reference command/scaffold.go:15-25). Here: <name>.toml (stdlib
+tomllib) or <name>.json from the same three-tier search path, flattened
+to dotted keys, then WEED_* env vars override — e.g.
+
+    WEED_JWT_SIGNING_KEY=secret    ->  cfg["jwt.signing.key"]
+
+(env words map to dotted segments, lowercase, like viper's replacer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs_tpu"),
+               "/etc/seaweedfs_tpu"]
+ENV_PREFIX = "WEED_"
+
+
+def _flatten(d: dict, prefix: str = "") -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}".lower()
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def find_config_file(name: str,
+                     dirs: Optional[List[str]] = None) -> Optional[str]:
+    for d in dirs or SEARCH_DIRS:
+        for ext in (".toml", ".json"):
+            p = os.path.join(d, name + ext)
+            if os.path.isfile(p):
+                return p
+    return None
+
+
+def load_config(name: str, dirs: Optional[List[str]] = None,
+                env: Optional[dict] = None) -> Dict[str, object]:
+    """Flattened dotted-key config for <name>, {} when no file exists;
+    WEED_* env vars always apply on top (a config can be pure env)."""
+    cfg: Dict[str, object] = {}
+    path = find_config_file(name, dirs)
+    if path is not None:
+        if path.endswith(".toml"):
+            import tomllib
+            with open(path, "rb") as f:
+                cfg = _flatten(tomllib.load(f))
+        else:
+            with open(path) as f:
+                cfg = _flatten(json.load(f))
+    environ = os.environ if env is None else env
+    for k, v in environ.items():
+        if k.startswith(ENV_PREFIX):
+            dotted = k[len(ENV_PREFIX):].lower().replace("_", ".")
+            cfg[dotted] = v
+    return cfg
+
+
+def config_get(cfg: Dict[str, object], key: str, default=None):
+    """Dotted lookup with underscore tolerance (env vars can't carry
+    dots, so WEED_SECURITY_JWT_KEY and [security] jwt_key in TOML must
+    land on the same value)."""
+    key = key.lower()
+    if key in cfg:
+        return cfg[key]
+    alt = key.replace("_", ".")
+    return cfg.get(alt, default)

@@ -1,0 +1,39 @@
+"""Write throttler (reference weed/util/throttler.go).
+
+Vacuum/compaction copies gigabytes right next to live reads; the
+reference rate-limits those writes with a bytes-per-second budget
+(compactionBytePerSecond, weed/storage/volume_vacuum.go:37). Same
+shape here: feed `maybe_slowdown(n)` after each write and it sleeps
+whenever the running budget goes negative. 0 = unthrottled.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class WriteThrottler:
+    WINDOW = 0.1  # budget granularity, seconds
+
+    def __init__(self, bytes_per_second: int = 0):
+        self.bps = int(bytes_per_second)
+        self._budget = self.bps * self.WINDOW
+        self._last = time.monotonic()
+
+    def maybe_slowdown(self, n: int):
+        if self.bps <= 0:
+            return
+        self._budget -= n
+        if self._budget >= 0:
+            return
+        # refill from elapsed time; sleep off any remaining debt
+        now = time.monotonic()
+        self._budget += (now - self._last) * self.bps
+        self._last = now
+        if self._budget < 0:
+            debt = -self._budget / self.bps
+            time.sleep(min(debt, 2.0))
+            # the sleep itself must not count as refill time on the
+            # next call (that would halve the effective throttle)
+            self._last = time.monotonic()
+            self._budget = 0.0

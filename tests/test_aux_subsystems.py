@@ -1,0 +1,151 @@
+"""Round-3 auxiliary subsystems: write throttler, config tiers, TLS,
+master maintenance cron, status UIs (VERDICT r2 missing #7/#8/#9/#10 +
+§5.6)."""
+
+import os
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.server.http_util import (HttpServer, Request, Router,
+                                            configure_tls, get_json,
+                                            http_call, reset_tls)
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.util.config import config_get, load_config
+from seaweedfs_tpu.util.throttler import WriteThrottler
+
+
+# -- throttler ---------------------------------------------------------------
+
+def test_throttler_limits_rate():
+    t = WriteThrottler(bytes_per_second=1 << 20)  # 1 MB/s
+    start = time.monotonic()
+    for _ in range(6):
+        t.maybe_slowdown(256 << 10)  # 1.5MB total
+    elapsed = time.monotonic() - start
+    assert elapsed >= 0.8  # ~1.4s of debt after the first window
+
+    free = WriteThrottler(0)
+    start = time.monotonic()
+    for _ in range(100):
+        free.maybe_slowdown(10 << 20)
+    assert time.monotonic() - start < 0.1  # unthrottled = no sleeps
+
+
+def test_throttled_compaction(tmp_path):
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    v = Volume(str(tmp_path), "", 1, create=True)
+    rng = np.random.default_rng(0)
+    for i in range(1, 9):
+        v.write_needle(Needle(id=i, cookie=1, data=rng.integers(
+            0, 256, 128 << 10).astype(np.uint8).tobytes()))
+    t0 = time.monotonic()
+    v.compact(bytes_per_second=1 << 20)  # ~1MB of live data at 1MB/s
+    throttled = time.monotonic() - t0
+    v.commit_compact()
+    assert throttled >= 0.5
+    for i in range(1, 9):
+        assert v.read_needle(Needle(id=i, cookie=1)).size > 0
+    v.close()
+
+
+# -- config tiers ------------------------------------------------------------
+
+def test_config_search_path_and_env_override(tmp_path):
+    (tmp_path / "security.toml").write_text(
+        '[jwt.signing]\nkey = "from-file"\n[https]\ncert = "/c.pem"\n')
+    cfg = load_config("security", dirs=[str(tmp_path)], env={})
+    assert config_get(cfg, "jwt.signing.key") == "from-file"
+    assert config_get(cfg, "https.cert") == "/c.pem"
+    # WEED_* env overrides the file (reference scaffold.go env tiers)
+    cfg = load_config("security", dirs=[str(tmp_path)],
+                      env={"WEED_JWT_SIGNING_KEY": "from-env"})
+    assert config_get(cfg, "jwt.signing.key") == "from-env"
+    # underscore/dot tolerance
+    assert config_get(cfg, "jwt_signing_key") == "from-env"
+    # no file at all: pure-env configs still work
+    cfg = load_config("nope", dirs=[str(tmp_path)],
+                      env={"WEED_HTTPS_CA": "/ca.pem"})
+    assert config_get(cfg, "https.ca") == "/ca.pem"
+
+
+# -- TLS ---------------------------------------------------------------------
+
+def _make_cert(tmp_path):
+    cert, key = str(tmp_path / "c.pem"), str(tmp_path / "k.pem")
+    out = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1", "-subj",
+         "/CN=127.0.0.1"], capture_output=True)
+    if out.returncode != 0:
+        pytest.skip(f"openssl unavailable: {out.stderr[:100]}")
+    return cert, key
+
+
+def test_tls_end_to_end(tmp_path):
+    cert, key = _make_cert(tmp_path)
+    router = Router()
+    router.add("GET", "/ping", lambda req: {"pong": True})
+    try:
+        configure_tls(cert, key)
+        srv = HttpServer(0, router, "127.0.0.1")
+        srv.start()
+        # plain-looking URL transparently upgrades to https and verifies
+        out = get_json(f"http://127.0.0.1:{srv.port}/ping")
+        assert out == {"pong": True}
+        srv.stop()
+    finally:
+        reset_tls()
+    # after reset, plaintext servers work again
+    srv2 = HttpServer(0, router, "127.0.0.1")
+    srv2.start()
+    assert get_json(f"http://127.0.0.1:{srv2.port}/ping") == {"pong": True}
+    srv2.stop()
+
+
+# -- maintenance cron --------------------------------------------------------
+
+def test_master_maintenance_scripts_run():
+    from seaweedfs_tpu.shell.command_env import command
+
+    runs = []
+
+    @command("test.maintenance.probe", "test-only")
+    def probe(env, args):  # noqa: ARG001
+        runs.append(time.time())
+
+    master = MasterServer(port=0, maintenance_scripts=
+                          "test.maintenance.probe",
+                          maintenance_interval=0.2).start()
+    try:
+        deadline = time.monotonic() + 5
+        while not runs and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert runs, "maintenance script never ran"
+        assert master._maintenance_runs >= 1
+    finally:
+        master.stop()
+
+
+# -- status UIs --------------------------------------------------------------
+
+def test_status_pages_render(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      ec_backend="numpy").start()
+    try:
+        from seaweedfs_tpu.client import operation as op
+        a = op.assign(master.url)
+        op.upload(a["url"], a["fid"], b"ui-bytes" * 10, filename="u.bin")
+        page = http_call("GET", f"http://{master.url}/").decode()
+        assert "Volume servers" in page and vs.url in page
+        vpage = http_call("GET", f"http://{vs.url}/ui").decode()
+        assert "Volumes" in vpage and "rw" in vpage
+    finally:
+        vs.stop()
+        master.stop()
