@@ -21,6 +21,11 @@ Env knobs: SW_BENCH_DAT_MB (volume size, default 4096),
 SW_BENCH_SLAB_MB (device slab per shard row, default 8),
 SW_BENCH_TRIALS (best-of trials per timed pass, default 2),
 SW_BENCH_INIT_TIMEOUT (default 180s), SW_BENCH_DIR (workdir).
+BASELINE configs 3-5 scale via SW_BENCH_GEO_MB (RS(6,3)/RS(20,4)
+volume size, default 256), SW_BENCH_SMALL_VOLS/SW_BENCH_SMALL_NEEDLES
+(batched 4KB-needle volumes, default 4 x 8192), SW_BENCH_CLUSTER_MB/
+SW_BENCH_CLUSTER_SERVERS/SW_BENCH_CLUSTER_BACKEND (live-cluster
+ec.rebuild, default 256MB over 4 servers, native compute).
 """
 
 import hashlib
@@ -297,12 +302,213 @@ def measure_device_resident(slab_mb: int, iters: int = 8):
     return med, best, thr
 
 
+def measure_geometries(device_ok: bool, size_mb: int, slab_mb: int) -> dict:
+    """BASELINE config 4: RS(6,3) and RS(20,4) — correctness is pinned by
+    tests/test_rs_codec.py; this measures MB/s on the native backend
+    (e2e encode of a real .dat) and, when the device is reachable, the
+    device-resident in-memory rate (the tunnel e2e is characterized once
+    by the headline RS(10,4) run; repeating it per geometry would just
+    re-measure the link)."""
+    import shutil as _shutil
+    from seaweedfs_tpu.ec import write_ec_files
+    from seaweedfs_tpu.ops.codec import get_codec
+    out = {}
+    for k, m in ((6, 3), (20, 4)):
+        gdir = tempfile.mkdtemp(prefix=f"swgeo_{k}_{m}_")
+        base = os.path.join(gdir, "1")
+        try:
+            size = generate_dat(base + ".dat", size_mb)
+            codec = get_codec(k, m, backend="native"
+                              if ensure_native() else "numpy")
+            t = time.perf_counter()
+            write_ec_files(base, codec=codec, slab=1 << 20,
+                           pipelined=False)
+            native_mbps = size / (time.perf_counter() - t) / 1e6
+            entry = {"native_e2e_mbps": round(native_mbps)}
+            if device_ok:
+                try:
+                    import jax.numpy as jnp
+                    from seaweedfs_tpu.ops.rs_tpu import make_encode_fn
+                    n = slab_mb << 20
+                    fn, bitmat = make_encode_fn(k, m, n)
+                    bm = jnp.asarray(bitmat)
+                    rng = np.random.default_rng(3)
+                    bufs = [jnp.asarray(rng.integers(
+                        0, 256, (k, n), dtype=np.uint8))
+                        for _ in range(2)]
+                    fn(bm, bufs[0]).block_until_ready()  # compile
+                    times = []
+                    for i in range(4):
+                        t = time.perf_counter()
+                        fn(bm, bufs[i % 2]).block_until_ready()
+                        times.append(time.perf_counter() - t)
+                    entry["device_resident_mbps"] = round(
+                        (k * n) / min(times) / 1e6)
+                except Exception as e:  # noqa: BLE001 - device flaky
+                    log(f"rs({k},{m}) device measurement failed: {e!r}")
+            out[f"rs_{k}_{m}"] = entry
+            log(f"rs({k},{m}) on {size_mb}MB: {entry}")
+        finally:
+            _shutil.rmtree(gdir, ignore_errors=True)
+    return out
+
+
+def measure_batched_small_needles(n_volumes: int = 4,
+                                  needles_per_volume: int = 8192) -> dict:
+    """BASELINE config 3 (scaled): volumes full of 4KB needles encoded
+    through the coalesced-batch streaming path. The full 1M x 4KB x 32
+    volumes run is the same code at bigger constants (env-scalable via
+    SW_BENCH_SMALL_VOLS / SW_BENCH_SMALL_NEEDLES)."""
+    import shutil as _shutil
+    from seaweedfs_tpu.ec import write_ec_files
+    from seaweedfs_tpu.ops.codec import get_codec
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    workdir = tempfile.mkdtemp(prefix="swsmall_")
+    try:
+        rng = np.random.default_rng(9)
+        payload = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        total_bytes = 0
+        t_build = time.perf_counter()
+        for vi in range(n_volumes):
+            v = Volume(workdir, "", vi + 1, create=True)
+            for i in range(1, needles_per_volume + 1):
+                v.write_needle(Needle(id=i, cookie=1, data=payload))
+            total_bytes += v.size()
+            v.close()
+        build_s = time.perf_counter() - t_build
+        codec = get_codec(K, M, backend="native"
+                          if ensure_native() else "numpy")
+        t = time.perf_counter()
+        for vi in range(n_volumes):
+            write_ec_files(os.path.join(workdir, str(vi + 1)),
+                           codec=codec, slab=1 << 20, pipelined=False)
+        dt = time.perf_counter() - t
+        mbps = total_bytes / dt / 1e6
+        log(f"batched small-needle encode: {n_volumes} volumes x "
+            f"{needles_per_volume} x 4KB = {total_bytes / 1e6:.0f} MB, "
+            f"{mbps:.0f} MB/s (write {build_s:.1f}s, encode {dt:.1f}s)")
+        return {"volumes": n_volumes, "needles_per_volume":
+                needles_per_volume, "total_mb": round(total_bytes / 1e6),
+                "encode_mbps": round(mbps)}
+    finally:
+        _shutil.rmtree(workdir, ignore_errors=True)
+
+
+def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4) -> dict:
+    """BASELINE config 5 (scaled): EC volume spread over a live cluster,
+    shards on one server destroyed, rebuilt on another — survivor
+    shard-pulls (parallel HTTP) and the GF rebuild timed separately.
+    Backend for the rebuild compute: SW_BENCH_CLUSTER_BACKEND
+    (default native — the tunnel makes per-shard device round-trips the
+    wall; on a real-host TPU deployment set it to tpu)."""
+    import shutil as _shutil
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import get_json, post_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    backend = os.environ.get("SW_BENCH_CLUSTER_BACKEND", "native")
+    workdir = tempfile.mkdtemp(prefix="swcluster_")
+    master = MasterServer(port=0, volume_size_limit_mb=size_mb * 2,
+                          pulse_seconds=1).start()
+    servers = []
+    try:
+        for i in range(n_servers):
+            servers.append(VolumeServer(
+                port=0, directories=[os.path.join(workdir, f"v{i}")],
+                master_url=master.url, pulse_seconds=1,
+                max_volume_counts=[10], ec_backend=backend).start())
+        # one volume filled with data
+        a = op.assign(master.url, collection="bench")
+        vid = int(a["fid"].split(",")[0])
+        rng = np.random.default_rng(4)
+        chunk = rng.integers(0, 256, 4 << 20, dtype=np.uint8).tobytes()
+        written = 0
+        i = 0
+        while written < (size_mb << 20):
+            i += 1
+            op.upload(a["url"], f"{vid},{i:x}00000001", chunk,
+                      filename=f"b{i}")
+            written += len(chunk)
+        # encode + spread via the shell orchestration
+        import seaweedfs_tpu.shell  # noqa: F401
+        from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
+        env = CommandEnv(master.url)
+        t_encode = time.perf_counter()
+        run_command(env, f"ec.encode -volumeId {vid}")
+        encode_s = time.perf_counter() - t_encode
+        time.sleep(1.5)  # shard ownership reaches the master via pulse
+        # destroy every shard on one holder
+        ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                      f"?volumeId={vid}")
+        by_holder = {}
+        for sid, urls in ec["shards"].items():
+            for u in urls:
+                by_holder.setdefault(u, []).append(int(sid))
+        victim, lost = max(by_holder.items(), key=lambda kv: len(kv[1]))
+        post_json(f"http://{victim}/admin/ec/unmount?volume={vid}"
+                  f"&shards={','.join(map(str, sorted(lost)))}")
+        post_json(f"http://{victim}/admin/ec/delete_shards?volume={vid}"
+                  f"&collection=bench"
+                  f"&shards={','.join(map(str, sorted(lost)))}")
+        time.sleep(1.5)
+        # rebuild (shell picks the rebuilder, pulls survivors in
+        # parallel, runs the GF rebuild)
+        t_rebuild = time.perf_counter()
+        run_command(env, "ec.rebuild -collection bench")
+        rebuild_s = time.perf_counter() - t_rebuild
+        ec2 = get_json(f"http://{master.url}/cluster/ec_lookup"
+                       f"?volumeId={vid}")
+        have = {int(s) for s in ec2["shards"]}
+        ok = have == set(range(TOTAL))
+        out = {"servers": n_servers, "volume_mb": size_mb,
+               "backend": backend, "lost_shards": len(lost),
+               "encode_spread_s": round(encode_s, 1),
+               "rebuild_wall_s": round(rebuild_s, 1),
+               "rebuild_mbps_volume_bytes": round(
+                   (size_mb << 20) / rebuild_s / 1e6),
+               "all_shards_restored": ok}
+        log(f"cluster rebuild: {out}")
+        return out
+    finally:
+        for vs in servers:
+            vs.stop()
+        master.stop()
+        _shutil.rmtree(workdir, ignore_errors=True)
+
+
 def emit(value: float, vs_baseline: float, **extras):
     line = {"metric": "ec_encode_e2e_rs10_4_mbps",
             "value": round(value, 1), "unit": "MB/s",
             "vs_baseline": round(vs_baseline, 2)}
     line.update(extras)
     print(json.dumps(line))
+
+
+def secondary_configs(device_ok: bool, slab_mb: int) -> dict:
+    """BASELINE configs 3-5, each scaled by env and individually
+    fault-isolated (they report alongside the headline, never instead
+    of it)."""
+    extras = {}
+    try:
+        extras["rs_geometries"] = measure_geometries(
+            device_ok, int(os.environ.get("SW_BENCH_GEO_MB", "256")),
+            slab_mb)
+    except Exception as e:  # noqa: BLE001 - secondary
+        log(f"geometry bench failed: {e!r}")
+    try:
+        extras["batched_small_needles"] = measure_batched_small_needles(
+            int(os.environ.get("SW_BENCH_SMALL_VOLS", "4")),
+            int(os.environ.get("SW_BENCH_SMALL_NEEDLES", "8192")))
+    except Exception as e:  # noqa: BLE001 - secondary
+        log(f"small-needle bench failed: {e!r}")
+    try:
+        extras["cluster_rebuild"] = measure_cluster_rebuild(
+            int(os.environ.get("SW_BENCH_CLUSTER_MB", "256")),
+            int(os.environ.get("SW_BENCH_CLUSTER_SERVERS", "4")))
+    except Exception as e:  # noqa: BLE001 - secondary
+        log(f"cluster rebuild bench failed: {e!r}")
+    return extras
 
 
 def main():
@@ -322,7 +528,7 @@ def main():
 
         devices = init_device(init_timeout)
         if devices is None:
-            emit(cpu_mbps, 1.0)
+            emit(cpu_mbps, 1.0, **secondary_configs(False, slab_mb))
             return
         log(f"devices: {devices}")
         try:
@@ -330,7 +536,7 @@ def main():
             tpu_mbps, stages = measure_tpu_e2e(base, dat_size, slab_mb)
         except Exception as e:  # noqa: BLE001 - tunnel flakiness: fall back
             log(f"tpu bench failed: {e!r}")
-            emit(cpu_mbps, 1.0)
+            emit(cpu_mbps, 1.0, **secondary_configs(False, slab_mb))
             return
         # correctness failures must NOT fall back to a healthy-looking
         # line: a digest mismatch is data corruption and fails the bench
@@ -353,6 +559,7 @@ def main():
                 extras["device_vs_cpu_inmem"] = round(thr / cpu_inmem, 1)
         except Exception as e:  # noqa: BLE001 - secondary metric only
             log(f"device-resident measurement failed: {e!r}")
+        extras.update(secondary_configs(True, slab_mb))
         emit(tpu_mbps, tpu_mbps / cpu_mbps, **extras)
     finally:
         if not os.environ.get("SW_BENCH_KEEP"):

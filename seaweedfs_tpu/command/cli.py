@@ -13,13 +13,22 @@ import sys
 import time
 
 
+def _security_cfg(args):
+    """security.toml/json + WEED_* env, loaded once per process and
+    memoized on args (reference three-tier config, util/config.go +
+    scaffold.go)."""
+    if not hasattr(args, "_security_cfg_cache"):
+        from ..util.config import load_config
+        args._security_cfg_cache = load_config("security")
+    return args._security_cfg_cache
+
+
 def _apply_security_config(args):
-    """Flag -> security.toml/json -> WEED_* env fallback for the JWT key
-    (reference three-tier config, util/config.go + scaffold.go)."""
-    from ..util.config import config_get, load_config
-    cfg = load_config("security")
+    """Flag -> config -> env fallback for the JWT key."""
+    from ..util.config import config_get
     if not getattr(args, "jwtKey", ""):
-        args.jwtKey = config_get(cfg, "jwt.signing.key", "") or ""
+        args.jwtKey = config_get(_security_cfg(args),
+                                 "jwt.signing.key", "") or ""
 
 
 def _apply_tls_config(args):
@@ -27,8 +36,8 @@ def _apply_tls_config(args):
     command: servers present cert/key, and pure clients (upload,
     download, shell, benchmark) still need the client context to reach
     a TLS cluster."""
-    from ..util.config import config_get, load_config
-    cfg = load_config("security")
+    from ..util.config import config_get
+    cfg = _security_cfg(args)
     cert = getattr(args, "tlsCert", "") or \
         config_get(cfg, "https.cert", "") or ""
     key = getattr(args, "tlsKey", "") or \

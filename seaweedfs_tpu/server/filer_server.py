@@ -14,6 +14,7 @@ Reference weed/server/filer_server*.go:
 from __future__ import annotations
 
 import posixpath
+import queue
 import threading
 import time
 from typing import Optional
@@ -82,8 +83,7 @@ class FilerServer:
         self._stop = threading.Event()
         self._deleter = threading.Thread(target=self._deletion_loop,
                                          daemon=True)
-        import queue as _queue
-        self._notify_queue: "_queue.Queue" = _queue.Queue(maxsize=1024)
+        self._notify_queue: queue.Queue = queue.Queue(maxsize=1024)
         self._notifier = threading.Thread(target=self._notify_loop,
                                           daemon=True) \
             if notify_publisher is not None else None
@@ -100,7 +100,17 @@ class FilerServer:
     def stop(self):
         self._stop.set()
         if self._notifier is not None:
-            self._notify_queue.put(None)  # drain sentinel
+            try:
+                self._notify_queue.put_nowait(None)  # drain sentinel
+            except queue.Full:
+                try:  # make room: shutdown outranks a pending event
+                    self._notify_queue.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    self._notify_queue.put_nowait(None)
+                except queue.Full:
+                    pass  # notifier is daemon; process exit reaps it
         self.log_buffer.close()
         self.server.stop()
         self.filer.store.close()
@@ -122,18 +132,25 @@ class FilerServer:
             key = (new or old).full_path
             try:
                 self._notify_queue.put_nowait((key, event))
-            except __import__("queue").Full:
+            except queue.Full:
                 from ..util import glog
                 try:
                     dropped = self._notify_queue.get_nowait()
+                except queue.Empty:  # raced a drain
+                    dropped = None
+                if dropped is None and self._stop.is_set():
+                    # popped the shutdown sentinel: put it back, the
+                    # notifier must still exit
+                    self._notify_queue.put_nowait(None)
+                    return
+                if dropped is not None:
                     glog.V(0).infof("notification buffer full; dropped "
                                     "event for %s", dropped[0])
-                except Exception:  # noqa: BLE001 - raced a drain
-                    pass
                 try:
                     self._notify_queue.put_nowait((key, event))
-                except Exception:  # noqa: BLE001 - raced a refill
-                    pass
+                except queue.Full:  # raced a refill: drop the new event
+                    glog.V(0).infof("notification buffer full; dropped "
+                                    "event for %s", key)
 
     def _notify_loop(self):
         from ..util import glog

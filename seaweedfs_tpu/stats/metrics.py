@@ -214,11 +214,14 @@ def start_push_loop(registry: Registry, gateway_url: str,
     def loop():
         while not stop.wait(interval_s):
             try:
+                # external endpoint: exempt from the cluster TLS URL
+                # rewrite (a plain-HTTP pushgateway must stay reachable
+                # when the cluster itself runs TLS)
                 http_call(
                     "POST",
                     f"{gateway_url.rstrip('/')}/metrics/job/{job}",
                     registry.render().encode(),
-                    {"Content-Type": "text/plain"})
+                    {"Content-Type": "text/plain"}, external=True)
             except HttpError:
                 pass
 

@@ -381,6 +381,12 @@ class Volume:
         # exists (holding the lock throughout would make it dead code
         # and stall the volume for the copy's duration).
         with self.lock:
+            # exactly one compaction at a time: two copiers would
+            # interleave writes into the same .cpd and commit garbage
+            if getattr(self, "_compacting", False):
+                raise VolumeError(
+                    f"volume {self.id}: compaction already in progress")
+            self._compacting = True
             prefix = self.file_name()
             cpd, cpx = prefix + ".cpd", prefix + ".cpx"
             new_sb = SuperBlock(
@@ -395,18 +401,21 @@ class Volume:
             self._compact_idx_watermark = os.path.getsize(self.idx_path)
             deleted_size = self.nm.deleted_size
         from .needle_map import entry_to_bytes
-        with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
-            dat_out.write(new_sb.to_bytes())
-            for nid, nv in live:
-                if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
-                    continue
-                new_off = dat_out.tell()
-                with self.lock:
-                    blob = self._read_blob(nv.offset, nv.size)
-                dat_out.write(blob)
-                idx_out.write(entry_to_bytes(nid, new_off, nv.size,
-                                             width))
-                throttler.maybe_slowdown(len(blob))
+        try:
+            with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
+                dat_out.write(new_sb.to_bytes())
+                for nid, nv in live:
+                    if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
+                        continue
+                    new_off = dat_out.tell()
+                    with self.lock:
+                        blob = self._read_blob(nv.offset, nv.size)
+                    dat_out.write(blob)
+                    idx_out.write(entry_to_bytes(nid, new_off, nv.size,
+                                                 width))
+                    throttler.maybe_slowdown(len(blob))
+        finally:
+            self._compacting = False
         return deleted_size
 
     def commit_compact(self):

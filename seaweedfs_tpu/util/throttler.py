@@ -32,8 +32,11 @@ class WriteThrottler:
         self._last = now
         if self._budget < 0:
             debt = -self._budget / self.bps
-            time.sleep(min(debt, 2.0))
+            slept = min(debt, 2.0)
+            time.sleep(slept)
             # the sleep itself must not count as refill time on the
-            # next call (that would halve the effective throttle)
+            # next call (that would halve the effective throttle), and
+            # debt beyond the 2s cap CARRIES — forgiving it would let a
+            # stream of large blobs run at a multiple of the limit
             self._last = time.monotonic()
-            self._budget = 0.0
+            self._budget += slept * self.bps
