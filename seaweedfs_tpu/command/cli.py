@@ -652,7 +652,7 @@ def build_parser() -> argparse.ArgumentParser:
     sc = sub.add_parser("scaffold", help="print example config files")
     sc.add_argument("-config", default="replication",
                     choices=["tier", "s3", "replication", "security",
-                             "notification"])
+                             "notification", "filer"])
     sc.set_defaults(fn=cmd_scaffold)
 
     ver = sub.add_parser("version", help="print version")

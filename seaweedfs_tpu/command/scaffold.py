@@ -66,18 +66,40 @@ SCAFFOLDS = {
 }
 """,
     "security": """\
-// security knobs (flags, not a file — listed here for discovery):
-//   -jwtKey <secret>     master/volume/filer: JWT-protected writes
-//                        (reference security.toml jwt.signing.key)
-//   -whiteList <cidrs>   volume server: IP allowlist
-//                        (reference guard white_list)
+// security.toml — searched in ., ~/.seaweedfs_tpu, /etc/seaweedfs_tpu
+// (reference util/config.go tiers); every key also overridable via
+// WEED_* env vars, e.g. WEED_JWT_SIGNING_KEY=secret.
+// Equivalent flags: -jwtKey, -tlsCert/-tlsKey/-tlsCa, -whiteList.
+//
+//   [jwt.signing]
+//   key = "write-token-secret"      # JWT-protected writes
+//
+//   [https]                         # TLS on every surface
+//   cert = "/etc/seaweedfs_tpu/cluster.crt"
+//   key  = "/etc/seaweedfs_tpu/cluster.key"
+//   ca   = "/etc/seaweedfs_tpu/ca.crt"
 {}
 """,
     "notification": """\
 // filer notification publisher (reference notification.toml):
 // configured programmatically via
 // seaweedfs_tpu.notification.make_publisher(name, **options);
-// built-ins: "log", "memory" (kafka/sqs/pubsub are gated stubs)
+// built-ins:
+//   "log"      print events
+//   "memory"   in-process pub-sub (tests/replicator)
+//   "webhook"  POST JSON to any HTTP endpoint, options:
+//              url, timeout, retries, hmac_key (X-Seaweed-Signature)
+//   kafka/sqs/pubsub remain gated stubs (no broker SDKs here)
+{}
+""",
+    "filer": """\
+// filer store selection (reference filer.toml):
+//   -store memory                     volatile, tests
+//   -store sqlite  -db ./filer.db     single-file embedded store
+//   -store sharded -db ./filer_meta \\
+//          -storeShards 8             leveldb2-style sharded store:
+//                                     md5(dir) routes to one of N
+//                                     sqlite shards; count is sticky
 {}
 """,
 }

@@ -41,6 +41,9 @@ class VolumeServer:
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
         router.add("POST", "/admin/delete_volume", self.admin_delete_volume)
         router.add("POST", "/admin/volume/readonly", self.admin_readonly)
+        router.add("POST", "/admin/volume/mount", self.admin_volume_mount)
+        router.add("POST", "/admin/volume/unmount",
+                   self.admin_volume_unmount)
         router.add("POST", "/admin/vacuum/check", self.admin_vacuum_check)
         router.add("POST", "/admin/vacuum/compact", self.admin_vacuum_compact)
         router.add("POST", "/admin/vacuum/commit", self.admin_vacuum_commit)
@@ -322,6 +325,28 @@ class VolumeServer:
         if not self.store.mark_volume_readonly(vid, readonly):
             raise HttpError(404, f"volume {vid} not found")
         return {"volume": vid, "readonly": readonly}
+
+    def admin_volume_mount(self, req: Request):
+        """Load an on-disk volume into serving (reference
+        volume_grpc_admin.go VolumeMount)."""
+        vid = int(req.query["volume"])
+        if self.store.find_volume(vid) is not None:
+            return {"volume": vid, "mounted": False}  # already serving
+        for loc in self.store.locations:
+            if loc.load_volume(vid) is not None:
+                self.heartbeat_once()
+                return {"volume": vid, "mounted": True}
+        raise HttpError(404, f"volume {vid} files not found")
+
+    def admin_volume_unmount(self, req: Request):
+        """Stop serving a volume without deleting its files (reference
+        VolumeUnmount)."""
+        vid = int(req.query["volume"])
+        for loc in self.store.locations:
+            if loc.unload_volume(vid):
+                self.heartbeat_once()
+                return {"volume": vid, "unmounted": True}
+        raise HttpError(404, f"volume {vid} not mounted")
 
     def admin_vacuum_check(self, req: Request):
         vid = int(req.query["volume"])

@@ -9,4 +9,5 @@ from .command_env import CommandEnv, COMMANDS, command  # noqa: F401
 from . import command_volume  # noqa: F401  (registers volume.* commands)
 from . import command_ec  # noqa: F401  (registers ec.* commands)
 from . import command_fs  # noqa: F401  (registers fs.* commands)
+from . import command_bucket  # noqa: F401  (registers bucket.* commands)
 from . import command_collection  # noqa: F401

@@ -1,0 +1,45 @@
+"""bucket.* shell commands (reference weed/shell/command_bucket_*.go).
+
+Buckets are directories under the filer's buckets folder
+(reference filer_buckets.go); these commands ride FilerClient's bucket
+API — the same surface the S3 gateway uses.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .command_env import CommandEnv, command, parse_flags
+
+
+@command("bucket.list", ": list buckets")
+def bucket_list(env: CommandEnv, args: List[str]):
+    entries = env.filer().list_buckets()
+    if not entries:
+        env.write("no buckets")
+        return
+    for e in entries:
+        env.write(e.name)
+
+
+@command("bucket.create",
+         "-name <bucket> [-collection <c>] : create a bucket")
+def bucket_create(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    name = flags.get("name")
+    if not name:
+        env.write("usage: bucket.create -name <bucket>")
+        return
+    env.filer().create_bucket(name, collection=flags.get("collection", ""))
+    env.write(f"created bucket {name}")
+
+
+@command("bucket.delete", "-name <bucket> : delete a bucket recursively")
+def bucket_delete(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    name = flags.get("name")
+    if not name:
+        env.write("usage: bucket.delete -name <bucket>")
+        return
+    env.filer().delete_bucket(name)
+    env.write(f"deleted bucket {name}")

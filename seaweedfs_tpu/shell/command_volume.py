@@ -237,3 +237,24 @@ def volume_tier_download(env: CommandEnv, args: List[str]):
                   f"({out['size']} bytes)")
     if not brought:
         env.write(f"volume {vid}: no replica is tiered")
+
+
+@command("volume.mount",
+         "-volumeId <id> -node <url> : serve an on-disk volume")
+def volume_mount(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    out = env.node_post(
+        flags["node"],
+        f"/admin/volume/mount?volume={flags['volumeId']}")
+    env.write(f"volume {flags['volumeId']}: mounted={out.get('mounted')}")
+
+
+@command("volume.unmount",
+         "-volumeId <id> -node <url> : stop serving (files stay on disk)")
+def volume_unmount(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    out = env.node_post(
+        flags["node"],
+        f"/admin/volume/unmount?volume={flags['volumeId']}")
+    env.write(f"volume {flags['volumeId']}: "
+              f"unmounted={out.get('unmounted')}")

@@ -81,6 +81,31 @@ class DiskLocation:
                     continue
 
     # -- volume management -------------------------------------------------
+    def load_volume(self, vid: int) -> Optional[Volume]:
+        """Mount one on-disk volume by id, whatever collection prefixes
+        its files — the boot scan's matching and .dat/.vif guard, for a
+        single id, entirely under the location lock (so a concurrent
+        mount can't double-open and leak the first handle set). Returns
+        the (possibly already-mounted) Volume, or None when no loadable
+        files exist."""
+        with self.lock:
+            existing = self.volumes.get(vid)
+            if existing is not None:
+                return existing
+            for fname in sorted(os.listdir(self.directory)):
+                m = _VOL_RE.match(fname)
+                if not m or int(m.group("vid")) != vid:
+                    continue
+                base = os.path.join(self.directory, fname[: -len(".idx")])
+                if not os.path.exists(base + ".dat") and \
+                        not os.path.exists(base + ".vif"):
+                    continue  # orphaned .idx: same quarantine as boot
+                v = Volume(self.directory, m.group("collection") or "",
+                           vid, index_kind=self.index_kind)
+                self.volumes[vid] = v
+                return v
+            return None
+
     def add_volume(self, collection: str, vid: int, **kwargs) -> Volume:
         with self.lock:
             if vid in self.volumes:

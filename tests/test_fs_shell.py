@@ -140,3 +140,21 @@ def test_fs_meta_notify_reemits_events(stack):
     paths = [(e["event"].get("newEntry") or {}).get("FullPath", "")
              for e in batch]
     assert any(p.endswith("a.txt") for p in paths)
+
+
+def test_bucket_commands(stack):
+    master, vol, filer = stack
+    env, out = _env(master, filer)
+    run_command(env, "bucket.create -name photos")
+    out_list = io.StringIO()  # fresh buffer: 'created bucket photos'
+    env_list = CommandEnv(master.url, out=out_list,  # must not satisfy
+                          filer_url=filer.url)       # the list assert
+    run_command(env_list, "bucket.list")
+    assert "photos" in out_list.getvalue()
+    post_multipart(f"http://{filer.url}/buckets/photos/p.jpg", "p.jpg",
+                   b"jpeg-bytes")
+    run_command(env, "bucket.delete -name photos")
+    out2 = io.StringIO()
+    env2 = CommandEnv(master.url, out=out2, filer_url=filer.url)
+    run_command(env2, "bucket.list")
+    assert "photos" not in out2.getvalue()

@@ -158,3 +158,23 @@ def test_unknown_command_and_help(cluster3):
     run_command(env2, "help")
     assert "ec.encode" in out2.getvalue()
     assert run_command(env2, "exit") is False
+
+
+def test_volume_mount_unmount_cycle(cluster3):
+    master, servers = cluster3
+    vid, payloads = _fill_volume(master.url)
+    holder = next(vs for vs in servers if vs.store.find_volume(vid))
+    env, out = _env(master)
+    run_command(env, f"volume.unmount -volumeId {vid} -node {holder.url}")
+    assert "unmounted=True" in out.getvalue()
+    assert holder.store.find_volume(vid) is None
+    # files remain on disk; a read now 404s on that server
+    from seaweedfs_tpu.server.http_util import HttpError, http_call
+    fid = next(iter(payloads))
+    with pytest.raises(HttpError):
+        http_call("GET", f"http://{holder.url}/{fid}")
+    env2, out2 = _env(master)  # fresh buffer: 'unmounted=True' contains
+    run_command(env2, f"volume.mount -volumeId {vid} -node {holder.url}")
+    assert "mounted=True" in out2.getvalue()  # the substring 'mounted='
+    assert http_call("GET", f"http://{holder.url}/{fid}") \
+        == payloads[fid]
