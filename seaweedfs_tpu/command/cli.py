@@ -458,7 +458,7 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-rack", default="")
     v.add_argument("-pulseSeconds", type=int, default=5)
     v.add_argument("-ec.backend", dest="ec_backend", default="auto",
-                   choices=["auto", "numpy", "native", "tpu"])
+                   choices=["auto", "numpy", "native", "tpu", "mesh"])
     v.add_argument("-compactionMBps", type=int, default=0,
                    help="throttle vacuum/compaction writes (MB/s, "
                         "0 = unthrottled; reference compactionMBps)")
@@ -499,7 +499,7 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-webdav", action="store_true")
     s.add_argument("-webdavPort", type=int, default=7333)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
-                   choices=["auto", "numpy", "native", "tpu"])
+                   choices=["auto", "numpy", "native", "tpu", "mesh"])
     s.add_argument("-jwtKey", default="")
     s.add_argument("-tlsCert", default="")
     s.add_argument("-tlsKey", default="")
@@ -666,6 +666,14 @@ def main(argv=None):
     glog.set_verbosity(args.v)
     if args.vmodule:
         glog.set_vmodule(args.vmodule)
+    # sitecustomize pre-imports jax with its own platform choice; re-apply
+    # the JAX_PLATFORMS env request before any device touch so
+    # `JAX_PLATFORMS=cpu weed volume -ec.backend mesh` really runs on CPU
+    try:
+        from ..util.jax_platform import honor_platform_request
+        honor_platform_request()
+    except Exception:  # noqa: BLE001 - jax may be absent entirely
+        pass
     _apply_tls_config(args)
     args.fn(args)
 

@@ -200,4 +200,9 @@ def get_codec(data_shards: int, parity_shards: int,
     if backend == "tpu":
         from .rs_tpu import TpuCodec
         return TpuCodec(data_shards, parity_shards, matrix_kind)
+    if backend == "mesh":
+        # SPMD over every visible device (multi-chip hosts); same
+        # programs the multichip dryrun validates on a virtual mesh
+        from ..parallel.mesh_codec import MeshCodec
+        return MeshCodec(data_shards, parity_shards, matrix_kind)
     raise ValueError(f"unknown backend {backend!r}")

@@ -1,0 +1,108 @@
+"""MeshCodec — multi-chip EC as a first-class codec backend.
+
+`-ec.backend mesh` runs every GF(2^8) coding matmul SPMD over a
+`jax.sharding.Mesh` of all visible devices: the payload axis shards
+over 'data' (stripes are independent byte positions — zero
+communication), coefficients replicate, and XLA partitions the
+bit-plane matmul (parallel/sharded_ec.py documents the math). On an
+8-chip host a volume encode therefore streams through all chips from
+the same `write_ec_files` call sites the single-chip TpuCodec uses;
+on the CPU test mesh it exercises the identical program. Outputs are
+bit-identical to every other backend (exact int32 arithmetic).
+
+This is the serving-path face of SURVEY §2.6's device tier: the same
+sharded programs the driver dry-runs via __graft_entry__ become the
+volume server's encode/rebuild engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..ops import gf256
+from ..ops.codec import ReedSolomonCodec
+from .mesh import make_mesh
+
+
+class MeshCodec(ReedSolomonCodec):
+    backend = "mesh"
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 matrix_kind: str = "vandermonde", mesh=None,
+                 chunk_bytes: int = 32 << 20):
+        super().__init__(data_shards, parity_shards, matrix_kind)
+        self.chunk_bytes = int(chunk_bytes)
+        self._mesh = mesh  # lazy: devices may not be initialized yet
+        self._fns: Dict[Tuple[int, int, int], object] = {}
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh()
+        return self._mesh
+
+    def _fn(self, rows_in: int, rows_out: int, n: int):
+        """Jitted (bitmat (rows_in*8, rows_out*8) int8, data
+        (rows_in, n) uint8) -> (rows_out, n) uint8, payload sharded
+        over 'data'."""
+        key = (rows_in, rows_out, n)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def program(bitmat, data):
+            shifts = jnp.arange(8, dtype=jnp.uint8)
+            bits = ((data[:, None, :] >> shifts[None, :, None]) & 1)
+            x = bits.reshape(rows_in * 8, n).astype(jnp.int8)
+            y = jax.lax.dot_general(
+                bitmat.T, x, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            ybits = (y & 1).astype(jnp.uint8).reshape(rows_out, 8, n)
+            weights = (jnp.uint8(1) << shifts)[None, :, None]
+            return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
+
+        mesh = self.mesh
+        fn = jax.jit(
+            program,
+            in_shardings=(NamedSharding(mesh, P(None, None)),
+                          NamedSharding(mesh, P(None, "data"))),
+            out_shardings=NamedSharding(mesh, P(None, "data")))
+        self._fns[key] = fn
+        return fn
+
+    def _width_bucket(self, n: int) -> int:
+        """Pad widths to power-of-two buckets (compile reuse), then up to
+        a multiple of the 'data' axis so the shard split is even."""
+        data_ax = self.mesh.shape["data"]
+        bucket = min(max(512, 1 << (n - 1).bit_length()), self.chunk_bytes)
+        bucket = max(bucket, n)  # chunk_bytes cap may undershoot n's chunk
+        return bucket + (-bucket) % data_ax
+
+    def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        r, k = coeffs.shape
+        n = data.shape[1]
+        if n == 0:
+            return np.zeros((r, 0), dtype=np.uint8)
+        bitmat = jnp.asarray(gf256.bit_matrix(coeffs).astype(np.int8))
+        out = np.empty((r, n), dtype=np.uint8)
+        step = self.chunk_bytes
+        for off in range(0, n, step):
+            end = min(off + step, n)
+            w = end - off
+            bucket = self._width_bucket(w)
+            fn = self._fn(k, r, bucket)
+            if w < bucket:  # zero-pad: GF-linear, so exact
+                padded = np.zeros((k, bucket), dtype=np.uint8)
+                padded[:, :w] = data[:, off:end]
+            else:
+                padded = data[:, off:end]
+            out[:, off:end] = np.asarray(fn(bitmat, padded))[:, :w]
+        return out

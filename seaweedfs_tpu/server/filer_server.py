@@ -252,6 +252,32 @@ class FilerServer:
         limit = int(req.query.get("limit", 1000))
         last = req.query.get("lastFileName", "")
         entries = self.filer.list_entries(path, last, False, limit)
+        # browsers get the file-browser page (reference filer_ui/);
+        # API clients keep the JSON listing
+        if "text/html" in req.headers.get("Accept", "") and \
+                req.query.get("pretty") != "y":
+            import html as _html
+            import urllib.parse as _up
+            from .status_ui import Raw, render_page
+            rows = []
+            base = path.rstrip("/")
+            for e in entries:
+                href = _up.quote(f"{base}/{e.name}")
+                name = _html.escape(e.name)
+                kind = "dir" if e.is_directory else (e.attr.mime or "file")
+                rows.append((Raw(f'<a href="{href}">{name}</a>'), kind,
+                             e.size() if not e.is_directory else "-"))
+            footer = ""
+            if entries and len(entries) == limit:  # page truncated
+                nxt = _up.quote(entries[-1].name)
+                footer = (f'<p><a href="?lastFileName={nxt}&'
+                          f'limit={limit}">next page &raquo;</a></p>')
+            page = render_page(
+                f"Filer {path}",
+                [(path, ["name", "type", "size"], rows)],
+                footer_html=footer)
+            return Response(page,
+                            content_type="text/html; charset=utf-8")
         return {
             "path": path,
             "entries": [self._entry_json(e) for e in entries],

@@ -16,19 +16,31 @@ th{{background:#f4f4f4}} h1{{font-size:1.3em}} .muted{{color:#888}}
 <p class="muted">seaweedfs_tpu &middot; {now}</p></body></html>"""
 
 
+class Raw(str):
+    """Marks ONE cell as trusted, pre-escaped markup. Everything else is
+    escaped — confining the XSS trust decision to the specific cell
+    instead of a page-wide flag."""
+
+
 def _table(headers, rows) -> str:
     head = "".join(f"<th>{html.escape(str(h))}</th>" for h in headers)
+
+    def cell(c):
+        return str(c) if isinstance(c, Raw) else html.escape(str(c))
+
     body = "".join(
-        "<tr>" + "".join(f"<td>{html.escape(str(c))}</td>" for c in row)
+        "<tr>" + "".join(f"<td>{cell(c)}</td>" for c in row)
         + "</tr>" for row in rows)
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
-def render_page(title: str, sections) -> bytes:
+def render_page(title: str, sections, footer_html: str = "") -> bytes:
+    """``footer_html`` is trusted markup appended after the sections."""
     body = ""
     for heading, headers, rows in sections:
         body += f"<h2>{html.escape(heading)}</h2>"
         body += _table(headers, rows)
+    body += footer_html
     return _PAGE.format(title=html.escape(title), body=body,
                         now=time.strftime("%Y-%m-%d %H:%M:%S")).encode()
 

@@ -133,6 +133,30 @@ def test_master_maintenance_scripts_run():
 
 # -- status UIs --------------------------------------------------------------
 
+def test_filer_browser_page(tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.http_util import post_multipart
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      ec_backend="numpy").start()
+    filer = FilerServer(port=0, master_url=master.url).start()
+    try:
+        post_multipart(f"http://{filer.url}/docs/<i>.txt", "x",
+                       b"escaped-name")
+        page = http_call("GET", f"http://{filer.url}/docs/",
+                         headers={"Accept": "text/html"}).decode()
+        assert "<h1>Filer /docs" in page
+        assert "&lt;i&gt;.txt" in page and "<i>.txt" not in page  # XSS
+        # API clients still get JSON
+        js = http_call("GET", f"http://{filer.url}/docs/").decode()
+        assert js.startswith("{")
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
 def test_status_pages_render(tmp_path):
     master = MasterServer(port=0, pulse_seconds=1).start()
     vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
