@@ -228,21 +228,41 @@ class MasterServer:
                     "leader": self.leader_url(),
                     "not_leader": True}
         hb = req.json()
-        self.topology.register_heartbeat(
-            dc_id=hb.get("data_center", ""),
-            rack_id=hb.get("rack", ""),
-            ip=hb.get("ip", "127.0.0.1"),
-            port=int(hb.get("port", 0)),
-            public_url=hb.get("public_url", ""),
-            max_volume_count=int(hb.get("max_volume_count", 7)),
-            volumes=hb.get("volumes", []),
-            ec_shards={int(k): v
-                       for k, v in (hb.get("ec_shards") or {}).items()},
-            ec_collections={int(k): v
-                            for k, v in
-                            (hb.get("ec_collections") or {}).items()},
-            max_file_key=int(hb.get("max_file_key", 0)),
-        )
+        ec_shards = {int(k): v
+                     for k, v in (hb.get("ec_shards") or {}).items()}
+        ec_collections = {int(k): v
+                          for k, v in
+                          (hb.get("ec_collections") or {}).items()}
+        if hb.get("delta"):
+            # incremental heartbeat (reference master_grpc_server.go:
+            # 94-152): only new/changed/deleted volumes ride the wire.
+            # An unknown node means we lost its registration (restart,
+            # failover) — ask for a full resync instead of guessing.
+            applied = self.topology.apply_heartbeat_delta(
+                url=f"{hb.get('ip', '127.0.0.1')}:{hb.get('port', 0)}",
+                new_volumes=hb.get("new_volumes", []),
+                deleted_volumes=[int(v) for v in
+                                 hb.get("deleted_volumes", [])],
+                ec_shards=ec_shards, ec_collections=ec_collections,
+                max_file_key=int(hb.get("max_file_key", 0)))
+            if not applied:
+                return {"resync": True,
+                        "volume_size_limit":
+                        self.topology.volume_size_limit,
+                        "leader": self.leader_url() or self.url}
+        else:
+            self.topology.register_heartbeat(
+                dc_id=hb.get("data_center", ""),
+                rack_id=hb.get("rack", ""),
+                ip=hb.get("ip", "127.0.0.1"),
+                port=int(hb.get("port", 0)),
+                public_url=hb.get("public_url", ""),
+                max_volume_count=int(hb.get("max_volume_count", 7)),
+                volumes=hb.get("volumes", []),
+                ec_shards=ec_shards,
+                ec_collections=ec_collections,
+                max_file_key=int(hb.get("max_file_key", 0)),
+            )
         return {"volume_size_limit": self.topology.volume_size_limit,
                 "leader": self.leader_url() or self.url}
 

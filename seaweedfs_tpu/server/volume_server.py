@@ -115,6 +115,9 @@ class VolumeServer:
         self._ec_loc_cache = EcShardLocationCache(
             self._fetch_ec_shard_locations)
         self._stop = threading.Event()
+        # delta-heartbeat state: last volume set acked, and by whom
+        self._hb_acked_master = None
+        self._hb_acked_volumes = None
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
 
@@ -158,6 +161,37 @@ class VolumeServer:
                 # heartbeat_once already rotated through every seed
                 glog.V(0).infof("no master reachable: %s", e)
 
+    def _heartbeat_payload(self, hb: dict, target: str) -> dict:
+        """Full heartbeat, or a volume DELTA against the state the
+        target master last acknowledged (reference incremental
+        heartbeats, master_grpc_server.go:94-152): unchanged volumes
+        stay home, only new/changed/deleted ride the wire."""
+        if target != self._hb_acked_master or self._hb_acked_volumes is None:
+            return hb
+        current = {v["id"]: v for v in hb["volumes"]}
+        previous = self._hb_acked_volumes
+        delta = dict(hb)
+        del delta["volumes"]
+        delta["delta"] = True
+        delta["new_volumes"] = [v for vid, v in current.items()
+                                if previous.get(vid) != v]
+        delta["deleted_volumes"] = [vid for vid in previous
+                                    if vid not in current]
+        return delta
+
+    def _post_heartbeat(self, hb: dict, target: str) -> dict:
+        resp = post_json(f"http://{target}/cluster/heartbeat",
+                         self._heartbeat_payload(hb, target), timeout=10)
+        if resp.get("resync"):
+            # the master lost (or never had) our registration: replay
+            # the full state immediately
+            resp = post_json(f"http://{target}/cluster/heartbeat", hb,
+                             timeout=10)
+        if not resp.get("not_leader"):
+            self._hb_acked_master = target
+            self._hb_acked_volumes = {v["id"]: v for v in hb["volumes"]}
+        return resp
+
     def heartbeat_once(self):
         """Heartbeat the current master, trying every seed before
         giving up — startup must not die because the first listed seed
@@ -166,9 +200,7 @@ class VolumeServer:
         last = None
         for _ in range(len(self._seed_masters)):
             try:
-                resp = post_json(
-                    f"http://{self.master_url}/cluster/heartbeat",
-                    hb, timeout=10)
+                resp = self._post_heartbeat(hb, self.master_url)
                 break
             except HttpError as e:
                 last = e
@@ -185,9 +217,7 @@ class VolumeServer:
         if leader and leader != self.master_url:
             self.master_url = leader
             if resp.get("not_leader"):
-                resp = post_json(
-                    f"http://{self.master_url}/cluster/heartbeat",
-                    hb, timeout=10)
+                resp = self._post_heartbeat(hb, self.master_url)
                 if resp.get("volume_size_limit"):
                     self.volume_size_limit = resp["volume_size_limit"]
 

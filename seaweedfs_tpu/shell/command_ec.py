@@ -257,44 +257,121 @@ def ec_decode(env: CommandEnv, args: List[str]):
                   f"{target}")
 
 
-@command("ec.balance", "[-collection <name>] : even EC shards across nodes")
+def _move_shard(env: CommandEnv, vid: int, collection: str, sid: int,
+                src: str, dst: str):
+    env.node_post(dst, f"/admin/ec/copy?volume={vid}"
+                       f"&collection={collection}&source={src}"
+                       f"&shards={sid}")
+    env.node_post(dst, f"/admin/ec/mount?volume={vid}"
+                       f"&collection={collection}&shards={sid}")
+    env.node_post(src, f"/admin/ec/delete_shards?volume={vid}"
+                       f"&collection={collection}&shards={sid}")
+
+
+def _balance_one_ec_volume(env: CommandEnv, vid: int, collection: str,
+                           shards: Dict[int, List[str]],
+                           node_rack: Dict[str, str]) -> int:
+    """Rack-aware two-phase balance of one EC volume (reference
+    command_ec_balance.go): first spread shards evenly across RACKS (a
+    lost rack must never cost more than its fair share of shards), then
+    even node counts within each rack. Returns moves made."""
+    import math
+    moves = 0
+    racks = sorted(set(node_rack.values()))
+    nodes_in_rack = {r: sorted(u for u, rr in node_rack.items()
+                               if rr == r) for r in racks}
+
+    # replicated shards count EVERY holder (a shard may briefly — or by
+    # policy — live on several nodes); a move relocates one replica and
+    # must never target a node already holding the shard
+    def rack_counts() -> Dict[str, int]:
+        c = {r: 0 for r in racks}
+        for sid, urls in shards.items():
+            for u in urls:
+                r = node_rack.get(u)
+                if r is not None:
+                    c[r] += 1
+        return c
+
+    def node_counts(urls) -> Dict[str, int]:
+        c = {u: 0 for u in urls}
+        for sid, holders in shards.items():
+            for h in holders:
+                if h in c:
+                    c[h] += 1
+        return c
+
+    def relocate(sid: int, src: str, dst: str):
+        _move_shard(env, vid, collection, sid, src, dst)
+        shards[sid] = [dst if u == src else u for u in shards[sid]]
+
+    # phase 1: across racks
+    if len(racks) > 1:
+        ceil_per_rack = math.ceil(len(shards) / len(racks))
+        while True:
+            rc = rack_counts()
+            hi = max(racks, key=lambda r: rc[r])
+            lo = min(racks, key=lambda r: rc[r])
+            if rc[hi] <= ceil_per_rack or rc[hi] - rc[lo] <= 1:
+                break
+            nc = node_counts(nodes_in_rack[lo])
+            job = None
+            for s in sorted(shards):
+                src = next((u for u in shards[s]
+                            if node_rack.get(u) == hi), None)
+                if src is None:
+                    continue
+                dst = min((u for u in nodes_in_rack[lo]
+                           if u not in shards[s]),
+                          key=lambda u: nc[u], default=None)
+                if dst is not None:
+                    job = (s, src, dst)
+                    break
+            if job is None:
+                break  # nothing movable without double-placing a shard
+            relocate(*job)
+            moves += 1
+
+    # phase 2: within each rack
+    for r in racks:
+        urls = nodes_in_rack[r]
+        if len(urls) < 2:
+            continue
+        while True:
+            nc = node_counts(urls)
+            hi = max(urls, key=lambda u: nc[u])
+            lo = min(urls, key=lambda u: nc[u])
+            if nc[hi] - nc[lo] <= 1:
+                break
+            sid = next((s for s in sorted(shards)
+                        if hi in shards[s] and lo not in shards[s]),
+                       None)
+            if sid is None:
+                break
+            relocate(sid, hi, lo)
+            moves += 1
+    return moves
+
+
+@command("ec.balance",
+         "[-collection <name>] : spread EC shards evenly across racks, "
+         "then across nodes within each rack")
 def ec_balance(env: CommandEnv, args: List[str]):
     flags = parse_flags(args)
-    nodes = [n["url"] for n in env.cluster_nodes()]
-    if not nodes:
+    cluster = env.cluster_nodes()
+    if not cluster:
         env.write("no volume servers")
         return
+    node_rack = {n["url"]: n.get("rack", "") or "DefaultRack"
+                 for n in cluster}
     moves = 0
     for vid_s, info in env.ec_volumes().items():
         vid = int(vid_s)
         collection = info.get("collection", "")
         if "collection" in flags and collection != flags["collection"]:
             continue
-        shards = {int(s): urls for s, urls in info["shards"].items()}
-        counts = {u: 0 for u in nodes}
-        for sid, urls in shards.items():
-            for u in urls:
-                if u in counts:
-                    counts[u] += 1
-        # move shards from the most-loaded node to the least-loaded until
-        # the spread is <= 1 (rack-aware refinement comes with multi-rack
-        # topologies; reference command_ec_balance.go)
-        while True:
-            hi = max(counts, key=counts.get)
-            lo = min(counts, key=counts.get)
-            if counts[hi] - counts[lo] <= 1:
-                break
-            sid = next(s for s, urls in sorted(shards.items())
-                       if hi in urls and lo not in urls)
-            env.node_post(lo, f"/admin/ec/copy?volume={vid}"
-                              f"&collection={collection}&source={hi}"
-                              f"&shards={sid}")
-            env.node_post(lo, f"/admin/ec/mount?volume={vid}"
-                              f"&collection={collection}&shards={sid}")
-            env.node_post(hi, f"/admin/ec/delete_shards?volume={vid}"
-                              f"&collection={collection}&shards={sid}")
-            shards[sid] = [lo if u == hi else u for u in shards[sid]]
-            counts[hi] -= 1
-            counts[lo] += 1
-            moves += 1
+        shards = {int(s): list(urls)
+                  for s, urls in info["shards"].items()}
+        moves += _balance_one_ec_volume(env, vid, collection, shards,
+                                        node_rack)
     env.write(f"ec.balance: {moves} shard moves")

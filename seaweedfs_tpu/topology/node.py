@@ -103,6 +103,7 @@ class DataNode:
         self.ec_shard_collections = dict(collections)
 
     def to_dict(self) -> dict:
+        rack = self.rack
         return {
             "url": self.url, "public_url": self.public_url,
             "volumes": len(self.volumes),
@@ -110,6 +111,11 @@ class DataNode:
             "max": self.max_volume_count,
             "free": self.free_space(),
             "last_seen": self.last_seen,
+            # placement context for rack-aware shell maintenance
+            # (reference command_ec_balance.go works on racks)
+            "rack": rack.id if rack else "",
+            "dataCenter": rack.data_center.id
+            if rack and rack.data_center else "",
         }
 
 

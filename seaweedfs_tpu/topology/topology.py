@@ -129,6 +129,46 @@ class Topology:
                 self._sync_ec_shards(node)
             return node
 
+    def apply_heartbeat_delta(self, url: str, new_volumes: List[dict],
+                              deleted_volumes: List[int],
+                              ec_shards: Dict[int, int] = None,
+                              ec_collections: Dict[int, str] = None,
+                              max_file_key: int = 0) -> bool:
+        """Incremental registration (reference master_grpc_server.go
+        IncrementalHeartbeat path). Returns False when the node is
+        unknown — the caller must then request a full resync."""
+        with self.lock:
+            node = self.find_node(url)
+            if node is None:
+                return False
+            node.last_seen = time.time()
+            self.sequencer.set_max(max_file_key)
+            for v in new_volumes:
+                vi = VolumeInfo.from_dict(v)
+                was_known = vi.id in node.volumes
+                node.volumes[vi.id] = vi
+                self.max_volume_id = max(self.max_volume_id, vi.id)
+                layout = self.get_layout(vi.collection,
+                                         vi.replica_placement, vi.ttl)
+                layout.register_volume(vi, node)
+                if not was_known and self.location_listener is not None:
+                    self.location_listener("new", vi.id, node.url,
+                                           node.public_url)
+            for vid in deleted_volumes:
+                was_present = node.volumes.pop(vid, None) is not None
+                for layout in self.layouts.values():
+                    layout.unregister_volume(vid, node)
+                # a delta whose ack was lost gets resent: only a volume
+                # we actually knew may broadcast a deletion, or watch
+                # subscribers see duplicate events every pulse
+                if was_present and self.location_listener is not None:
+                    self.location_listener("deleted", vid, node.url,
+                                           node.public_url)
+            if ec_shards is not None:
+                node.update_ec_shards(ec_shards, ec_collections or {})
+                self._sync_ec_shards(node)
+            return True
+
     def _sync_ec_shards(self, node: DataNode):
         # rebuild this node's contribution to the ec shard map
         for vid, per_shard in self.ec_shard_map.items():

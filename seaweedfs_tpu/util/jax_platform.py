@@ -22,13 +22,16 @@ def honor_platform_request() -> None:
     """Re-apply the JAX_PLATFORMS env request onto jax.config.
 
     Only effective before the first device touch of the process; call it
-    before any ``jax.devices()`` / array creation.
+    before any ``jax.devices()`` / array creation. With no request set
+    this is free — no jax import (CLI subcommands that never touch a
+    device must not pay the multi-second import at startup).
     """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
     import jax
 
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        jax.config.update("jax_platforms", want)
+    jax.config.update("jax_platforms", want)
 
 
 def set_host_device_count_flag(n: int, flags: Optional[str] = None) -> str:

@@ -1,0 +1,152 @@
+"""Rack-aware ec.balance (reference command_ec_balance.go,
+command_ec_test.go's fake-node style: pure logic on synthetic
+topologies, then one live-cluster pass)."""
+
+import math
+
+import pytest
+
+from seaweedfs_tpu.shell.command_ec import _balance_one_ec_volume
+
+
+class FakeEnv:
+    """Records shard moves instead of HTTP calls."""
+
+    def __init__(self):
+        self.moves = []
+
+    def node_post(self, node, path, timeout=600):
+        if "/admin/ec/copy" in path:
+            self.moves.append((node, path))
+        return {}
+
+    def write(self, line):
+        pass
+
+
+def spread(shards, node_rack):
+    per_rack, per_node = {}, {}
+    for sid, urls in shards.items():
+        u = urls[0]
+        per_node[u] = per_node.get(u, 0) + 1
+        r = node_rack[u]
+        per_rack[r] = per_rack.get(r, 0) + 1
+    return per_rack, per_node
+
+
+def test_balance_spreads_across_racks_then_nodes():
+    node_rack = {"a1": "rackA", "a2": "rackA",
+                 "b1": "rackB", "b2": "rackB"}
+    # all 14 shards piled on one node of one rack
+    shards = {sid: ["a1"] for sid in range(14)}
+    env = FakeEnv()
+    moves = _balance_one_ec_volume(env, 7, "", shards, node_rack)
+    per_rack, per_node = spread(shards, node_rack)
+    assert max(per_rack.values()) <= math.ceil(14 / 2)
+    # within each rack the node spread is <= 1
+    for r in ("rackA", "rackB"):
+        counts = [c for u, c in per_node.items() if node_rack[u] == r]
+        assert max(counts) - min(counts) <= 1, per_node
+    assert moves == len(env.moves) and moves > 0
+
+
+def test_balance_three_racks_uneven():
+    node_rack = {"a1": "rA", "b1": "rB", "c1": "rC", "c2": "rC"}
+    shards = {sid: ["c1"] for sid in range(14)}
+    env = FakeEnv()
+    _balance_one_ec_volume(env, 1, "", shards, node_rack)
+    per_rack, per_node = spread(shards, node_rack)
+    assert max(per_rack.values()) <= math.ceil(14 / 3)
+    assert abs(per_node.get("c1", 0) - per_node.get("c2", 0)) <= 1
+
+
+def test_balance_noop_when_even():
+    node_rack = {"a1": "rA", "b1": "rB"}
+    shards = {sid: ["a1" if sid % 2 else "b1"] for sid in range(14)}
+    env = FakeEnv()
+    moves = _balance_one_ec_volume(env, 1, "", shards, node_rack)
+    assert moves == 0 and env.moves == []
+
+
+def test_balance_single_rack_is_node_evening():
+    node_rack = {"a1": "r", "a2": "r", "a3": "r"}
+    shards = {sid: ["a1"] for sid in range(14)}
+    env = FakeEnv()
+    _balance_one_ec_volume(env, 1, "", shards, node_rack)
+    _, per_node = spread(shards, node_rack)
+    assert max(per_node.values()) - min(per_node.values()) <= 1
+
+
+def test_balance_never_double_places_replicated_shard():
+    """A shard with several live replicas must not be copied onto a node
+    that already holds it, and the untouched replica stays tracked."""
+    node_rack = {"a1": "rA", "a2": "rA", "b1": "rB", "b2": "rB"}
+    shards = {sid: ["a1"] for sid in range(13)}
+    shards[13] = ["a1", "b1"]  # replicated shard
+    env = FakeEnv()
+    _balance_one_ec_volume(env, 1, "", shards, node_rack)
+    for sid, urls in shards.items():
+        assert len(set(urls)) == len(urls), (sid, urls)
+    assert len(shards[13]) == 2  # both replicas still accounted for
+    # no copy ever targeted a node already in that shard's holder list
+    for node, path in env.moves:
+        sid = int(path.split("shards=")[1].split("&")[0])
+        assert shards[sid].count(node) <= 1
+
+
+# -- live cluster ------------------------------------------------------------
+
+def test_live_rack_aware_balance(tmp_path):
+    import io
+
+    import numpy as np
+
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import get_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
+
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    servers = []
+    for i, rack in enumerate(["r1", "r1", "r2", "r2"]):
+        servers.append(VolumeServer(
+            port=0, directories=[str(tmp_path / f"v{i}")],
+            master_url=master.url, pulse_seconds=1, rack=rack,
+            max_volume_counts=[20], ec_backend="numpy").start())
+    try:
+        a = op.assign(master.url, collection="bal")
+        vid = int(a["fid"].split(",")[0])
+        rng = np.random.default_rng(0)
+        for i in range(1, 8):
+            op.upload(a["url"], f"{vid},{i:x}00000001",
+                      rng.integers(0, 256, 120_000
+                                   ).astype(np.uint8).tobytes(),
+                      filename=f"f{i}")
+        out = io.StringIO()
+        env = CommandEnv(master.url, out=out)
+        run_command(env, f"ec.encode -volumeId {vid}")
+        import time
+        time.sleep(1.5)
+        run_command(env, "ec.balance -collection bal")
+        time.sleep(1.5)
+        ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                      f"?volumeId={vid}")
+        rack_of = {vs.url: ["r1", "r1", "r2", "r2"][i]
+                   for i, vs in enumerate(servers)}
+        per_rack = {}
+        total = 0
+        for sid, urls in ec["shards"].items():
+            total += 1
+            per_rack[rack_of[urls[0]]] = \
+                per_rack.get(rack_of[urls[0]], 0) + 1
+        assert total == 14
+        assert max(per_rack.values()) <= math.ceil(14 / 2) + 1
+        # every shard still readable: degraded read through EC path
+        got = op.read_file(master.url, f"{vid},100000001")
+        assert len(got) == 120_000
+    finally:
+        for vs in servers:
+            vs.stop()
+        master.stop()

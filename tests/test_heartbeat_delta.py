@@ -1,0 +1,96 @@
+"""Incremental heartbeats (SURVEY hard part #6; reference
+master_grpc_server.go:94-152 incremental vs full sync)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.server.http_util import get_json, post_json
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.topology.topology import Topology
+
+
+def hb_volume(vid, size=100, collection=""):
+    return {"id": vid, "collection": collection, "size": size,
+            "file_count": 1, "delete_count": 0, "deleted_byte_count": 0,
+            "read_only": False, "replica_placement": "000", "ttl": 0,
+            "version": 3, "compact_revision": 0, "modified_at": 0}
+
+
+def test_topology_delta_apply_and_resync_signal():
+    topo = Topology(pulse_seconds=1)
+    events = []
+    topo.location_listener = \
+        lambda t, vid, url, pub: events.append((t, vid))
+    # unknown node -> resync required
+    assert not topo.apply_heartbeat_delta("1.2.3.4:80", [hb_volume(1)], [])
+    topo.register_heartbeat(
+        dc_id="", rack_id="", ip="1.2.3.4", port=80, public_url="",
+        max_volume_count=10, volumes=[hb_volume(1), hb_volume(2)])
+    assert ("new", 1) in events and ("new", 2) in events
+    events.clear()
+    # delta: volume 1 grows (no location event), 3 appears, 2 dies
+    assert topo.apply_heartbeat_delta(
+        "1.2.3.4:80", [hb_volume(1, size=5000), hb_volume(3)], [2])
+    node = topo.find_node("1.2.3.4:80")
+    assert set(node.volumes) == {1, 3}
+    assert node.volumes[1].size == 5000
+    assert events == [("new", 3), ("deleted", 2)]
+    assert topo.lookup("", 2) in (None, [])
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[20], ec_backend="numpy").start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def wait_until(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_deltas_carry_growth_and_deletion(cluster):
+    master, vs = cluster
+    a = op.assign(master.url)
+    vid = int(a["fid"].split(",")[0])
+    vs.heartbeat_once()          # ack baseline: later beats are deltas
+    assert vs._hb_acked_volumes is not None
+    payload = vs._heartbeat_payload(vs.store.collect_heartbeat(),
+                                    vs.master_url)
+    assert payload.get("delta") is True  # proves the wire format
+    op.upload(a["url"], a["fid"], b"grow" * 5000, filename="g.bin")
+    vs.heartbeat_once()          # delta carries the size change
+    vols = get_json(f"http://{master.url}/cluster/volumes")["volumes"]
+    assert vols[str(vid)][0]["size"] > 0
+    # volume deletion flows through deleted_volumes
+    post_json(f"http://{vs.url}/admin/delete_volume?volume={vid}")
+    assert wait_until(lambda: str(vid) not in get_json(
+        f"http://{master.url}/cluster/volumes")["volumes"])
+
+
+def test_master_amnesia_forces_resync(cluster):
+    """A master that lost the registration (restart/failover) must get
+    the full state back on the next pulse, not a blind delta."""
+    master, vs = cluster
+    a = op.assign(master.url)
+    vid = int(a["fid"].split(",")[0])
+    vs.heartbeat_once()
+    node = master.topology.find_node(vs.url)
+    master.topology.unregister_node(node)   # simulated amnesia
+    assert master.topology.find_node(vs.url) is None
+    vs.heartbeat_once()                     # delta -> resync -> full
+    assert master.topology.find_node(vs.url) is not None
+    assert vid in master.topology.find_node(vs.url).volumes
