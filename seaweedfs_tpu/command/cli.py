@@ -59,8 +59,9 @@ def cmd_master(args):
                      jwt_signing_key=args.jwtKey,
                      peers=args.peers, raft_dir=args.mdir,
                      maintenance_scripts=args.maintenanceScripts,
-                     maintenance_interval=args.maintenanceIntervalSeconds
-                     ).start()
+                     maintenance_interval=args.maintenanceIntervalSeconds,
+                     vacuum_interval=args.vacuumIntervalSeconds,
+                     garbage_threshold=args.garbageThreshold).start()
     print(f"master listening on {m.url}")
     _wait(m)
 
@@ -446,6 +447,11 @@ def build_parser() -> argparse.ArgumentParser:
                         'e.g. "volume.vacuum; ec.rebuild"')
     m.add_argument("-maintenanceIntervalSeconds", type=float,
                    default=17 * 60)
+    m.add_argument("-vacuumIntervalSeconds", type=float, default=15 * 60,
+                   help="automatic vacuum + TTL-expiry sweep on the "
+                        "leader (0 disables; reference "
+                        "StartRefreshWritableVolumes)")
+    m.add_argument("-garbageThreshold", type=float, default=0.3)
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")

@@ -30,7 +30,8 @@ class MasterServer:
                  jwt_signing_key: str = "",
                  peers: str = "", raft_dir: str = "",
                  maintenance_scripts: str = "",
-                 maintenance_interval: float = 17 * 60):
+                 maintenance_interval: float = 17 * 60,
+                 vacuum_interval: float = 15 * 60):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -85,6 +86,13 @@ class MasterServer:
         if self.maintenance_scripts:
             self._maintenance_thread = threading.Thread(
                 target=self._maintenance_loop, daemon=True)
+        # automatic vacuum + TTL expiry (reference
+        # Topo.StartRefreshWritableVolumes, master_server.go:128 →
+        # topology_vacuum.go:139); 0 disables
+        self.vacuum_interval = float(vacuum_interval)
+        self._vacuum_thread = threading.Thread(
+            target=self._vacuum_loop, daemon=True) \
+            if self.vacuum_interval > 0 else None
 
         # raft HA (reference weed/server/raft_server.go): multi-master
         # when -peers is set; single-master otherwise (no raft at all)
@@ -184,6 +192,8 @@ class MasterServer:
             self.raft.start()
         if self._maintenance_thread is not None:
             self._maintenance_thread.start()
+        if self._vacuum_thread is not None:
+            self._vacuum_thread.start()
         return self
 
     def stop(self):
@@ -199,6 +209,90 @@ class MasterServer:
     def _prune_loop(self):
         while not self._stop.wait(self.topology.pulse_seconds):
             self.topology.prune_dead_nodes()
+
+    def _ttl_expired_volumes(self):
+        """(vid, [node urls]) for TTL volumes whose content outlived its
+        TTL (reference volume.expired() + the vacuum loop's expiry
+        sweep). Empty volumes never expire — they are writable targets."""
+        out = {}
+        now = time.time()
+        with self.topology.lock:
+            for node in self.topology.all_nodes():
+                for vid, vi in node.volumes.items():
+                    ttl = TTL.from_uint32(vi.ttl or 0)
+                    if ttl.minutes == 0 or vi.size == 0:
+                        continue
+                    if not vi.modified_at:
+                        continue
+                    # 10% grace past the TTL like the reference, so a
+                    # volume isn't reaped while still serving tail reads
+                    if now - vi.modified_at > ttl.minutes * 60 * 1.1:
+                        out.setdefault(vid, []).append(node.url)
+        return sorted(out.items())
+
+    def _run_vacuum_pass(self, threshold: float = None,
+                         reap_ttl: bool = False) -> dict:
+        """One vacuum sweep; ``reap_ttl`` additionally deletes
+        TTL-expired volumes — only the background loop passes it (a
+        manual /vol/vacuum must never have destructive side effects the
+        operator didn't ask for)."""
+        threshold = threshold if threshold is not None \
+            else self.garbage_threshold
+        results = []
+        for vid, nodes in self.topology.vacuum_candidates(threshold):
+            ok = True
+            for n in nodes:
+                try:
+                    post_json(f"http://{n.url}/admin/vacuum/compact"
+                              f"?volume={vid}")
+                except HttpError:
+                    ok = False
+                    break
+            if ok:
+                for n in nodes:
+                    try:
+                        post_json(f"http://{n.url}/admin/vacuum/commit"
+                                  f"?volume={vid}")
+                    except HttpError:
+                        ok = False
+            results.append({"volume": vid, "ok": ok})
+        expired = []
+        if reap_ttl:
+            for vid, urls in self._ttl_expired_volumes():
+                # unroute FIRST: assigns/lookups must stop returning the
+                # volume before any replica is destroyed, or a fid can
+                # be handed out for a volume dying under it
+                with self.topology.lock:
+                    for node in self.topology.all_nodes():
+                        if node.url not in urls:
+                            continue
+                        node.volumes.pop(vid, None)
+                        for layout in self.topology.layouts.values():
+                            layout.unregister_volume(vid, node)
+                        if self.topology.location_listener is not None:
+                            self.topology.location_listener(
+                                "deleted", vid, node.url,
+                                node.public_url)
+                for u in urls:
+                    try:
+                        post_json(f"http://{u}/admin/delete_volume"
+                                  f"?volume={vid}")
+                    except HttpError:
+                        pass
+                expired.append(vid)
+        return {"vacuumed": results, "ttl_expired": expired}
+
+    def _vacuum_loop(self):
+        from ..util import glog
+        while not self._stop.wait(self.vacuum_interval):
+            if not self.is_leader():
+                continue
+            try:
+                out = self._run_vacuum_pass(reap_ttl=True)
+                if out["vacuumed"] or out["ttl_expired"]:
+                    glog.V(0).infof("auto vacuum: %s", out)
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                glog.V(0).infof("auto vacuum failed: %s", e)
 
     def _maintenance_loop(self):
         """Run the configured shell scripts every interval (leader-only,
@@ -484,25 +578,7 @@ class MasterServer:
             return fwd
         threshold = float(req.query.get("garbageThreshold",
                                         self.garbage_threshold))
-        results = []
-        for vid, nodes in self.topology.vacuum_candidates(threshold):
-            ok = True
-            for n in nodes:
-                try:
-                    post_json(f"http://{n.url}/admin/vacuum/compact"
-                              f"?volume={vid}")
-                except HttpError:
-                    ok = False
-                    break
-            if ok:
-                for n in nodes:
-                    try:
-                        post_json(f"http://{n.url}/admin/vacuum/commit"
-                                  f"?volume={vid}")
-                    except HttpError:
-                        ok = False
-            results.append({"volume": vid, "ok": ok})
-        return {"vacuumed": results}
+        return self._run_vacuum_pass(threshold)
 
     def col_delete(self, req: Request):
         fwd = self._leader_forward(req)

@@ -321,6 +321,14 @@ def _balance_one_ec_volume(env: CommandEnv, vid: int, collection: str,
                             if node_rack.get(u) == hi), None)
                 if src is None:
                     continue
+                # racks already holding ANOTHER replica of s (besides
+                # the one being moved) are off limits — two replicas of
+                # one shard in a rack is exactly the fault-domain
+                # collapse this phase exists to prevent
+                other_racks = {node_rack.get(u) for u in shards[s]
+                               if u != src}
+                if lo in other_racks:
+                    continue
                 dst = min((u for u in nodes_in_rack[lo]
                            if u not in shards[s]),
                           key=lambda u: nc[u], default=None)

@@ -20,13 +20,14 @@ class VolumeInfo:
 
     __slots__ = ("id", "collection", "size", "file_count", "delete_count",
                  "deleted_byte_count", "read_only", "replica_placement",
-                 "ttl", "version", "compact_revision")
+                 "ttl", "version", "compact_revision", "modified_at")
 
     def __init__(self, id: int, collection: str = "", size: int = 0,
                  file_count: int = 0, delete_count: int = 0,
                  deleted_byte_count: int = 0, read_only: bool = False,
                  replica_placement: str = "000", ttl: int = 0,
-                 version: int = 3, compact_revision: int = 0):
+                 version: int = 3, compact_revision: int = 0,
+                 modified_at: float = 0):
         self.id = id
         self.collection = collection
         self.size = size
@@ -38,6 +39,7 @@ class VolumeInfo:
         self.ttl = ttl
         self.version = version
         self.compact_revision = compact_revision
+        self.modified_at = modified_at
 
     @classmethod
     def from_dict(cls, d: dict) -> "VolumeInfo":
@@ -45,7 +47,7 @@ class VolumeInfo:
                       ("id", "collection", "size", "file_count",
                        "delete_count", "deleted_byte_count", "read_only",
                        "replica_placement", "ttl", "version",
-                       "compact_revision") if k in d})
+                       "compact_revision", "modified_at") if k in d})
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}

@@ -77,6 +77,20 @@ def test_balance_single_rack_is_node_evening():
     assert max(per_node.values()) - min(per_node.values()) <= 1
 
 
+def test_balance_keeps_replicas_rack_diverse():
+    """Phase 1 must not move a replica into a rack that already holds
+    another replica of the same shard (fault-domain collapse)."""
+    node_rack = {"a1": "rA", "a2": "rA", "b1": "rB"}
+    # rA overloaded; shard 0 already has a replica in rB
+    shards = {sid: ["a1"] for sid in range(13)}
+    shards[0] = ["a1", "b1"]
+    env = FakeEnv()
+    _balance_one_ec_volume(env, 1, "", shards, node_rack)
+    for urls in shards.values():
+        rs = [node_rack[u] for u in urls]
+        assert len(set(rs)) == len(rs), (urls, "replicas share a rack")
+
+
 def test_balance_never_double_places_replicated_shard():
     """A shard with several live replicas must not be copied onto a node
     that already holds it, and the untouched replica stays tracked."""

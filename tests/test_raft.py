@@ -259,3 +259,38 @@ def test_ha_leader_failover(ha_cluster):
     assert ok
     # data from before the failover is still readable
     assert op.read_file(new_leader.url, fid) == b"pre-failover"
+
+
+def test_ha_watch_survives_failover(ha_cluster):
+    """A vid map polling a FOLLOWER (forwarded to the leader) must
+    recover routes after the leader dies: the new leader's fresh hub
+    forces an epoch reset and the rebuilt registration flows back."""
+    masters, vs = ha_cluster
+    leader = _wait_http_leader(masters)
+    vs.start()
+    time.sleep(2.5)
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.client.vid_map import VidMap
+    fid = op.upload_data(leader.url, b"watched-ha", filename="w.bin")
+    vid = int(fid.split(",")[0])
+
+    follower = next(m for m in masters if m is not leader)
+    vm = VidMap(follower.url).start()
+    deadline = time.time() + 10
+    while time.time() < deadline and vm.lookup(vid) is None:
+        time.sleep(0.2)
+    assert vm.lookup(vid) == [vs.url]
+
+    survivors = [m for m in masters if m is not leader]
+    leader.stop()
+    _wait_http_leader(masters, alive=survivors, timeout=15.0)
+    # the volume server re-registers with the new leader; the vid map's
+    # next poll forwards there, sees an epoch regression, resets, and
+    # serves the route again
+    deadline = time.time() + 20
+    ok = False
+    while time.time() < deadline and not ok:
+        ok = vm.lookup(vid) == [vs.url]
+        time.sleep(0.3)
+    assert ok, "vid map never recovered after leader failover"
+    vm.stop()
