@@ -174,6 +174,13 @@ class S3ApiServer:
                 self.filer.find_entry(self._bucket_path(bucket))
                 return Response(b"", 200)
             if m == "GET":
+                if "location" in q:
+                    # GetBucketLocation: clients (SDK region probes)
+                    # expect an empty LocationConstraint for us-east-1
+                    self._check(ident, ACTION_READ, bucket)
+                    self.filer.find_entry(self._bucket_path(bucket))
+                    root = ET.Element("LocationConstraint")
+                    return _xml(root)
                 if "uploads" in q:
                     self._check(ident, ACTION_LIST, bucket)
                     return self.list_multipart_uploads(bucket)

@@ -259,12 +259,26 @@ class MasterServer:
         expired = []
         if reap_ttl:
             for vid, urls in self._ttl_expired_volumes():
-                # unroute FIRST: assigns/lookups must stop returning the
-                # volume before any replica is destroyed, or a fid can
-                # be handed out for a volume dying under it
+                # stop assigns FIRST (readonly in every layout) so no
+                # fid can be handed out for a volume dying under it —
+                # but keep the registration until each replica's delete
+                # actually succeeds: a popped-but-undeleted volume would
+                # be orphaned forever (delta heartbeats only resend
+                # CHANGED volumes, so the master would never relearn it)
                 with self.topology.lock:
-                    for node in self.topology.all_nodes():
-                        if node.url not in urls:
+                    for layout in self.topology.layouts.values():
+                        layout.set_volume_readonly(vid, True)
+                reaped = []
+                for u in urls:
+                    try:
+                        post_json(f"http://{u}/admin/delete_volume"
+                                  f"?volume={vid}")
+                    except HttpError:
+                        continue  # still registered: retried next pass
+                    reaped.append(u)
+                    with self.topology.lock:
+                        node = self.topology.find_node(u)
+                        if node is None:
                             continue
                         node.volumes.pop(vid, None)
                         for layout in self.topology.layouts.values():
@@ -273,13 +287,8 @@ class MasterServer:
                             self.topology.location_listener(
                                 "deleted", vid, node.url,
                                 node.public_url)
-                for u in urls:
-                    try:
-                        post_json(f"http://{u}/admin/delete_volume"
-                                  f"?volume={vid}")
-                    except HttpError:
-                        pass
-                expired.append(vid)
+                if reaped:
+                    expired.append(vid)
         return {"vacuumed": results, "ttl_expired": expired}
 
     def _vacuum_loop(self):

@@ -768,6 +768,18 @@ class VolumeServer:
             # payload is a chunk-manifest JSON (reference
             # needle_parse_upload.go: FormValue("cm") sets the flag)
             n.set_is_chunk_manifest()
+        # Seaweed-* headers ride with the needle as key/value pairs
+        # (reference needle_parse_upload.go parsePairs; the uint16
+        # PairsSize field caps them — oversize is an ERROR, silently
+        # dropping metadata while returning 200 would lie to the client)
+        pairs = {k: v for k, v in req.headers.items()
+                 if k.lower().startswith("seaweed-")}
+        if pairs:
+            import json as _json
+            blob = _json.dumps(pairs).encode()
+            if len(blob) >= 65536:
+                raise HttpError(400, "Seaweed-* pairs exceed 64KB")
+            n.set_pairs(blob)
         from ..storage.types import TTL
         ttl = TTL.parse(req.query.get("ttl", ""))
         if ttl.to_uint32():
@@ -791,18 +803,22 @@ class VolumeServer:
 
             # payload-shaping params must survive the hop: cm marks the
             # manifest flag (a replica missing it would serve raw JSON
-            # and never cascade deletes), ttl stamps per-needle expiry
+            # and never cascade deletes), ttl stamps per-needle expiry,
+            # Seaweed-* headers carry the needle's metadata pairs
             extra_q = ""
             if req.query.get("cm") == "true":
                 extra_q += "&cm=true"
             if req.query.get("ttl"):
                 extra_q += f"&ttl={req.query['ttl']}"
+            pair_headers = {k: v for k, v in req.headers.items()
+                            if k.lower().startswith("seaweed-")} or None
 
             def replicate(node_url: str):
                 post_multipart(
                     f"http://{node_url}{req.path}?type=replicate{jwt_q}"
                     f"{extra_q}",
-                    filename, data, ctype or "application/octet-stream")
+                    filename, data, ctype or "application/octet-stream",
+                    headers=pair_headers)
 
             failed = [
                 f"{node_url}: {exc.message or exc.status}"
@@ -870,6 +886,15 @@ class VolumeServer:
             else "application/octet-stream"
         headers = {"Etag": f'"{got.etag}"',
                    "Accept-Ranges": "bytes"}
+        if got.has_pairs() and got.pairs:
+            # stored Seaweed-* pairs come back as response headers
+            # (reference volume_server_handlers_read.go SetEtag + pairs)
+            import json as _json
+            try:
+                for pk, pv in _json.loads(got.pairs.decode()).items():
+                    headers[pk] = pv
+            except (ValueError, AttributeError):
+                pass
         if got.has_name():
             headers["Content-Disposition"] = \
                 f'inline; filename="{got.name.decode("utf-8", "replace")}"'

@@ -135,3 +135,53 @@ def test_ec_encode_spread_and_degraded_read(cluster, tmp_path):
     out = get_json(f"http://{master.url}/cluster/ec_lookup?volumeId={vid}")
     holders = {u for urls in out["shards"].values() for u in urls}
     assert holders == {src.url, dst.url}
+
+
+def test_seaweed_pairs_roundtrip(cluster):
+    """Seaweed-* headers persist with the needle and come back on reads
+    (reference needle_parse_upload.go parsePairs)."""
+    master, servers = cluster
+    a = op.assign(master.url)
+    from seaweedfs_tpu.server.http_util import post_multipart
+    post_multipart(f"http://{a['url']}/{a['fid']}", "p.bin", b"pair-data",
+                   headers={"Seaweed-Owner": "alice",
+                            "Seaweed-Tag": "hot"})
+    import urllib.request
+    req = urllib.request.Request(f"http://{a['url']}/{a['fid']}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read()
+        assert body == b"pair-data"
+        assert resp.headers.get("Seaweed-Owner") == "alice"
+        assert resp.headers.get("Seaweed-Tag") == "hot"
+
+
+def test_seaweed_pairs_replicate(cluster):
+    """Pairs must survive the replica hop: a read served by either
+    replica returns the same Seaweed-* headers."""
+    master, servers = cluster
+    a = op.assign(master.url, replication="001")
+    from seaweedfs_tpu.server.http_util import post_multipart
+    post_multipart(f"http://{a['url']}/{a['fid']}", "r.bin", b"rep",
+                   headers={"Seaweed-Team": "storage"})
+    vid = int(a["fid"].split(",")[0])
+    import urllib.request
+    for u in op.lookup(master.url, vid):
+        req = urllib.request.Request(f"http://{u}/{a['fid']}")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers.get("Seaweed-Team") == "storage", u
+
+
+def test_get_bucket_location():
+    import xml.etree.ElementTree as ET
+    from seaweedfs_tpu.filer import Filer, MemoryStore
+    from seaweedfs_tpu.s3 import S3ApiServer
+    store = MemoryStore()
+    store.initialize()
+    s3 = S3ApiServer(Filer(store), "127.0.0.1:0", port=0).start()
+    try:
+        http_call("PUT", f"http://{s3.url}/bkt")
+        out = http_call("GET", f"http://{s3.url}/bkt?location")
+        root = ET.fromstring(out)
+        assert "LocationConstraint" in root.tag
+    finally:
+        s3.stop()
