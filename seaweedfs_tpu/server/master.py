@@ -109,12 +109,26 @@ class MasterServer:
                 import tempfile
                 raft_dir = os.path.join(tempfile.gettempdir(),
                                         "weed-tpu-raft")
-            self.raft = RaftNode(self.url, peer_list, self._apply_raft,
-                                 state_dir=raft_dir)
+            # snapshots must capture only COMMITTED state:
+            # topology.max_volume_id is bumped optimistically before
+            # propose (and rolled back on failure), so it can briefly
+            # exceed any committed entry — _raft_committed_max_vid
+            # tracks the apply stream instead
+            self._raft_committed_max_vid = 0
+            self.raft = RaftNode(
+                self.url, peer_list, self._apply_raft,
+                state_dir=raft_dir,
+                snapshot_state_fn=lambda: {
+                    "max_volume_id": self._raft_committed_max_vid},
+                restore_fn=lambda st: self._apply_raft(
+                    {"type": "max_volume_id",
+                     "value": int(st.get("max_volume_id", 0))}))
             router.add("POST", "/raft/request_vote",
                        self.raft_request_vote)
             router.add("POST", "/raft/append_entries",
                        self.raft_append_entries)
+            router.add("POST", "/raft/install_snapshot",
+                       self.raft_install_snapshot)
             router.add("GET", "/raft/status", self.raft_status)
 
     # -- raft glue ---------------------------------------------------------
@@ -122,15 +136,21 @@ class MasterServer:
         """Apply a committed raft command (reference
         topology/cluster_commands.go MaxVolumeIdCommand)."""
         if command.get("type") == "max_volume_id":
+            value = int(command["value"])
+            self._raft_committed_max_vid = max(
+                getattr(self, "_raft_committed_max_vid", 0), value)
             with self.topology.lock:
                 self.topology.max_volume_id = max(
-                    self.topology.max_volume_id, int(command["value"]))
+                    self.topology.max_volume_id, value)
 
     def raft_request_vote(self, req: Request):
         return self.raft.handle_request_vote(req.json())
 
     def raft_append_entries(self, req: Request):
         return self.raft.handle_append_entries(req.json())
+
+    def raft_install_snapshot(self, req: Request):
+        return self.raft.handle_install_snapshot(req.json())
 
     def raft_status(self, req: Request):
         return self.raft.status()

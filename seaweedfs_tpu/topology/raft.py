@@ -9,8 +9,11 @@ transport — no external coordination service.
 
 Scope notes (matching the reference's usage, not full Raft):
   * fixed membership (the -peers list), no joint consensus
-  * no log compaction/snapshotting — the log holds max-volume-id bumps,
-    which are tiny and bounded by volume-creation rate
+  * log compaction via state snapshots: applied prefixes collapse into
+    a snapshot of the (tiny) state machine once the log passes
+    max_log_entries, with an InstallSnapshot RPC for peers whose
+    next_index has fallen off the retained suffix — without this every
+    proposal re-persists an ever-growing log (O(n) per volume creation)
 """
 
 from __future__ import annotations
@@ -66,28 +69,41 @@ class RaftNode:
     def __init__(self, node_id: str, peers: List[str],
                  apply_fn: Callable[[dict], None],
                  state_dir: Optional[str] = None,
-                 transport: Optional[Callable] = None):
+                 transport: Optional[Callable] = None,
+                 snapshot_state_fn: Optional[Callable[[], dict]] = None,
+                 restore_fn: Optional[Callable[[dict], None]] = None,
+                 max_log_entries: int = 1024):
         """node_id and peers are master urls (host:port). apply_fn is
         called exactly once per committed command, in log order.
         transport(peer, rpc_name, payload) -> reply dict; the default
-        POSTs to http://<peer>/raft/<rpc_name>."""
+        POSTs to http://<peer>/raft/<rpc_name>. snapshot_state_fn()
+        captures the applied state machine for log compaction;
+        restore_fn(state) reinstalls it on a follower receiving an
+        InstallSnapshot. Without them the log is kept whole."""
         self.id = node_id
         self.peers = [p for p in peers if not same_node(p, node_id)]
         self.apply_fn = apply_fn
         self.state_dir = state_dir
         self.transport = transport or self._http_transport
+        self.snapshot_state_fn = snapshot_state_fn
+        self.restore_fn = restore_fn
+        self.max_log_entries = int(max_log_entries)
 
         # persistent state
         self.current_term = 0
         self.voted_for: Optional[str] = None
         self.log: List[dict] = []        # {"term": t, "command": {...}}
+        # compaction base: entries 1..snap_index live only as snap_state
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snap_state: Optional[dict] = None
         self._load_state()
 
         # volatile
         self.state = FOLLOWER
         self.leader_id: Optional[str] = None
-        self.commit_index = 0            # 1-based; 0 = nothing
-        self.last_applied = 0
+        self.commit_index = self.snap_index  # 1-based; 0 = nothing
+        self.last_applied = self.snap_index
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
 
@@ -116,6 +132,46 @@ class RaftNode:
         with self.lock:
             return self.id if self.state == LEADER else self.leader_id
 
+    # -- log indexing over the snapshot base -------------------------------
+    def _last_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def _entry(self, index: int) -> dict:
+        return self.log[index - self.snap_index - 1]
+
+    def _term_at(self, index: int) -> int:
+        if index == self.snap_index:
+            return self.snap_term
+        if index < self.snap_index or index > self._last_index():
+            return 0
+        return self._entry(index)["term"]
+
+    def _maybe_compact(self):
+        """Collapse the applied prefix into a snapshot (call with the
+        lock held). The cut is ALWAYS exactly last_applied — the state
+        captured by snapshot_state_fn corresponds to precisely that
+        apply point, so restore+replay applies every command exactly
+        once. A leader therefore either waits for a slightly-behind
+        peer (keeps the entries it still needs) or compacts past a
+        badly-lagging one, which then catches up via InstallSnapshot."""
+        if self.snapshot_state_fn is None:
+            return
+        if len(self.log) <= self.max_log_entries:
+            return
+        cut_to = self.last_applied
+        if cut_to <= self.snap_index:
+            return
+        if self.state == LEADER and self.peers:
+            floor = min(self.match_index.get(p, 0) for p in self.peers)
+            if cut_to > floor and \
+                    self._last_index() - floor <= self.max_log_entries:
+                return  # peer is close: keep its entries, cut later
+        self.snap_term = self._term_at(cut_to)
+        self.snap_state = self.snapshot_state_fn()
+        self.log = self.log[cut_to - self.snap_index:]
+        self.snap_index = cut_to
+        self._persist()
+
     # -- persistence -------------------------------------------------------
     def _state_path(self) -> str:
         safe = self.id.replace(":", "_").replace("/", "_")
@@ -133,6 +189,12 @@ class RaftNode:
                 self.current_term = st.get("term", 0)
                 self.voted_for = st.get("voted_for")
                 self.log = st.get("log", [])
+                self.snap_index = st.get("snap_index", 0)
+                self.snap_term = st.get("snap_term", 0)
+                self.snap_state = st.get("snap_state")
+                if self.snap_state is not None and \
+                        self.restore_fn is not None:
+                    self.restore_fn(self.snap_state)
             except (ValueError, OSError):
                 pass
 
@@ -144,7 +206,10 @@ class RaftNode:
         with open(tmp, "w") as f:
             json.dump({"term": self.current_term,
                        "voted_for": self.voted_for,
-                       "log": self.log}, f)
+                       "log": self.log,
+                       "snap_index": self.snap_index,
+                       "snap_term": self.snap_term,
+                       "snap_state": self.snap_state}, f)
         os.replace(tmp, p)
 
     # -- timers ------------------------------------------------------------
@@ -170,8 +235,8 @@ class RaftNode:
             self.leader_id = None
             self._persist()
             term = self.current_term
-            last_index = len(self.log)
-            last_term = self.log[-1]["term"] if self.log else 0
+            last_index = self._last_index()
+            last_term = self._term_at(last_index)
             self._election_deadline = self._new_deadline()
         # solicit votes in parallel — serial RPCs against a dead peer
         # would stall past the election timeout and flap leadership
@@ -209,7 +274,7 @@ class RaftNode:
                     and votes * 2 > len(self.peers) + 1:
                 self.state = LEADER
                 self.leader_id = self.id
-                nxt = len(self.log) + 1
+                nxt = self._last_index() + 1
                 self.next_index = {p: nxt for p in self.peers}
                 self.match_index = {p: 0 for p in self.peers}
         if self.is_leader:
@@ -240,6 +305,13 @@ class RaftNode:
                 try:
                     self._replicate_to(p)
                     self._advance_commit()
+                    # compaction waits for close peers; the moment their
+                    # match_index catches up (this ack) the deferred cut
+                    # can proceed — without this hook a burst of
+                    # proposes never compacts (each commit fires while
+                    # the slowest ack is still one step behind)
+                    with self.lock:
+                        self._maybe_compact()
                 finally:
                     with self.lock:
                         self._inflight[p] = False
@@ -250,12 +322,34 @@ class RaftNode:
             if self.state != LEADER:
                 return
             term = self.current_term
-            nxt = self.next_index.get(peer, len(self.log) + 1)
-            prev_index = nxt - 1
-            prev_term = self.log[prev_index - 1]["term"] \
-                if prev_index >= 1 else 0
-            entries = self.log[nxt - 1:]
-            commit = self.commit_index
+            nxt = self.next_index.get(peer, self._last_index() + 1)
+            if nxt <= self.snap_index:
+                # the peer needs entries we compacted away: ship the
+                # snapshot instead, then resume from its last index
+                snap = {"term": term, "leader_id": self.id,
+                        "snap_index": self.snap_index,
+                        "snap_term": self.snap_term,
+                        "state": self.snap_state}
+            else:
+                snap = None
+                prev_index = nxt - 1
+                prev_term = self._term_at(prev_index)
+                entries = self.log[nxt - self.snap_index - 1:]
+                commit = self.commit_index
+        if snap is not None:
+            reply = self._rpc(peer, "install_snapshot", snap)
+            if reply is None:
+                return
+            with self.lock:
+                if reply["term"] > self.current_term:
+                    self._become_follower(reply["term"], None)
+                    return
+                if self.state != LEADER or self.current_term != term:
+                    return
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), snap["snap_index"])
+                self.next_index[peer] = self.match_index[peer] + 1
+            return
         reply = self._rpc(peer, "append_entries", {
             "term": term, "leader_id": self.id,
             "prev_log_index": prev_index, "prev_log_term": prev_term,
@@ -278,8 +372,8 @@ class RaftNode:
         with self.lock:
             if self.state != LEADER:
                 return
-            for n in range(len(self.log), self.commit_index, -1):
-                if self.log[n - 1]["term"] != self.current_term:
+            for n in range(self._last_index(), self.commit_index, -1):
+                if self._term_at(n) != self.current_term:
                     break
                 replicas = 1 + sum(1 for p in self.peers
                                    if self.match_index.get(p, 0) >= n)
@@ -292,7 +386,8 @@ class RaftNode:
     def _apply_committed(self):
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            self.apply_fn(self.log[self.last_applied - 1]["command"])
+            self.apply_fn(self._entry(self.last_applied)["command"])
+        self._maybe_compact()
 
     # -- public write path -------------------------------------------------
     def propose(self, command: dict, timeout: float = 5.0) -> int:
@@ -304,7 +399,7 @@ class RaftNode:
             self.log.append({"term": self.current_term,
                              "command": command})
             self._persist()
-            index = len(self.log)
+            index = self._last_index()
         if not self.peers:                  # single-node cluster
             with self.lock:
                 self.commit_index = index
@@ -332,11 +427,12 @@ class RaftNode:
             granted = False
             if term == self.current_term and \
                     self.voted_for in (None, req["candidate_id"]):
-                my_last_term = self.log[-1]["term"] if self.log else 0
+                my_last = self._last_index()
+                my_last_term = self._term_at(my_last)
                 up_to_date = (
                     req["last_log_term"] > my_last_term or
                     (req["last_log_term"] == my_last_term and
-                     req["last_log_index"] >= len(self.log)))
+                     req["last_log_index"] >= my_last))
                 if up_to_date:
                     granted = True
                     self.voted_for = req["candidate_id"]
@@ -355,11 +451,25 @@ class RaftNode:
                 return {"term": self.current_term, "success": True}
             self._become_follower(term, req["leader_id"])
             prev = req["prev_log_index"]
-            if prev > len(self.log) or (
-                    prev >= 1 and
-                    self.log[prev - 1]["term"] != req["prev_log_term"]):
-                return {"term": self.current_term, "success": False}
             entries = req["entries"]
+            clamped = False
+            if prev < self.snap_index:
+                # the window starts inside our compacted prefix — those
+                # entries are committed state here; skip past them. The
+                # leader's prev_log_term describes its ORIGINAL prev
+                # index, not the clamped boundary, so no term check
+                # applies after clamping (the boundary is our own
+                # committed snapshot by definition) — comparing would
+                # wrongly reject every retransmission and walk
+                # next_index backwards forever.
+                skip = self.snap_index - prev
+                entries = entries[skip:] if skip < len(entries) else []
+                prev = self.snap_index
+                clamped = True
+            if prev > self._last_index() or (
+                    not clamped and prev > 0 and
+                    self._term_at(prev) != req.get("prev_log_term", 0)):
+                return {"term": self.current_term, "success": False}
             if entries:
                 # Raft §5.3: truncate only from the first index where the
                 # terms conflict, then append the genuinely new suffix — a
@@ -368,10 +478,10 @@ class RaftNode:
                 # already acknowledged (possibly committed)
                 changed = False
                 for i, e in enumerate(entries):
-                    idx = prev + i  # 0-based slot of this entry
-                    if idx < len(self.log):
-                        if self.log[idx]["term"] != e["term"]:
-                            self.log = self.log[:idx] + entries[i:]
+                    pos = prev + i - self.snap_index  # 0-based log slot
+                    if pos < len(self.log):
+                        if self.log[pos]["term"] != e["term"]:
+                            self.log = self.log[:pos] + entries[i:]
                             changed = True
                             break
                     else:
@@ -382,8 +492,40 @@ class RaftNode:
                     self._persist()
             if req["leader_commit"] > self.commit_index:
                 self.commit_index = min(req["leader_commit"],
-                                        len(self.log))
+                                        self._last_index())
                 self._apply_committed()
+            return {"term": self.current_term, "success": True}
+
+    def handle_install_snapshot(self, req: dict) -> dict:
+        """Reinstall a compacted leader's state (Raft §7 InstallSnapshot,
+        minimal form: the whole state machine rides in one message —
+        it is a single counter here)."""
+        with self.lock:
+            term = req["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            self._become_follower(term, req["leader_id"])
+            snap_index = int(req["snap_index"])
+            snap_term = int(req["snap_term"])
+            if snap_index <= self.snap_index:
+                return {"term": self.current_term, "success": True}
+            if snap_index < self._last_index() and \
+                    self._term_at(snap_index) == snap_term:
+                # our suffix continues the snapshot's branch: keep it
+                self.log = self.log[snap_index - self.snap_index:]
+            else:
+                # conflicting (stale-branch) or absent suffix: Raft §7
+                # discards the entire log — stitching a different
+                # branch past the boundary fabricates an impossible log
+                self.log = []
+            self.snap_index = snap_index
+            self.snap_term = int(req["snap_term"])
+            self.snap_state = req.get("state")
+            if self.snap_state is not None and self.restore_fn is not None:
+                self.restore_fn(self.snap_state)
+            self.commit_index = max(self.commit_index, snap_index)
+            self.last_applied = max(self.last_applied, snap_index)
+            self._persist()
             return {"term": self.current_term, "success": True}
 
     # -- transport ---------------------------------------------------------
@@ -403,5 +545,6 @@ class RaftNode:
                     "term": self.current_term,
                     "leader": self.leader(),
                     "log_length": len(self.log),
+                    "snap_index": self.snap_index,
                     "commit_index": self.commit_index,
                     "peers": self.peers}

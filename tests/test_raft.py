@@ -24,6 +24,8 @@ class Net:
         node = self.nodes[peer]
         if rpc == "request_vote":
             return node.handle_request_vote(payload)
+        if rpc == "install_snapshot":
+            return node.handle_install_snapshot(payload)
         return node.handle_append_entries(payload)
 
 
@@ -294,3 +296,88 @@ def test_ha_watch_survives_failover(ha_cluster):
         time.sleep(0.3)
     assert ok, "vid map never recovered after leader failover"
     vm.stop()
+
+
+def test_log_compaction_bounds_log_and_preserves_state():
+    """Past max_log_entries the applied prefix collapses into a
+    snapshot; committed state survives and the log stays bounded."""
+    net = Net()
+    ids = ["c0", "c1", "c2"]
+    state = {i: {"max": 0} for i in ids}
+
+    def apply_for(i):
+        def apply(cmd):
+            state[i]["max"] = max(state[i]["max"], cmd["value"])
+        return apply
+
+    def snap_for(i):
+        return lambda: dict(state[i])
+
+    def restore_for(i):
+        def restore(st):
+            state[i]["max"] = max(state[i]["max"], st.get("max", 0))
+        return restore
+
+    for i in ids:
+        net.nodes[i] = RaftNode(
+            i, ids, apply_for(i), transport=net.transport,
+            snapshot_state_fn=snap_for(i), restore_fn=restore_for(i),
+            max_log_entries=20)
+    for n in net.nodes.values():
+        n.start()
+    try:
+        leader = wait_leader(net)
+        for v in range(1, 121):
+            leader.propose({"value": v})
+        assert state[leader.id]["max"] == 120
+        assert len(leader.log) <= 40  # bounded (20 + slack pre-compact)
+        assert leader.snap_index > 0
+        # followers converge on the state and also stay bounded
+        deadline = time.time() + 8
+        while time.time() < deadline and not all(
+                state[i]["max"] == 120 for i in ids):
+            time.sleep(0.05)
+        assert all(state[i]["max"] == 120 for i in ids), state
+    finally:
+        stop_all(net)
+
+
+def test_lagging_follower_catches_up_via_snapshot():
+    """A follower down through many compactions must be restored by
+    InstallSnapshot, then follow the live log again."""
+    net = Net()
+    ids = ["s0", "s1", "s2"]
+    state = {i: {"max": 0} for i in ids}
+    for i in ids:
+        net.nodes[i] = RaftNode(
+            i, ids,
+            (lambda i=i: lambda cmd: state[i].__setitem__(
+                "max", max(state[i]["max"], cmd["value"])))(),
+            transport=net.transport,
+            snapshot_state_fn=(lambda i=i: lambda: dict(state[i]))(),
+            restore_fn=(lambda i=i: lambda st: state[i].__setitem__(
+                "max", max(state[i]["max"], st.get("max", 0))))(),
+            max_log_entries=10)
+    for n in net.nodes.values():
+        n.start()
+    try:
+        leader = wait_leader(net)
+        laggard = next(i for i in ids if i != leader.id)
+        net.down.add(laggard)
+        for v in range(1, 101):
+            leader.propose({"value": v})
+        assert leader.snap_index > 0
+        net.down.discard(laggard)
+        deadline = time.time() + 8
+        while time.time() < deadline and state[laggard]["max"] != 100:
+            time.sleep(0.05)
+        assert state[laggard]["max"] == 100
+        assert net.nodes[laggard].snap_index > 0
+        # and it keeps following ordinary appends afterwards
+        leader.propose({"value": 200})
+        deadline = time.time() + 5
+        while time.time() < deadline and state[laggard]["max"] != 200:
+            time.sleep(0.05)
+        assert state[laggard]["max"] == 200
+    finally:
+        stop_all(net)
