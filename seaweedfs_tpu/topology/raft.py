@@ -161,7 +161,14 @@ class RaftNode:
         cut_to = self.last_applied
         if cut_to <= self.snap_index:
             return
-        if self.state == LEADER and self.peers:
+        if self.state == LEADER and self.peers and \
+                len(self.log) <= 2 * self.max_log_entries:
+            # defer for a close peer — but only while the log stays
+            # bounded: under sustained writes a peer perpetually a few
+            # entries behind must not hold compaction (and the O(log)
+            # re-persist per propose) hostage forever. Past 2x the
+            # limit the cut proceeds and the peer catches up via
+            # InstallSnapshot.
             floor = min(self.match_index.get(p, 0) for p in self.peers)
             if cut_to > floor and \
                     self._last_index() - floor <= self.max_log_entries:
@@ -519,7 +526,7 @@ class RaftNode:
                 # branch past the boundary fabricates an impossible log
                 self.log = []
             self.snap_index = snap_index
-            self.snap_term = int(req["snap_term"])
+            self.snap_term = snap_term
             self.snap_state = req.get("state")
             if self.snap_state is not None and self.restore_fn is not None:
                 self.restore_fn(self.snap_state)

@@ -1,0 +1,148 @@
+"""Concurrency discipline (SURVEY §5.2): mixed threaded workloads must
+never corrupt data — the per-struct lock design is exercised the way
+Go's -race runs would in the reference."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NotFound, Volume, VolumeError
+
+
+def test_threaded_write_read_delete_volume(tmp_path):
+    """8 threads hammer one volume with disjoint key ranges; every
+    surviving needle reads back byte-exact after the storm, including
+    through a concurrent throttle-free compaction."""
+    v = Volume(str(tmp_path), "", 1, create=True)
+    n_threads, per_thread = 8, 60
+    rng = np.random.default_rng(0)
+    payload_pool = [rng.integers(0, 256, sz).astype(np.uint8).tobytes()
+                    for sz in (100, 3000, 40_000)]
+    expected = {}
+    exp_lock = threading.Lock()
+    errors = []
+
+    def worker(t):
+        try:
+            base = t * 1000
+            for i in range(per_thread):
+                nid = base + i
+                data = payload_pool[(t + i) % len(payload_pool)]
+                v.write_needle(Needle(id=nid, cookie=7, data=data))
+                with exp_lock:
+                    expected[nid] = data
+                if i % 7 == 3:  # delete some of our own
+                    v.delete_needle(Needle(id=nid, cookie=7))
+                    with exp_lock:
+                        del expected[nid]
+                if i % 11 == 5:  # read-back mid-storm
+                    got = v.read_needle(Needle(id=base, cookie=7))
+                    assert got.data == payload_pool[t % len(payload_pool)]
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append((t, e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    # compact mid-storm: snapshot copy + makeup-diff replay must fold
+    # in whatever the writers land meanwhile
+    compacted = []
+    try:
+        compacted.append(v.compact())
+        v.commit_compact()
+    except VolumeError:
+        pass  # a concurrent test-triggered compact would be rejected
+    for th in threads:
+        th.join(60)
+    assert not errors, errors[:3]
+    for nid, data in expected.items():
+        assert v.read_needle(Needle(id=nid, cookie=7)).data == data, nid
+    # deleted needles stay deleted across the compaction
+    for nid in range(0, n_threads * 1000, 1000):
+        gone = [k for k in range(nid, nid + per_thread)
+                if k not in expected]
+        for k in gone[:3]:
+            with pytest.raises(NotFound):
+                v.read_needle(Needle(id=k, cookie=7))
+    v.close()
+    # cold boot agrees byte-for-byte
+    v2 = Volume(str(tmp_path), "", 1)
+    for nid, data in list(expected.items())[:50]:
+        assert v2.read_needle(Needle(id=nid, cookie=7)).data == data
+    v2.close()
+
+
+@pytest.mark.parametrize("kind", ["compact", "sortedfile"])
+def test_threaded_needle_map_variants(tmp_path, kind):
+    """The numpy-backed maps keep their counters and contents sane under
+    concurrent put/get/delete from multiple threads (volume lock is held
+    by callers; this hammers the map through the volume API)."""
+    v = Volume(str(tmp_path), "", 2, create=True, index_kind=kind)
+    errors = []
+
+    def worker(t):
+        try:
+            rng = np.random.default_rng(t)
+            for i in range(80):
+                nid = t * 500 + i
+                v.write_needle(Needle(
+                    id=nid, cookie=1,
+                    data=rng.integers(0, 256, 500
+                                      ).astype(np.uint8).tobytes()))
+                if i % 3 == 0:
+                    v.read_needle(Needle(id=nid, cookie=1))
+                if i % 5 == 0:
+                    v.delete_needle(Needle(id=nid, cookie=1))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+    assert not errors, errors[:3]
+    live = 6 * 80 - 6 * len(range(0, 80, 5))
+    assert len(v.nm) == live
+    v.close()
+
+
+def test_threaded_filer_store_sharded(tmp_path):
+    """Concurrent inserts/lists/deletes across many directories on the
+    sharded store."""
+    from seaweedfs_tpu.filer import Entry, ShardedStore
+    s = ShardedStore()
+    s.initialize(path=str(tmp_path / "m"), shards=4)
+    errors = []
+
+    def worker(t):
+        try:
+            for i in range(50):
+                p = f"/d{t}/f{i}"
+                s.insert_entry(Entry(full_path=p))
+                if i % 4 == 0:
+                    assert s.find_entry(p) is not None
+                if i % 9 == 0:
+                    s.delete_entry(p)
+            s.list_directory_entries(f"/d{t}", "", False, 100)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+    assert not errors, errors[:3]
+    for t in range(8):
+        names = {e.name for e in
+                 s.list_directory_entries(f"/d{t}", "", False, 100)}
+        want = {f"f{i}" for i in range(50)} - \
+            {f"f{i}" for i in range(0, 50, 9)}
+        assert names == want, t
+    s.close()
