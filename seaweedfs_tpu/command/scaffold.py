@@ -89,7 +89,11 @@ SCAFFOLDS = {
 //   "memory"   in-process pub-sub (tests/replicator)
 //   "webhook"  POST JSON to any HTTP endpoint, options:
 //              url, timeout, retries, hmac_key (X-Seaweed-Signature)
-//   kafka/sqs/pubsub remain gated stubs (no broker SDKs here)
+//   "kafka"    classic-protocol producer (no SDK), options:
+//              hosts ("h1:9092,h2:9092"), topic, timeout, retries
+//   "aws_sqs"  SendMessage via the SQS query API (SigV4), options:
+//              queue_url, access_key, secret_key, region
+//   google_pub_sub/gocdk_pub_sub remain gated stubs (need OAuth2)
 {}
 """,
     "filer": """\

@@ -2,17 +2,23 @@
 
 Reference weed/notification/: a MessageQueue interface with
 implementations selected by notification.toml (kafka, aws_sqs,
-google_pub_sub, gocdk_pub_sub, log). Here: `log` (stderr/file) and
-`memory` (in-process, for tests and the replicator) are real; the
-cloud publishers are registered stubs that raise on use so config
-errors surface the same way the reference's missing-broker errors do.
+google_pub_sub, gocdk_pub_sub, log). Here: `log` (stderr/file),
+`memory` (in-process, for tests and the replicator), `webhook`
+(JSON POST), `kafka` (from-scratch classic-protocol producer,
+notification/kafka.py) and `aws_sqs` (SigV4-signed SendMessage) are
+real; the OAuth2-gated pubsub publishers are registered stubs that
+raise on use so config errors surface the same way the reference's
+missing-broker errors do.
 """
 
 from .queues import (  # noqa: F401
     PUBLISHERS,
+    KafkaPublisher,
     LogPublisher,
     MemoryPublisher,
     Publisher,
+    SqsPublisher,
     StubPublisher,
+    WebhookPublisher,
     make_publisher,
 )

@@ -1,0 +1,309 @@
+"""Minimal Kafka wire-protocol producer (no SDK).
+
+The reference ships a Kafka notification backend
+(reference weed/notification/kafka/kafka_queue.go via the sarama client);
+this is a from-scratch produce-only client speaking the classic binary
+protocol over TCP — Metadata v0 (api_key 3) to discover partition
+leaders, Produce v0 (api_key 0) with message-format-v0 sets to publish —
+so filer metadata events can land in any broker that accepts the classic
+protocol (Kafka <= 3.x, Redpanda), with zero dependencies.
+
+Kept deliberately at protocol v0: the framing is stable, every broker
+generation that predates KIP-896 accepts it, and the publisher's job is
+an at-least-once event firehose, not a transactional producer.
+
+Wire shapes (big-endian):
+  frame    = int32 size | payload
+  request  = int16 api_key | int16 api_version | int32 correlation_id
+           | STRING client_id | body
+  response = int32 correlation_id | body
+  STRING   = int16 len | bytes          (-1 = null)
+  BYTES    = int32 len | bytes          (-1 = null)
+  message  = int64 offset | int32 size | uint32 crc | int8 magic(0)
+           | int8 attrs(0) | BYTES key | BYTES value
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+API_PRODUCE = 0
+API_METADATA = 3
+
+# error codes that a metadata refresh + retry can fix
+_RETRIABLE = {3, 5, 6, 7}  # unknown topic/partition, leader not
+# available, not leader for partition, request timed out
+
+
+class KafkaError(Exception):
+    pass
+
+
+def _str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    """Cursor over a response payload."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise KafkaError("short response")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        return self._take(n).decode()
+
+
+def encode_message_set(pairs: List[Tuple[Optional[bytes], bytes]]) -> bytes:
+    """Message-format-v0 set: one (key, value) message per pair."""
+    out = []
+    for key, value in pairs:
+        body = struct.pack(">bb", 0, 0) + _bytes(key) + _bytes(value)
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        msg = struct.pack(">I", crc) + body
+        out.append(struct.pack(">qi", 0, len(msg)) + msg)
+    return b"".join(out)
+
+
+class KafkaProducer:
+    """Produce-only client: leader discovery, per-key partitioning,
+    retry with metadata refresh on retriable errors."""
+
+    def __init__(self, bootstrap: str, client_id: str = "seaweedfs",
+                 timeout: float = 10.0, acks: int = 1, retries: int = 3):
+        # bootstrap: "host:port" or comma-separated list
+        self.seeds = []
+        for hp in bootstrap.split(","):
+            host, _, port = hp.strip().rpartition(":")
+            self.seeds.append((host, int(port)))
+        if not self.seeds:
+            raise ValueError("kafka producer needs bootstrap host:port")
+        self.client_id = client_id
+        self.timeout = float(timeout)
+        self.acks = int(acks)
+        self.retries = max(1, int(retries))
+        self._corr = 0
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        # topic -> {partition: (host, port)} (leaderless partitions absent)
+        self._leaders: Dict[str, Dict[int, Tuple[str, int]]] = {}
+        # topic -> total partition count (incl. leaderless — the key->
+        # partition mapping must be stable across leader elections)
+        self._npartitions: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- transport --------------------------------------------------------
+
+    def _conn(self, addr: Tuple[str, int]) -> socket.socket:
+        sock = self._conns.get(addr)
+        if sock is not None:
+            return sock
+        sock = socket.create_connection(addr, timeout=self.timeout)
+        sock.settimeout(self.timeout)
+        self._conns[addr] = sock
+        return sock
+
+    def _drop_conn(self, addr: Tuple[str, int]):
+        sock = self._conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _call(self, addr: Tuple[str, int], api_key: int, body: bytes,
+              expect_response: bool = True) -> Optional[_Reader]:
+        self._corr += 1
+        corr = self._corr
+        header = struct.pack(">hhi", api_key, 0, corr) + _str(self.client_id)
+        frame = header + body
+        sock = self._conn(addr)
+        try:
+            sock.sendall(struct.pack(">i", len(frame)) + frame)
+            if not expect_response:
+                # produce with acks=0: the broker sends nothing back
+                return None
+            raw = self._recv_exact(sock, 4)
+            (size,) = struct.unpack(">i", raw)
+            if size < 4 or size > 64 << 20:
+                raise KafkaError(f"bad response size {size}")
+            payload = self._recv_exact(sock, size)
+        except (OSError, KafkaError):
+            self._drop_conn(addr)
+            raise
+        r = _Reader(payload)
+        got = r.i32()
+        if got != corr:
+            self._drop_conn(addr)
+            raise KafkaError(f"correlation mismatch {got} != {corr}")
+        return r
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n:
+            c = sock.recv(n)
+            if not c:
+                raise KafkaError("connection closed")
+            chunks.append(c)
+            n -= len(c)
+        return b"".join(chunks)
+
+    # -- metadata ---------------------------------------------------------
+
+    def _refresh_metadata(self, topic: str):
+        body = struct.pack(">i", 1) + _str(topic)
+        last: Exception = KafkaError("no seed brokers")
+        for addr in self.seeds:
+            try:
+                r = self._call(addr, API_METADATA, body)
+            except (OSError, KafkaError) as e:
+                last = e
+                continue
+            brokers: Dict[int, Tuple[str, int]] = {}
+            for _ in range(r.i32()):
+                node = r.i32()
+                host = r.string() or ""
+                port = r.i32()
+                brokers[node] = (host, port)
+            leaders: Dict[int, Tuple[str, int]] = {}
+            topic_err = 0
+            total = 0
+            for _ in range(r.i32()):
+                terr = r.i16()
+                tname = r.string()
+                parts = {}
+                nparts = r.i32()
+                for _ in range(nparts):
+                    perr = r.i16()
+                    pid = r.i32()
+                    leader = r.i32()
+                    for _ in range(r.i32()):  # replicas
+                        r.i32()
+                    for _ in range(r.i32()):  # isr
+                        r.i32()
+                    if perr in (0, 9) and leader in brokers:
+                        # 9 = replica-not-available: leader still usable
+                        parts[pid] = brokers[leader]
+                if tname == topic:
+                    topic_err = terr
+                    leaders = parts
+                    total = nparts
+            if topic_err not in (0, 5) and not leaders:
+                raise KafkaError(f"topic {topic!r}: broker error "
+                                 f"{topic_err}")
+            if leaders:
+                self._leaders[topic] = leaders
+                self._npartitions[topic] = total
+                return
+            last = KafkaError(f"no leaders for topic {topic!r}")
+        raise last
+
+    def _leader_for(self, topic: str, key: Optional[bytes]
+                    ) -> Tuple[int, Tuple[str, int]]:
+        parts = self._leaders.get(topic)
+        if not parts:
+            self._refresh_metadata(topic)
+            parts = self._leaders.get(topic) or {}
+        if not parts:
+            raise KafkaError(f"no partitions for topic {topic!r}")
+        total = self._npartitions.get(topic, len(parts))
+        if key is None:
+            # keyless: any currently-led partition will do
+            pids = sorted(parts)
+            pid = pids[int(time.monotonic() * 1000) % len(pids)]
+        else:
+            # keyed: hash over the TOTAL partition count so the key->
+            # partition mapping (and per-key ordering) is stable across
+            # leader elections; a leaderless target is a retriable
+            # condition, not a remap (sarama's hash partitioner errors
+            # the same way)
+            pid = zlib.crc32(key) % total
+            if pid not in parts:
+                raise KafkaError(
+                    f"partition {pid} of {topic!r} has no leader")
+        return pid, parts[pid]
+
+    # -- produce ----------------------------------------------------------
+
+    def send(self, topic: str, key: Optional[bytes], value: bytes) -> int:
+        """Publish one message; returns the broker-assigned base offset
+        (-1 with acks=0). Retries with a metadata refresh on leadership
+        errors — at-least-once, like the reference's sarama config."""
+        with self._lock:
+            last: Exception = KafkaError("unreachable")
+            for attempt in range(self.retries):
+                try:
+                    return self._send_once(topic, key, value)
+                except (OSError, KafkaError) as e:
+                    last = e
+                    self._leaders.pop(topic, None)
+                    if attempt + 1 < self.retries:
+                        time.sleep(min(0.1 * (2 ** attempt), 1.0))
+            raise KafkaError(
+                f"produce to {topic!r} failed after {self.retries} "
+                f"attempts: {last}")
+
+    def _send_once(self, topic: str, key: Optional[bytes],
+                   value: bytes) -> int:
+        pid, addr = self._leader_for(topic, key)
+        mset = encode_message_set([(key, value)])
+        body = (struct.pack(">hi", self.acks, int(self.timeout * 1000))
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">i", pid) + struct.pack(">i", len(mset))
+                + mset)
+        r = self._call(addr, API_PRODUCE, body,
+                       expect_response=self.acks != 0)
+        if self.acks == 0:
+            return -1
+        for _ in range(r.i32()):
+            r.string()  # topic name
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                offset = r.i64()
+                if err:
+                    if err in _RETRIABLE:
+                        raise KafkaError(f"retriable broker error {err}")
+                    raise KafkaError(
+                        f"produce failed: broker error {err}")
+                return offset
+        raise KafkaError("empty produce response")
+
+    def close(self):
+        with self._lock:
+            for addr in list(self._conns):
+                self._drop_conn(addr)

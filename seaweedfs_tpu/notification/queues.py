@@ -128,11 +128,119 @@ class WebhookPublisher(Publisher):
                            f"{attempt + 1} attempts: {last}")
 
 
+@register
+class KafkaPublisher(Publisher):
+    """Publish events to a Kafka topic over the classic binary protocol —
+    a from-scratch produce client (notification/kafka.py), no SDK.
+    Mirrors reference weed/notification/kafka/kafka_queue.go (sarama):
+    event key = file path (so per-path ordering holds within a
+    partition), value = JSON event."""
+
+    name = "kafka"
+
+    def initialize(self, hosts: str = "", topic: str = "seaweedfs_filer",
+                   timeout: float = 10.0, retries: int = 3, **options):
+        if not hosts:
+            raise ValueError("kafka publisher needs hosts (host:port[,..])")
+        from .kafka import KafkaProducer
+        self.topic = topic
+        self._producer = KafkaProducer(hosts, timeout=timeout,
+                                       retries=retries)
+
+    def send(self, key: str, event: dict) -> None:
+        import json
+        self._producer.send(self.topic, key.encode(),
+                            json.dumps({"key": key, "event": event},
+                                       sort_keys=True).encode())
+
+    def close(self):
+        self._producer.close()
+
+
+@register
+class SqsPublisher(Publisher):
+    """Publish events to an AWS SQS queue via the query API with SigV4
+    signing (reference weed/notification/aws_sqs/sqs_queue.go via the
+    AWS SDK; same SendMessage wire call, signed by our own s3/auth
+    primitives with service='sqs')."""
+
+    name = "aws_sqs"
+
+    def initialize(self, queue_url: str = "", access_key: str = "",
+                   secret_key: str = "", region: str = "us-east-1",
+                   timeout: float = 10.0, retries: int = 3, **options):
+        if not queue_url:
+            raise ValueError("aws_sqs publisher needs queue_url")
+        self.queue_url = queue_url
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout = float(timeout)
+        self.retries = max(1, int(retries))
+
+    def send(self, key: str, event: dict) -> None:
+        import datetime
+        import hashlib
+        import json
+        import time as _time
+        import urllib.parse
+        from ..s3.auth import (canonical_request, derive_signing_key,
+                               string_to_sign, _hmac)
+        from ..server.http_util import HttpError, http_call
+        body = urllib.parse.urlencode({
+            "Action": "SendMessage",
+            "MessageBody": json.dumps({"key": key, "event": event},
+                                      sort_keys=True),
+            "Version": "2012-11-05",
+        }).encode()
+        parsed = urllib.parse.urlparse(self.queue_url)
+        path = parsed.path or "/"
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date = now.strftime("%Y%m%d")
+        payload_hash = hashlib.sha256(body).hexdigest()
+        headers = {
+            "content-type": "application/x-www-form-urlencoded",
+            "host": parsed.netloc,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        signed = sorted(headers)
+        canon = canonical_request("POST", path, [], headers, signed,
+                                  payload_hash)
+        scope = f"{date}/{self.region}/sqs/aws4_request"
+        sts = string_to_sign(amz_date, scope, canon)
+        sig = _hmac(derive_signing_key(self.secret_key, date, self.region,
+                                       "sqs"), sts).hex()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        # same transport + retry discipline as WebhookPublisher:
+        # at-least-once against a fallible external endpoint
+        last = None
+        for attempt in range(self.retries):
+            try:
+                http_call("POST", self.queue_url, body, headers,
+                          timeout=self.timeout, external=True)
+                return
+            except HttpError as e:
+                last = e
+                if 400 <= e.status < 500 and e.status != 429:
+                    break
+            except Exception as e:  # noqa: BLE001 - network: retried
+                last = e
+            if attempt + 1 < self.retries:
+                _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+        raise RuntimeError(f"sqs {self.queue_url} failed after "
+                           f"{attempt + 1} attempts: {last}")
+
+
 class StubPublisher(Publisher):
-    """Placeholder for cloud brokers not present in this environment
-    (kafka/aws_sqs/google_pub_sub/gocdk_pub_sub). Configuring one fails
-    at first send with an actionable error, mirroring how the reference
-    fails when the broker endpoint is unreachable."""
+    """Placeholder for cloud brokers whose auth stack is not present in
+    this environment (google_pub_sub/gocdk_pub_sub need OAuth2 service
+    accounts). Configuring one fails at first send with an actionable
+    error, mirroring how the reference fails when the broker endpoint is
+    unreachable."""
 
     def send(self, key: str, event: dict) -> None:
         raise RuntimeError(
@@ -140,5 +248,5 @@ class StubPublisher(Publisher):
             f"broker that is not available in this environment")
 
 
-for _name in ("kafka", "aws_sqs", "google_pub_sub", "gocdk_pub_sub"):
+for _name in ("google_pub_sub", "gocdk_pub_sub"):
     register(type(f"Stub_{_name}", (StubPublisher,), {"name": _name}))

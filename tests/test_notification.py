@@ -72,11 +72,298 @@ def test_log_publisher_writes():
 
 
 def test_stub_publisher_raises():
-    p = make_publisher("kafka")
-    with pytest.raises(RuntimeError, match="kafka"):
+    p = make_publisher("google_pub_sub")
+    with pytest.raises(RuntimeError, match="google_pub_sub"):
         p.send("/k", {})
 
 
 def test_unknown_publisher():
     with pytest.raises(ValueError):
         make_publisher("nope")
+
+
+# -- Kafka wire-protocol producer (notification/kafka.py) -----------------
+
+import json  # noqa: E402
+import socket  # noqa: E402
+import struct  # noqa: E402
+
+from seaweedfs_tpu.notification.kafka import (  # noqa: E402
+    API_METADATA, API_PRODUCE, KafkaError, KafkaProducer, _Reader)
+
+
+class FakeBroker:
+    """Single-broker Kafka speaking Metadata v0 + Produce v0 — records
+    every produced (partition, key, value); can fail the first N produce
+    calls with NOT_LEADER_FOR_PARTITION to exercise the retry path."""
+
+    def __init__(self, topic="t", partitions=2, fail_first=0):
+        self.topic = topic
+        self.partitions = partitions
+        self.fail_first = fail_first
+        self.produced = []
+        self.next_offset = 0
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client, args=(conn,),
+                             daemon=True).start()
+
+    def _client(self, conn):
+        try:
+            while True:
+                raw = self._recv(conn, 4)
+                if raw is None:
+                    return
+                (size,) = struct.unpack(">i", raw)
+                payload = self._recv(conn, size)
+                if payload is None:
+                    return
+                r = _Reader(payload)
+                api, _ver, corr = r.i16(), r.i16(), r.i32()
+                r.string()  # client id
+                if api == API_METADATA:
+                    body = self._metadata()
+                elif api == API_PRODUCE:
+                    body = self._produce(r)
+                    if body is None:  # acks=0: no response on the wire
+                        continue
+                else:
+                    return
+                resp = struct.pack(">i", corr) + body
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv(conn, n):
+        chunks = []
+        while n:
+            c = conn.recv(n)
+            if not c:
+                return None
+            chunks.append(c)
+            n -= len(c)
+        return b"".join(chunks)
+
+    @staticmethod
+    def _s(s):
+        b = s.encode()
+        return struct.pack(">h", len(b)) + b
+
+    def _metadata(self):
+        out = [struct.pack(">i", 1),  # one broker
+               struct.pack(">i", 0), self._s("127.0.0.1"),
+               struct.pack(">i", self.port),
+               struct.pack(">i", 1),  # one topic
+               struct.pack(">h", 0), self._s(self.topic),
+               struct.pack(">i", self.partitions)]
+        for pid in range(self.partitions):
+            out.append(struct.pack(">hii", 0, pid, 0))  # err, pid, leader
+            out.append(struct.pack(">ii", 1, 0))        # replicas [0]
+            out.append(struct.pack(">ii", 1, 0))        # isr [0]
+        return b"".join(out)
+
+    def _produce(self, r):
+        acks = r.i16()
+        r.i32()  # timeout
+        parts_resp = []
+        for _ in range(r.i32()):
+            name = r.string()
+            for _ in range(r.i32()):
+                pid = r.i32()
+                mset = _Reader(r._take(r.i32()))
+                err = 0
+                if self.fail_first > 0:
+                    self.fail_first -= 1
+                    err = 6  # NOT_LEADER_FOR_PARTITION
+                else:
+                    while mset.pos < len(mset.buf):
+                        mset.i64()  # offset
+                        m = _Reader(mset._take(mset.i32()))
+                        m.i32()  # crc
+                        m._take(2)  # magic, attrs
+                        klen = m.i32()
+                        key = m._take(klen) if klen >= 0 else None
+                        vlen = m.i32()
+                        val = m._take(vlen) if vlen >= 0 else None
+                        self.produced.append((pid, key, val))
+                parts_resp.append(struct.pack(">ihq", pid, err,
+                                              self.next_offset))
+                self.next_offset += 1
+        if acks == 0:
+            return None
+        return (struct.pack(">i", 1) + self._s(name)
+                + struct.pack(">i", len(parts_resp))
+                + b"".join(parts_resp))
+
+
+def test_kafka_produce_roundtrip():
+    broker = FakeBroker(topic="events", partitions=3)
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5)
+        off = prod.send("events", b"/a/b", b'{"x":1}')
+        assert off >= 0
+        prod.send("events", b"/a/b", b'{"x":2}')
+        prod.close()
+    finally:
+        broker.stop()
+    assert len(broker.produced) == 2
+    # same key -> same partition, payloads intact and ordered
+    assert broker.produced[0][0] == broker.produced[1][0]
+    assert [v for _, _, v in broker.produced] == [b'{"x":1}', b'{"x":2}']
+
+
+def test_kafka_retries_on_not_leader():
+    broker = FakeBroker(topic="events", partitions=1, fail_first=1)
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5,
+                             retries=3)
+        prod.send("events", b"k", b"v")
+        prod.close()
+    finally:
+        broker.stop()
+    assert broker.produced == [(0, b"k", b"v")]
+
+
+def test_kafka_acks0_fire_and_forget():
+    broker = FakeBroker(topic="events", partitions=1)
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5,
+                             acks=0)
+        assert prod.send("events", b"k", b"v1") == -1
+        assert prod.send("events", b"k", b"v2") == -1
+        deadline = time.time() + 5
+        while time.time() < deadline and len(broker.produced) < 2:
+            time.sleep(0.05)
+        prod.close()
+    finally:
+        broker.stop()
+    assert [v for _, _, v in broker.produced] == [b"v1", b"v2"]
+
+
+def test_kafka_keyed_partition_stable_under_leaderless():
+    """The key->partition mapping hashes over the TOTAL partition count;
+    a leaderless target partition is a retriable error, never a remap."""
+    import zlib as _zlib
+    broker = FakeBroker(topic="events", partitions=3)
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5)
+        key = b"/some/path"
+        want_pid = _zlib.crc32(key) % 3
+        prod.send("events", key, b"v")
+        assert broker.produced[0][0] == want_pid
+        # simulate the target partition losing its leader: the producer
+        # must error (retriably), not silently reroute to another one
+        prod._leaders["events"] = {
+            p: a for p, a in prod._leaders["events"].items()
+            if p != want_pid}
+        prod._npartitions["events"] = 3
+        with pytest.raises(KafkaError, match="no leader"):
+            prod._send_once("events", key, b"v2")
+        prod.close()
+    finally:
+        broker.stop()
+
+
+def test_kafka_exhausted_retries_raise():
+    broker = FakeBroker(topic="events", partitions=1, fail_first=99)
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5,
+                             retries=2)
+        with pytest.raises(KafkaError, match="failed after 2"):
+            prod.send("events", b"k", b"v")
+        prod.close()
+    finally:
+        broker.stop()
+
+
+def test_kafka_publisher_end_to_end():
+    broker = FakeBroker(topic="seaweedfs_filer", partitions=2)
+    try:
+        p = make_publisher("kafka", hosts=f"127.0.0.1:{broker.port}")
+        p.send("/dir/file", {"new_entry": {"name": "file"}})
+        p.close()
+    finally:
+        broker.stop()
+    (pid, key, val), = broker.produced
+    assert key == b"/dir/file"
+    assert json.loads(val)["event"] == {"new_entry": {"name": "file"}}
+
+
+def test_sqs_publisher_signs_and_posts():
+    """Fake SQS endpoint: verifies the SigV4 signature (service=sqs)
+    against the same derivation the server side would run."""
+    import hashlib
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from seaweedfs_tpu.s3.auth import (canonical_request,
+                                       derive_signing_key,
+                                       string_to_sign, _hmac)
+
+    got = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            auth = self.headers["Authorization"]
+            # recompute the signature server-side
+            amz_date = self.headers["x-amz-date"]
+            date = amz_date[:8]
+            payload_hash = hashlib.sha256(body).hexdigest()
+            assert payload_hash == self.headers["x-amz-content-sha256"]
+            hdrs = {"content-type": self.headers["Content-Type"],
+                    "host": self.headers["Host"],
+                    "x-amz-content-sha256": payload_hash,
+                    "x-amz-date": amz_date}
+            canon = canonical_request("POST", self.path, [], hdrs,
+                                      sorted(hdrs), payload_hash)
+            scope = f"{date}/us-east-1/sqs/aws4_request"
+            sig = _hmac(derive_signing_key("sk", date, "us-east-1",
+                                           "sqs"),
+                        string_to_sign(amz_date, scope, canon)).hex()
+            got["sig_ok"] = f"Signature={sig}" in auth
+            got["body"] = body
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        p = make_publisher(
+            "aws_sqs",
+            queue_url=f"http://127.0.0.1:{srv.server_port}/123/q",
+            access_key="ak", secret_key="sk")
+        p.send("/k", {"n": 1})
+    finally:
+        srv.shutdown()
+    assert got["sig_ok"]
+    from urllib.parse import parse_qs
+    q = parse_qs(got["body"].decode())
+    assert q["Action"] == ["SendMessage"]
+    assert json.loads(q["MessageBody"][0])["key"] == "/k"
