@@ -56,8 +56,10 @@ SCAFFOLDS = {
     "master": "127.0.0.1:9333",
     "path": "/buckets"
   },
-// sink alternatives: "type": "filer" (below) or "type": "s3" with
-// endpoint/bucket/access_key/secret_key/directory keys
+// sink alternatives: "type": "filer" (below); "type": "s3" with
+// endpoint/bucket/access_key/secret_key/directory; "gcs"/"b2" (same
+// keys over their S3-interop APIs); "azure" with
+// account/account_key/container/directory (SharedKey Blob REST)
   "sink": {
     "type": "filer",
     "filer_url": "remote-filer:8888",

@@ -41,7 +41,13 @@ _RETRIABLE = {3, 5, 6, 7}  # unknown topic/partition, leader not
 
 
 class KafkaError(Exception):
-    pass
+    """retriable=False marks permanent broker verdicts (e.g.
+    MESSAGE_TOO_LARGE) that re-sending the same payload can never fix —
+    send() propagates those immediately instead of burning retries."""
+
+    def __init__(self, msg: str, retriable: bool = True):
+        super().__init__(msg)
+        self.retriable = retriable
 
 
 def _str(s: Optional[str]) -> bytes:
@@ -107,7 +113,13 @@ class KafkaProducer:
         # bootstrap: "host:port" or comma-separated list
         self.seeds = []
         for hp in bootstrap.split(","):
-            host, _, port = hp.strip().rpartition(":")
+            hp = hp.strip()
+            if not hp:
+                continue
+            host, _, port = hp.rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(
+                    f"bad kafka bootstrap entry {hp!r}: want host:port")
             self.seeds.append((host, int(port)))
         if not self.seeds:
             raise ValueError("kafka producer needs bootstrap host:port")
@@ -268,6 +280,8 @@ class KafkaProducer:
                 try:
                     return self._send_once(topic, key, value)
                 except (OSError, KafkaError) as e:
+                    if isinstance(e, KafkaError) and not e.retriable:
+                        raise  # permanent verdict: retrying can't help
                     last = e
                     self._leaders.pop(topic, None)
                     if attempt + 1 < self.retries:
@@ -299,7 +313,8 @@ class KafkaProducer:
                     if err in _RETRIABLE:
                         raise KafkaError(f"retriable broker error {err}")
                     raise KafkaError(
-                        f"produce failed: broker error {err}")
+                        f"produce failed: broker error {err}",
+                        retriable=False)
                 return offset
         raise KafkaError("empty produce response")
 

@@ -74,6 +74,31 @@ class MemoryPublisher(Publisher):
             fn(key, event)
 
 
+def _post_with_retries(url: str, body: bytes, headers: dict,
+                       timeout: float, retries: int, label: str) -> None:
+    """Shared external-POST discipline for HTTP-backed publishers:
+    retry with capped exponential backoff; 4xx (bar 429) short-circuits
+    — it can never succeed on retry."""
+    import time as _time
+    from ..server.http_util import HttpError, http_call
+    last = None
+    for attempt in range(retries):
+        try:
+            http_call("POST", url, body, headers, timeout=timeout,
+                      external=True)
+            return
+        except HttpError as e:
+            last = e
+            if 400 <= e.status < 500 and e.status != 429:
+                break
+        except Exception as e:  # noqa: BLE001 - network: retried
+            last = e
+        if attempt + 1 < retries:
+            _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+    raise RuntimeError(f"{label} {url} failed after "
+                       f"{attempt + 1} attempts: {last}")
+
+
 @register
 class WebhookPublisher(Publisher):
     """POST each metadata event as JSON to an HTTP endpoint — the
@@ -102,30 +127,13 @@ class WebhookPublisher(Publisher):
         import hashlib
         import hmac
         import json
-        import time as _time
-        from ..server.http_util import HttpError, http_call
         body = json.dumps({"key": key, "event": event}).encode()
         headers = {"Content-Type": "application/json"}
         if self.hmac_key:
             headers["X-Seaweed-Signature"] = hmac.new(
                 self.hmac_key.encode(), body, hashlib.sha256).hexdigest()
-        last = None
-        for attempt in range(self.retries):
-            try:
-                http_call("POST", self.url, body, headers,
-                          timeout=self.timeout, external=True)
-                return
-            except HttpError as e:
-                last = e
-                # 4xx (bar 429) can never succeed on retry
-                if 400 <= e.status < 500 and e.status != 429:
-                    break
-            except Exception as e:  # noqa: BLE001 - network: retried
-                last = e
-            if attempt + 1 < self.retries:
-                _time.sleep(min(0.2 * (2 ** attempt), 2.0))
-        raise RuntimeError(f"webhook {self.url} failed after "
-                           f"{attempt + 1} attempts: {last}")
+        _post_with_retries(self.url, body, headers, self.timeout,
+                           self.retries, "webhook")
 
 
 @register
@@ -182,11 +190,9 @@ class SqsPublisher(Publisher):
         import datetime
         import hashlib
         import json
-        import time as _time
         import urllib.parse
         from ..s3.auth import (canonical_request, derive_signing_key,
                                string_to_sign, _hmac)
-        from ..server.http_util import HttpError, http_call
         body = urllib.parse.urlencode({
             "Action": "SendMessage",
             "MessageBody": json.dumps({"key": key, "event": event},
@@ -215,24 +221,8 @@ class SqsPublisher(Publisher):
         headers["Authorization"] = (
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
             f"SignedHeaders={';'.join(signed)}, Signature={sig}")
-        # same transport + retry discipline as WebhookPublisher:
-        # at-least-once against a fallible external endpoint
-        last = None
-        for attempt in range(self.retries):
-            try:
-                http_call("POST", self.queue_url, body, headers,
-                          timeout=self.timeout, external=True)
-                return
-            except HttpError as e:
-                last = e
-                if 400 <= e.status < 500 and e.status != 429:
-                    break
-            except Exception as e:  # noqa: BLE001 - network: retried
-                last = e
-            if attempt + 1 < self.retries:
-                _time.sleep(min(0.2 * (2 ** attempt), 2.0))
-        raise RuntimeError(f"sqs {self.queue_url} failed after "
-                           f"{attempt + 1} attempts: {last}")
+        _post_with_retries(self.queue_url, body, headers, self.timeout,
+                           self.retries, "sqs")
 
 
 class StubPublisher(Publisher):

@@ -3,8 +3,11 @@
 FilerSink replicates into another filer cluster (the reference's
 filersink, the only sink with full fidelity there too); S3Sink writes
 objects to any S3-compatible endpoint through the same SigV4 client the
-tier backend uses. GCS/Azure/B2 exist for config parity but raise at
-construction — their SDKs are not in this build.
+tier backend uses; GCS and B2 ride their S3-interoperability APIs over
+the same client; AzureSink speaks the Blob REST API directly with
+SharedKey request signing (reference azuresink wraps the
+azure-storage-blob SDK; the wire calls here are the same PutBlob /
+DeleteBlob).
 """
 
 from __future__ import annotations
@@ -159,19 +162,135 @@ class B2Sink(S3Sink):
                          region=region)
 
 
-_SINKS = {"filer": FilerSink, "s3": S3Sink, "gcs": GcsSink, "b2": B2Sink}
+def azure_shared_key_signature(account: str, key_b64: str, method: str,
+                               path: str, headers: dict,
+                               query: dict) -> str:
+    """Azure Storage SharedKey string-to-sign + HMAC (the 2015+ scheme:
+    Content-Length is the empty string when 0). `headers` keys must be
+    lowercase; `path` is the URL path (/container/blob)."""
+    import base64
+    import hashlib
+    import hmac as _hmac
+
+    length = headers.get("content-length", "")
+    if length in ("0", 0):
+        length = ""
+    canon_headers = "".join(
+        f"{k}:{headers[k]}\n" for k in sorted(headers)
+        if k.startswith("x-ms-"))
+    canon_resource = f"/{account}{path}"
+    for k in sorted(query):
+        canon_resource += f"\n{k.lower()}:{query[k]}"
+    sts = "\n".join([
+        method.upper(),
+        headers.get("content-encoding", ""),
+        headers.get("content-language", ""),
+        str(length),
+        headers.get("content-md5", ""),
+        headers.get("content-type", ""),
+        headers.get("date", ""),
+        headers.get("if-modified-since", ""),
+        headers.get("if-match", ""),
+        headers.get("if-none-match", ""),
+        headers.get("if-unmodified-since", ""),
+        headers.get("range", ""),
+    ]) + "\n" + canon_headers + canon_resource
+    mac = _hmac.new(base64.b64decode(key_b64), sts.encode("utf-8"),
+                    hashlib.sha256).digest()
+    return base64.b64encode(mac).decode()
+
+
+class AzureSink(ReplicationSink):
+    """Replicate files as block blobs into an Azure Storage container —
+    Blob REST API with SharedKey auth, no SDK (reference azuresink's
+    CreateBlockBlobFromReader/DeleteBlob, sink/azuresink/azure_sink.go).
+    `endpoint` is overridable for Azurite or test doubles."""
+
+    kind = "azure"
+    api_version = "2020-10-02"
+
+    def __init__(self, account: str, account_key: str, container: str,
+                 directory: str = "", endpoint: str = ""):
+        self.account = account
+        self.account_key = account_key
+        self.container = container
+        self.directory = directory.strip("/")
+        self.endpoint = (endpoint.rstrip("/") or
+                         f"https://{account}.blob.core.windows.net")
+
+    def _blob_path(self, key: str) -> str:
+        import urllib.parse
+        key = key.lstrip("/")
+        if self.directory:
+            key = f"{self.directory}/{key}"
+        return f"/{self.container}/" + urllib.parse.quote(key)
+
+    def _request(self, method: str, path: str, body=None,
+                 content_type: str = "", blob_type: str = ""):
+        import email.utils
+        import urllib.request
+
+        body_file = body_len = None
+        if isinstance(body, tuple):
+            body_file, body_len = body
+        elif body is not None:
+            body_len = len(body)
+        headers = {
+            # formatdate, not strftime: RFC1123 day/month names must be
+            # English regardless of LC_TIME — the server validates this
+            # date as part of SharedKey auth
+            "x-ms-date": email.utils.formatdate(usegmt=True),
+            "x-ms-version": self.api_version,
+        }
+        if blob_type:
+            headers["x-ms-blob-type"] = blob_type
+        if content_type:
+            headers["content-type"] = content_type
+        if body_len is not None:
+            headers["content-length"] = str(body_len)
+        sig = azure_shared_key_signature(
+            self.account, self.account_key, method, path, headers, {})
+        headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+        data = body_file if body_file is not None else body
+        req = urllib.request.Request(self.endpoint + path, data=data,
+                                     method=method, headers=headers)
+        import urllib.error
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")[:200]
+            raise SinkError(
+                f"azure {method} {path}: {e.code} {detail}",
+            ) from None
+        except (urllib.error.URLError, OSError) as e:
+            raise SinkError(f"azure {method} {path}: {e}") from None
+
+    def create_entry(self, key: str, entry: dict, data):
+        if entry.get("IsDirectory"):
+            return                     # blob storage has no directories
+        mime = entry.get("Mime") or "application/octet-stream"
+        self._request("PUT", self._blob_path(key), _file_and_size(data),
+                      content_type=mime, blob_type="BlockBlob")
+
+    def delete_entry(self, key: str, is_directory: bool):
+        if is_directory:
+            return
+        try:
+            self._request("DELETE", self._blob_path(key))
+        except SinkError as e:
+            if " 404 " not in str(e) and "BlobNotFound" not in str(e):
+                raise
+
+
+_SINKS = {"filer": FilerSink, "s3": S3Sink, "gcs": GcsSink, "b2": B2Sink,
+          "azure": AzureSink}
 
 
 def make_sink(cfg: dict) -> ReplicationSink:
     """cfg = {"type": "filer", ...kwargs} (reference replication.toml
     [sink.<type>] sections)."""
     kind = cfg.get("type")
-    if kind == "azure":
-        # the lone sink with no S3-compatible endpoint; its SDK is not
-        # in this build (reference azuresink wraps azure-storage-blob)
-        raise SinkError(
-            "azure sink requires the Azure Blob SDK, which is not "
-            "available in this build; use the filer, s3, gcs or b2 sink")
     if kind not in _SINKS:
         raise SinkError(f"unknown sink type {kind!r}")
     kwargs = {k: v for k, v in cfg.items() if k != "type"}

@@ -299,6 +299,40 @@ def test_kafka_exhausted_retries_raise():
         broker.stop()
 
 
+def test_kafka_permanent_error_does_not_retry():
+    """A non-retriable broker verdict (e.g. MESSAGE_TOO_LARGE=10) must
+    propagate on the first attempt — re-sending the same payload can
+    never fix it."""
+    broker = FakeBroker(topic="events", partitions=1, fail_first=99)
+    broker_err = {"code": 10}
+    orig = FakeBroker._produce
+
+    def produce_permanent(self, r):
+        body = orig(self, r)
+        # rewrite the error code in the single partition response
+        return body[:-14] + struct.pack(">ihq", 0, broker_err["code"],
+                                        0)
+
+    broker._produce = produce_permanent.__get__(broker)
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5,
+                             retries=5)
+        with pytest.raises(KafkaError, match="broker error 10"):
+            prod.send("events", b"k", b"v")
+        prod.close()
+    finally:
+        broker.stop()
+    # exactly one attempt hit the broker (fail_first decremented once)
+    assert broker.fail_first == 98
+
+
+def test_kafka_bad_bootstrap_rejected():
+    with pytest.raises(ValueError, match="host:port"):
+        KafkaProducer("")
+    with pytest.raises(ValueError, match="host:port"):
+        KafkaProducer("hostonly")
+
+
 def test_kafka_publisher_end_to_end():
     broker = FakeBroker(topic="seaweedfs_filer", partitions=2)
     try:

@@ -160,12 +160,81 @@ def test_filer_to_s3_sink(stack, tmp_path):
 
 
 def test_unavailable_sinks_raise_cleanly():
-    # gcs/b2 became real S3-compatible sinks; azure (no S3 interop API)
-    # and unknown kinds must fail with a clear configuration error
+    # azure config missing its required fields, and unknown kinds, must
+    # fail with a clear configuration error
     with pytest.raises(SinkError, match="azure"):
         make_sink({"type": "azure"})
     with pytest.raises(SinkError):
         make_sink({"type": "ftp"})
+
+
+def test_azure_sink_shared_key_blob_roundtrip():
+    """Fake Azure Blob endpoint: verifies the SharedKey signature by
+    recomputing it server-side, stores PutBlob bodies, serves deletes —
+    the sink must create and delete blobs with valid auth."""
+    import base64
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from seaweedfs_tpu.replication.sink import (
+        AzureSink, azure_shared_key_signature)
+
+    account, key = "acct", base64.b64encode(b"topsecret").decode()
+    blobs, sigs_ok = {}, []
+
+    class Handler(BaseHTTPRequestHandler):
+        def _verify(self, method, body_len):
+            hdrs = {k.lower(): v for k, v in self.headers.items()
+                    if k.lower().startswith(("x-ms-", "content-"))}
+            if body_len:
+                hdrs["content-length"] = str(body_len)
+            want = azure_shared_key_signature(
+                account, key, method, self.path, hdrs, {})
+            sigs_ok.append(
+                self.headers["Authorization"]
+                == f"SharedKey {account}:{want}")
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            self._verify("PUT", n)
+            assert self.headers["x-ms-blob-type"] == "BlockBlob"
+            blobs[self.path] = body
+            self.send_response(201)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_DELETE(self):
+            self._verify("DELETE", 0)
+            if self.path in blobs:
+                del blobs[self.path]
+                self.send_response(202)
+            else:
+                self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        sink = make_sink({
+            "type": "azure", "account": account, "account_key": key,
+            "container": "backup", "directory": "mirror",
+            "endpoint": f"http://127.0.0.1:{srv.server_port}"})
+        assert isinstance(sink, AzureSink)
+        sink.create_entry("/docs/a.bin", {"Mime": "text/plain"},
+                          b"azure-bytes")
+        assert blobs == {"/backup/mirror/docs/a.bin": b"azure-bytes"}
+        sink.delete_entry("/docs/a.bin", False)
+        assert blobs == {}
+        # deleting a missing blob is a no-op, not an error
+        sink.delete_entry("/docs/a.bin", False)
+        assert sigs_ok and all(sigs_ok)
+    finally:
+        srv.shutdown()
 
 
 def test_subscriber_cursor_advances(stack):
