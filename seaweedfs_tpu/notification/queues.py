@@ -191,8 +191,7 @@ class SqsPublisher(Publisher):
         import hashlib
         import json
         import urllib.parse
-        from ..s3.auth import (canonical_request, derive_signing_key,
-                               string_to_sign, _hmac)
+        from ..s3.auth import authorization_header_v4
         body = urllib.parse.urlencode({
             "Action": "SendMessage",
             "MessageBody": json.dumps({"key": key, "event": event},
@@ -200,27 +199,17 @@ class SqsPublisher(Publisher):
             "Version": "2012-11-05",
         }).encode()
         parsed = urllib.parse.urlparse(self.queue_url)
-        path = parsed.path or "/"
         now = datetime.datetime.now(datetime.timezone.utc)
-        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
-        date = now.strftime("%Y%m%d")
-        payload_hash = hashlib.sha256(body).hexdigest()
         headers = {
             "content-type": "application/x-www-form-urlencoded",
             "host": parsed.netloc,
-            "x-amz-content-sha256": payload_hash,
-            "x-amz-date": amz_date,
+            "x-amz-content-sha256": hashlib.sha256(body).hexdigest(),
+            "x-amz-date": now.strftime("%Y%m%dT%H%M%SZ"),
         }
-        signed = sorted(headers)
-        canon = canonical_request("POST", path, [], headers, signed,
-                                  payload_hash)
-        scope = f"{date}/{self.region}/sqs/aws4_request"
-        sts = string_to_sign(amz_date, scope, canon)
-        sig = _hmac(derive_signing_key(self.secret_key, date, self.region,
-                                       "sqs"), sts).hex()
-        headers["Authorization"] = (
-            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        headers["Authorization"] = authorization_header_v4(
+            "POST", parsed.path or "/", headers,
+            headers["x-amz-content-sha256"], self.access_key,
+            self.secret_key, self.region, "sqs")
         _post_with_retries(self.queue_url, body, headers, self.timeout,
                            self.retries, "sqs")
 

@@ -20,7 +20,13 @@ from ..server.http_util import HttpError, post_multipart_file
 
 
 class SinkError(Exception):
-    pass
+    """`status` carries the HTTP status when the failure was an HTTP
+    response (0 otherwise), so callers can branch on e.g. 404 without
+    parsing the message."""
+
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = int(status)
 
 
 def _file_and_size(data):
@@ -211,19 +217,30 @@ class AzureSink(ReplicationSink):
 
     def __init__(self, account: str, account_key: str, container: str,
                  directory: str = "", endpoint: str = ""):
+        import urllib.parse
         self.account = account
         self.account_key = account_key
         self.container = container
         self.directory = directory.strip("/")
-        self.endpoint = (endpoint.rstrip("/") or
-                         f"https://{account}.blob.core.windows.net")
+        endpoint = (endpoint.rstrip("/") or
+                    f"https://{account}.blob.core.windows.net")
+        # split any path prefix out of the endpoint (Azurite uses
+        # http://host:port/<account>): the prefix is part of the
+        # request path and MUST be part of the signed canonical
+        # resource, or every request 403s
+        parsed = urllib.parse.urlparse(endpoint)
+        self.endpoint = f"{parsed.scheme}://{parsed.netloc}"
+        self.path_prefix = parsed.path.rstrip("/")
 
     def _blob_path(self, key: str) -> str:
+        """Full request path (incl. any endpoint prefix) — signed and
+        sent identically."""
         import urllib.parse
         key = key.lstrip("/")
         if self.directory:
             key = f"{self.directory}/{key}"
-        return f"/{self.container}/" + urllib.parse.quote(key)
+        return (f"{self.path_prefix}/{self.container}/"
+                + urllib.parse.quote(key))
 
     def _request(self, method: str, path: str, body=None,
                  content_type: str = "", blob_type: str = ""):
@@ -262,7 +279,7 @@ class AzureSink(ReplicationSink):
             detail = e.read().decode("utf-8", "replace")[:200]
             raise SinkError(
                 f"azure {method} {path}: {e.code} {detail}",
-            ) from None
+                status=e.code) from None
         except (urllib.error.URLError, OSError) as e:
             raise SinkError(f"azure {method} {path}: {e}") from None
 
@@ -279,7 +296,7 @@ class AzureSink(ReplicationSink):
         try:
             self._request("DELETE", self._blob_path(key))
         except SinkError as e:
-            if " 404 " not in str(e) and "BlobNotFound" not in str(e):
+            if e.status != 404:
                 raise
 
 

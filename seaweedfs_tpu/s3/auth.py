@@ -138,6 +138,27 @@ def string_to_sign(amz_date: str, scope: str, canon_req: str) -> str:
         hashlib.sha256(canon_req.encode()).hexdigest()])
 
 
+def authorization_header_v4(method: str, path: str,
+                            headers: Dict[str, str], payload_hash: str,
+                            access_key: str, secret_key: str,
+                            region: str, service: str = "s3",
+                            amz_date: str = None) -> str:
+    """Client-side SigV4: returns the Authorization header value for a
+    request whose lowercase `headers` (must include host, x-amz-date,
+    x-amz-content-sha256) will ALL be signed. Shared by the S3 tier
+    backend and the SQS publisher so the signing recipe lives once."""
+    amz_date = amz_date or headers["x-amz-date"]
+    date = amz_date[:8]
+    signed = sorted(headers)
+    canon = canonical_request(method, path, [], headers, signed,
+                              payload_hash)
+    scope = f"{date}/{region}/{service}/aws4_request"
+    sig = _hmac(derive_signing_key(secret_key, date, region, service),
+                string_to_sign(amz_date, scope, canon)).hex()
+    return (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+
+
 def _parse_auth_header(auth: str) -> Tuple[str, str, str, List[str], str]:
     """-> (access_key, date, region, signed_headers, signature)"""
     if not auth.startswith("AWS4-HMAC-SHA256"):

@@ -15,7 +15,7 @@ import time
 from typing import Optional
 
 from ..storage.types import TTL, ReplicaPlacement, parse_file_id
-from ..topology.topology import Topology
+from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
 from .http_util import (HttpError, HttpServer, Request, Router, get_json,
                         post_json, post_multipart)
@@ -115,14 +115,35 @@ class MasterServer:
             # exceed any committed entry — _raft_committed_max_vid
             # tracks the apply stream instead
             self._raft_committed_max_vid = 0
+            # file keys become raft-backed grants so a failover leader
+            # can never re-issue an id (the reference reaches for etcd
+            # for this, sequence/etcd_sequencer.go; this build already
+            # has a consensus log). Installed BEFORE RaftNode so a
+            # disk-restored snapshot's sequence_ceiling lands in it;
+            # the lambda resolves self.raft lazily for the same reason.
+            self.topology.sequencer = RaftSequencer(
+                lambda cmd: self.raft.propose(cmd))
+
+            def _snapshot_state():
+                state = {"max_volume_id": self._raft_committed_max_vid}
+                seq = self.topology.sequencer
+                if isinstance(seq, RaftSequencer):
+                    state["sequence_ceiling"] = seq.ceiling()
+                return state
+
+            def _restore_state(st):
+                self._apply_raft(
+                    {"type": "max_volume_id",
+                     "value": int(st.get("max_volume_id", 0))})
+                self._apply_raft(
+                    {"type": "sequence_ceiling",
+                     "value": int(st.get("sequence_ceiling", 0))})
+
             self.raft = RaftNode(
                 self.url, peer_list, self._apply_raft,
                 state_dir=raft_dir,
-                snapshot_state_fn=lambda: {
-                    "max_volume_id": self._raft_committed_max_vid},
-                restore_fn=lambda st: self._apply_raft(
-                    {"type": "max_volume_id",
-                     "value": int(st.get("max_volume_id", 0))}))
+                snapshot_state_fn=_snapshot_state,
+                restore_fn=_restore_state)
             router.add("POST", "/raft/request_vote",
                        self.raft_request_vote)
             router.add("POST", "/raft/append_entries",
@@ -142,6 +163,11 @@ class MasterServer:
             with self.topology.lock:
                 self.topology.max_volume_id = max(
                     self.topology.max_volume_id, value)
+        elif command.get("type") == "sequence_ceiling":
+            seq = self.topology.sequencer
+            if isinstance(seq, RaftSequencer):
+                seq.apply_ceiling(int(command["value"]),
+                                  command.get("nonce"))
 
     def raft_request_vote(self, req: Request):
         return self.raft.handle_request_vote(req.json())

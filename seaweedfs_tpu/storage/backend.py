@@ -181,14 +181,16 @@ class S3Backend(BackendStorage):
         """body may be bytes or a (file_object, length) pair — volume
         .dat files must stream, not transit RAM. With stream_to set the
         response body is written to that path and the return is b''."""
-        from ..s3.auth import (canonical_request, derive_signing_key,
-                              string_to_sign, _hmac)
-        path = f"/{self.bucket}/{urllib.parse.quote(key)}"
-        url = self.endpoint + path
-        host = urllib.parse.urlparse(self.endpoint).netloc
+        from ..s3.auth import authorization_header_v4
+        parsed = urllib.parse.urlparse(self.endpoint)
+        # sign the path exactly as sent on the wire, including any
+        # endpoint path prefix (path-style gateways, local test stores)
+        path = (parsed.path.rstrip("/")
+                + f"/{self.bucket}/{urllib.parse.quote(key)}")
+        url = f"{parsed.scheme}://{parsed.netloc}" + path
+        host = parsed.netloc
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
-        date = now.strftime("%Y%m%d")
         body_file = body_len = None
         if isinstance(body, tuple):
             body_file, body_len = body
@@ -214,16 +216,9 @@ class S3Backend(BackendStorage):
         if extra_headers:
             headers.update({k.lower(): v for k, v in
                             extra_headers.items()})
-        signed = sorted(headers)
-        canon = canonical_request(method, path, [], headers, signed,
-                                  payload_hash)
-        scope = f"{date}/{self.region}/s3/aws4_request"
-        sts = string_to_sign(amz_date, scope, canon)
-        sig = _hmac(derive_signing_key(self.secret_key, date, self.region),
-                    sts).hex()
-        headers["Authorization"] = (
-            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        headers["Authorization"] = authorization_header_v4(
+            method, path, headers, payload_hash, self.access_key,
+            self.secret_key, self.region, "s3", amz_date)
         data = body_file if body_file is not None else (body or None)
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=headers)

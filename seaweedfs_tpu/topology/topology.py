@@ -38,6 +38,89 @@ class Sequencer:
                 self._counter = seen + 1
 
 
+class RaftSequencer(Sequencer):
+    """File-key generator whose allocations survive master failover —
+    the HA role the reference fills with its etcd sequencer
+    (weed/sequence/etcd_sequencer.go), built on this cluster's own raft
+    log instead of an external store. Like the etcd variant it grants
+    keys in blocks (one consensus round-trip amortized over ``block``
+    ids), committing a rising "sequence ceiling" to the log; every
+    master applies the ceiling, so a new leader always starts above
+    every id any previous leader could have handed out.
+
+    Concurrency contract: ``propose_fn`` blocks until commit and the
+    raft apply runs ``apply_ceiling`` (possibly on another thread, or
+    reentrantly on this one for a single-node cluster), so this class
+    NEVER holds its lock across a propose call.
+
+    A node only hands out ids from grants it proposed itself
+    (``_grant_end``): applied ceilings from other leaders advance
+    ``_ceiling`` but never open a local allocation window, which is
+    what makes failover safe — ids at or below a remote ceiling may
+    already be in use.
+    """
+
+    def __init__(self, propose_fn, block: int = 10000):
+        super().__init__()
+        self._propose = propose_fn
+        self._block = int(block)
+        self._ceiling = 0     # highest committed ceiling (any leader)
+        self._grant_end = 0   # top of THIS node's own committed grant
+        self._nonce = 0
+        self._pending: set = set()  # nonces of my in-flight proposals
+
+    def next_file_id(self, count: int = 1) -> int:
+        while True:
+            with self._lock:
+                if self._counter + count - 1 <= self._grant_end:
+                    start = self._counter
+                    self._counter += count
+                    return start
+                need = max(self._block, count)
+                target = max(self._ceiling, self._grant_end,
+                             self._counter - 1) + need
+                self._nonce += 1
+                nonce = f"{id(self)}-{self._nonce}"
+                self._pending.add(nonce)
+            # Outside the lock: propose blocks until commit and the
+            # apply callback needs the lock. Raises NotLeaderError on a
+            # follower — Assign is leader-only, callers redirect.
+            # The grant's BASE is decided in apply_ceiling at commit
+            # order, not here: a fresh leader may propose before
+            # applying the previous leader's entries, and a
+            # propose-time base would overlap that leader's grant.
+            try:
+                self._propose({"type": "sequence_ceiling",
+                               "value": target, "nonce": nonce})
+            finally:
+                with self._lock:
+                    self._pending.discard(nonce)
+            # loop: if the apply granted us room, allocate; if a
+            # foreign ceiling swallowed the whole range (empty grant),
+            # re-propose above the now-visible ceiling
+
+    def apply_ceiling(self, value: int, nonce: str = None):
+        """Raft apply hook: a committed ceiling from any master. When
+        ``nonce`` identifies one of THIS node's in-flight proposals,
+        the range (ceiling-before-apply, value] becomes its exclusive
+        allocation grant — commit order makes that base authoritative."""
+        with self._lock:
+            if nonce is not None and nonce in self._pending:
+                base = self._ceiling
+                if base < value:
+                    if base > self._grant_end:
+                        # cleared a foreign ceiling: jump the counter
+                        # past ids other leaders may have issued
+                        self._counter = max(self._counter, base + 1)
+                    self._grant_end = max(self._grant_end, value)
+            if value > self._ceiling:
+                self._ceiling = value
+
+    def ceiling(self) -> int:
+        with self._lock:
+            return self._ceiling
+
+
 class Topology:
     def __init__(self, volume_size_limit: int = 30 * 1024 * 1024 * 1024,
                  pulse_seconds: int = 5, sequencer: Sequencer = None):

@@ -161,6 +161,113 @@ def test_reflected_self_heartbeat_does_not_depose():
     assert out["success"] and node.state == LEADER
 
 
+# -- raft-backed sequencer ---------------------------------------------------
+
+def test_raft_sequencer_grants_blocks():
+    from seaweedfs_tpu.topology.topology import RaftSequencer
+    committed = []
+
+    def propose(cmd):
+        committed.append(dict(cmd))
+        # single-node: commit applies immediately
+        seq.apply_ceiling(cmd["value"], cmd.get("nonce"))
+
+    seq = RaftSequencer(propose, block=100)
+    assert [seq.next_file_id() for _ in range(5)] == [1, 2, 3, 4, 5]
+    # one consensus round-trip granted the whole block
+    assert [(c["type"], c["value"]) for c in committed] == \
+        [("sequence_ceiling", 100)]
+    # a batch beyond the grant extends it contiguously (own grant: no
+    # id gap)
+    assert seq.next_file_id(200) == 6
+    assert committed[-1]["value"] >= 205
+
+
+def test_raft_sequencer_failover_never_reissues():
+    from seaweedfs_tpu.topology.topology import RaftSequencer
+
+    class Cluster:
+        """Two masters sharing a committed ceiling; only the 'leader'
+        may propose."""
+
+        def __init__(self):
+            self.nodes = []
+            self.leader = None
+
+        def propose_for(self, node):
+            def propose(cmd):
+                if self.leader is not node:
+                    raise RuntimeError("not leader")
+                for n in self.nodes:
+                    n.apply_ceiling(cmd["value"], cmd.get("nonce"))
+            return propose
+
+    c = Cluster()
+    # propose_for needs the sequencer object: bind after construction
+    a = RaftSequencer(lambda cmd: c.propose_for(a)(cmd), block=50)
+    b = RaftSequencer(lambda cmd: c.propose_for(b)(cmd), block=50)
+    c.nodes = [a, b]
+    c.leader = a
+
+    issued = [a.next_file_id() for _ in range(30)]
+    # failover: b takes over; it holds applied ceilings but no grant
+    c.leader = b
+    new_id = b.next_file_id()
+    assert new_id > max(issued)
+    assert new_id > a.ceiling() - 50  # started above A's whole grant
+    # a, now deposed, may still drain its OWN committed grant (those
+    # ids can never collide: b's grants start above a's ceiling) ...
+    drain = [a.next_file_id() for _ in range(20)]
+    assert set(drain).isdisjoint({new_id})
+    assert max(drain) <= 50  # never crosses into b's territory
+    # ... but once the grant is exhausted it cannot allocate more
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="not leader"):
+        a.next_file_id()
+    # everything ever issued is unique
+    all_ids = issued + [new_id] + drain
+    assert len(set(all_ids)) == len(all_ids)
+
+
+def test_raft_sequencer_set_max_from_heartbeat():
+    """Volume max-file-keys seen at boot must push allocations above
+    pre-existing needles, exactly like the memory sequencer."""
+    from seaweedfs_tpu.topology.topology import RaftSequencer
+
+    def propose(cmd):
+        seq.apply_ceiling(cmd["value"], cmd.get("nonce"))
+
+    seq = RaftSequencer(propose, block=100)
+    seq.set_max(5000)
+    assert seq.next_file_id() == 5001
+
+
+def test_raft_sequencer_grant_base_is_decided_at_apply_time():
+    """Failover race: a fresh leader proposes its first grant BEFORE
+    applying the dead leader's committed ceiling. Commit order places
+    the old ceiling first, so the new proposal's grant must be computed
+    against it (here: fully swallowed -> retry), never against the
+    propose-time view — a propose-time base would re-issue the old
+    leader's ids."""
+    from seaweedfs_tpu.topology.topology import RaftSequencer
+    calls = []
+
+    def propose(cmd):
+        calls.append(dict(cmd))
+        if len(calls) == 1:
+            # the log already holds the dead leader's ceiling=10000;
+            # it applies ahead of our first command
+            seq.apply_ceiling(10000)
+        seq.apply_ceiling(cmd["value"], cmd.get("nonce"))
+
+    seq = RaftSequencer(propose, block=10000)
+    # propose-time view: ceiling=0 -> first target is 10000, which the
+    # old ceiling swallows entirely; the loop must re-propose 20000 and
+    # allocate strictly above the dead leader's range
+    assert seq.next_file_id() == 10001
+    assert [c["value"] for c in calls] == [10000, 20000]
+
+
 # -- live HTTP integration --------------------------------------------------
 
 def free_ports(n):
@@ -261,6 +368,39 @@ def test_ha_leader_failover(ha_cluster):
     assert ok
     # data from before the failover is still readable
     assert op.read_file(new_leader.url, fid) == b"pre-failover"
+
+
+def test_ha_file_keys_monotonic_across_failover(ha_cluster):
+    """The raft-backed sequencer must hand out strictly increasing
+    needle keys across a leader change — a reissued key would collide
+    two different files in one volume."""
+    masters, vs = ha_cluster
+    leader = _wait_http_leader(masters)
+    vs.start()
+    time.sleep(2.5)
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.storage.types import parse_file_id
+
+    def key_of(fid):
+        _, nid, _ = parse_file_id(fid)
+        return nid
+
+    pre = [key_of(op.assign(leader.url)["fid"]) for _ in range(5)]
+    assert pre == sorted(pre)
+
+    survivors = [m for m in masters if m is not leader]
+    leader.stop()
+    new_leader = _wait_http_leader(masters, alive=survivors,
+                                   timeout=15.0)
+    deadline = time.time() + 15
+    post = None
+    while time.time() < deadline and post is None:
+        try:
+            post = key_of(op.assign(new_leader.url)["fid"])
+        except Exception:
+            time.sleep(0.5)
+    assert post is not None
+    assert post > max(pre), (pre, post)
 
 
 def test_ha_watch_survives_failover(ha_cluster):

@@ -233,6 +233,17 @@ def test_azure_sink_shared_key_blob_roundtrip():
         # deleting a missing blob is a no-op, not an error
         sink.delete_entry("/docs/a.bin", False)
         assert sigs_ok and all(sigs_ok)
+        # Azurite-style endpoint with a path prefix: the prefix must be
+        # both sent and signed (the fake recomputes over self.path, so a
+        # signature that ignored the prefix would fail here)
+        n_ok = len(sigs_ok)
+        sink2 = make_sink({
+            "type": "azure", "account": account, "account_key": key,
+            "container": "backup",
+            "endpoint": f"http://127.0.0.1:{srv.server_port}/{account}"})
+        sink2.create_entry("/p.bin", {}, b"prefixed")
+        assert blobs == {f"/{account}/backup/p.bin": b"prefixed"}
+        assert len(sigs_ok) > n_ok and all(sigs_ok)
     finally:
         srv.shutdown()
 

@@ -101,7 +101,12 @@ def test_sink_registry_shapes():
     assert "storage.googleapis.com" in sink.s3.endpoint
     sink2 = make_sink({"type": "b2", "bucket": "bkt"})
     assert "backblazeb2.com" in sink2.s3.endpoint
-    with pytest.raises(SinkError, match="azure sink requires"):
+    # azure is now a real SharedKey sink; missing config still
+    # surfaces as a SinkError
+    with pytest.raises(SinkError, match="azure sink config"):
         make_sink({"type": "azure"})
+    sink3 = make_sink({"type": "azure", "account": "acct",
+                       "account_key": "a2V5", "container": "c"})
+    assert sink3.endpoint == "https://acct.blob.core.windows.net"
     with pytest.raises(SinkError, match="unknown sink"):
         make_sink({"type": "nope"})
