@@ -124,7 +124,7 @@ class S3Sink(ReplicationSink):
         try:
             self.s3._request("PUT", self._key(key), _file_and_size(data))
         except BackendError as e:
-            raise SinkError(str(e)) from None
+            raise SinkError(str(e), status=e.status) from None
 
     def delete_entry(self, key: str, is_directory: bool):
         if is_directory:
@@ -133,8 +133,8 @@ class S3Sink(ReplicationSink):
         try:
             self.s3.delete(self._key(key))
         except BackendError as e:
-            if "404" not in str(e) and "NoSuchKey" not in str(e):
-                raise SinkError(str(e)) from None
+            if e.status != 404:
+                raise SinkError(str(e), status=e.status) from None
 
 
 class GcsSink(S3Sink):

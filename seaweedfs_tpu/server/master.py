@@ -435,6 +435,23 @@ class MasterServer:
         fwd = self._leader_forward(req)
         if fwd is not None:
             return fwd
+        from ..topology.raft import NotLeaderError
+        try:
+            return self._dir_assign_local(req)
+        except NotLeaderError as e:
+            # deposed between the forward check and the sequencer's
+            # raft grant: answer like the forward path would — a
+            # retriable 503 carrying the new leader
+            hint = e.args[0] if e.args else ""
+            raise HttpError(
+                503, f"leadership changed during assign; leader is "
+                     f"{hint or 'unknown'}") from None
+        except TimeoutError:
+            raise HttpError(
+                503, "raft commit timed out during assign; retry"
+            ) from None
+
+    def _dir_assign_local(self, req: Request):
         count = int(req.query.get("count", 1))
         collection = req.query.get("collection", "")
         replication = req.query.get("replication") \

@@ -30,7 +30,13 @@ EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 
 class BackendError(Exception):
-    pass
+    """`status` carries the HTTP status when the failure was an HTTP
+    response (0 otherwise) so callers branch on codes, not message
+    text."""
+
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = int(status)
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +241,8 @@ class S3Backend(BackendStorage):
         except urllib.error.HTTPError as e:
             raise BackendError(
                 f"{method} {url}: {e.code} "
-                f"{e.read().decode('utf-8', 'replace')[:200]}") from None
+                f"{e.read().decode('utf-8', 'replace')[:200]}",
+                status=e.code) from None
         except urllib.error.URLError as e:
             raise BackendError(f"{method} {url}: {e}") from None
         except OSError as e:

@@ -67,6 +67,13 @@ class RaftSequencer(Sequencer):
         self._ceiling = 0     # highest committed ceiling (any leader)
         self._grant_end = 0   # top of THIS node's own committed grant
         self._nonce = 0
+        # process-unique prefix: nonces ride the replicated log, so two
+        # masters' counters must never mint the same nonce (id() +
+        # counter can coincide across identical processes — a foreign
+        # entry matching a local pending nonce would be adopted as a
+        # grant and collide file ids)
+        import uuid
+        self._nonce_prefix = uuid.uuid4().hex
         self._pending: set = set()  # nonces of my in-flight proposals
 
     def next_file_id(self, count: int = 1) -> int:
@@ -80,7 +87,7 @@ class RaftSequencer(Sequencer):
                 target = max(self._ceiling, self._grant_end,
                              self._counter - 1) + need
                 self._nonce += 1
-                nonce = f"{id(self)}-{self._nonce}"
+                nonce = f"{self._nonce_prefix}-{self._nonce}"
                 self._pending.add(nonce)
             # Outside the lock: propose blocks until commit and the
             # apply callback needs the lock. Raises NotLeaderError on a
