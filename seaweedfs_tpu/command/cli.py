@@ -162,6 +162,10 @@ def cmd_filer(args):
         store_options = {"path": db, "shards": args.storeShards}
     elif args.store == "sqlite":
         store_options = {"path": db}
+    elif args.store == "redis":
+        store_options = {"addr": args.redisAddr,
+                         "password": args.redisPassword,
+                         "db": args.redisDb}
     else:
         store_options = {}
     f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
@@ -518,7 +522,7 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-ip", default="127.0.0.1")
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
-                   choices=["memory", "sqlite", "sharded"])
+                   choices=["memory", "sqlite", "sharded", "redis"])
     f.add_argument("-db", default="./filer.db",
                    help="metadata path: a sqlite file, or a directory "
                         "of shard dbs for -store sharded (default "
@@ -526,6 +530,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-storeShards", type=int, default=8,
                    help="shard count for -store sharded (sticky per "
                         "store directory)")
+    f.add_argument("-redisAddr", default="127.0.0.1:6379",
+                   help="redis endpoint for -store redis")
+    f.add_argument("-redisPassword", default="")
+    f.add_argument("-redisDb", type=int, default=0)
     f.add_argument("-collection", default="")
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,

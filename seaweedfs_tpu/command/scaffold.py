@@ -106,6 +106,9 @@ SCAFFOLDS = {
 //          -storeShards 8             leveldb2-style sharded store:
 //                                     md5(dir) routes to one of N
 //                                     sqlite shards; count is sticky
+//   -store redis   -redisAddr host:6379 [-redisPassword ..]
+//          [-redisDb N]               external store over a built-in
+//                                     RESP client (Redis/KeyDB/Valkey)
 {}
 """,
 }

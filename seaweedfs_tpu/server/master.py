@@ -442,10 +442,9 @@ class MasterServer:
             # deposed between the forward check and the sequencer's
             # raft grant: answer like the forward path would — a
             # retriable 503 carrying the new leader
-            hint = e.args[0] if e.args else ""
             raise HttpError(
                 503, f"leadership changed during assign; leader is "
-                     f"{hint or 'unknown'}") from None
+                     f"{e.leader or 'unknown'}") from None
         except TimeoutError:
             raise HttpError(
                 503, "raft commit timed out during assign; retry"

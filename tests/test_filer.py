@@ -13,6 +13,7 @@ from seaweedfs_tpu.filer import (
     FileChunk,
     Filer,
     MemoryStore,
+    RedisStore,
     ShardedStore,
     SqliteStore,
     compact_file_chunks,
@@ -27,6 +28,185 @@ from seaweedfs_tpu.filer.stream import read_chunked
 
 def c(fid, offset, size, mtime):
     return FileChunk(fid=fid, offset=offset, size=size, mtime=mtime)
+
+
+class FakeRedis:
+    """In-process redis-protocol server: strings + lex sorted sets —
+    the command subset the RedisStore speaks, validated on the real
+    wire format (RESP2 over TCP)."""
+
+    def __init__(self):
+        import socket
+        import threading
+        self.kv = {}
+        self.zsets = {}
+        self.lock = threading.Lock()
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def flushall(self):
+        with self.lock:
+            self.kv.clear()
+            self.zsets.clear()
+
+    def _serve(self):
+        import threading
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client, args=(conn,),
+                             daemon=True).start()
+
+    def _client(self, conn):
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n + 2:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, buf = buf[:n], buf[n + 2:]
+            return out
+
+        try:
+            while True:
+                line = read_line()
+                assert line[:1] == b"*", line
+                args = []
+                for _ in range(int(line[1:])):
+                    hdr = read_line()
+                    assert hdr[:1] == b"$"
+                    args.append(read_exact(int(hdr[1:])))
+                conn.sendall(self._dispatch(args))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _bulk(b):
+        if b is None:
+            return b"$-1\r\n"
+        return b"$%d\r\n%s\r\n" % (len(b), b)
+
+    def _dispatch(self, args):
+        cmd = args[0].decode().upper()
+        with self.lock:
+            if cmd == "PING":
+                return b"+PONG\r\n"
+            if cmd in ("AUTH", "SELECT"):
+                return b"+OK\r\n"
+            if cmd == "FLUSHALL":
+                self.kv.clear()
+                self.zsets.clear()
+                return b"+OK\r\n"
+            if cmd == "SET":
+                self.kv[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd == "GET":
+                return self._bulk(self.kv.get(args[1]))
+            if cmd == "MGET":
+                return b"*%d\r\n" % (len(args) - 1) + b"".join(
+                    self._bulk(self.kv.get(k)) for k in args[1:])
+            if cmd == "DEL":
+                n = 0
+                for k in args[1:]:
+                    n += self.kv.pop(k, None) is not None
+                    n += self.zsets.pop(k, None) is not None
+                return b":%d\r\n" % n
+            if cmd == "ZADD":
+                z = self.zsets.setdefault(args[1], set())
+                added = args[3] not in z
+                z.add(args[3])
+                return b":%d\r\n" % added
+            if cmd == "ZREM":
+                z = self.zsets.get(args[1], set())
+                removed = args[2] in z
+                z.discard(args[2])
+                return b":%d\r\n" % removed
+            if cmd == "SCAN":
+                # one-pass cursor; glob: \escape, *, ?
+                import re
+                pat = args[args.index(b"MATCH") + 1].decode() \
+                    if b"MATCH" in args else "*"
+                out, i = [], 0
+                while i < len(pat):
+                    ch = pat[i]
+                    if ch == "\\" and i + 1 < len(pat):
+                        out.append(re.escape(pat[i + 1]))
+                        i += 2
+                        continue
+                    out.append(".*" if ch == "*" else
+                               "." if ch == "?" else re.escape(ch))
+                    i += 1
+                rx = re.compile("^" + "".join(out) + "$", re.S)
+                keys = [k for k in
+                        list(self.kv) + list(self.zsets)
+                        if rx.match(k.decode("utf-8", "surrogateescape"))]
+                body = b"*%d\r\n" % len(keys) + b"".join(
+                    self._bulk(k) for k in keys)
+                return b"*2\r\n" + self._bulk(b"0") + body
+            if cmd == "ZRANGEBYLEX":
+                members = sorted(self.zsets.get(args[1], set()))
+                lo, hi = args[2], args[3]
+
+                def keep(m):
+                    if lo == b"-":
+                        ok_lo = True
+                    elif lo[:1] == b"[":
+                        ok_lo = m >= lo[1:]
+                    else:
+                        ok_lo = m > lo[1:]
+                    if hi == b"+":
+                        return ok_lo
+                    if hi[:1] == b"[":
+                        return ok_lo and m <= hi[1:]
+                    return ok_lo and m < hi[1:]
+
+                picked = [m for m in members if keep(m)]
+                if len(args) >= 7 and args[4].upper() == b"LIMIT":
+                    off, cnt = int(args[5]), int(args[6])
+                    picked = picked[off:off + cnt]
+                return b"*%d\r\n" % len(picked) + b"".join(
+                    self._bulk(m) for m in picked)
+        return b"-ERR unknown command\r\n"
+
+
+_fake_redis_srv = None
+
+
+def fake_redis():
+    global _fake_redis_srv
+    if _fake_redis_srv is None:
+        _fake_redis_srv = FakeRedis()
+    _fake_redis_srv.flushall()
+    return _fake_redis_srv
 
 
 class TestVisibleIntervals:
@@ -132,11 +312,15 @@ class TestReadChunked:
 
 
 @pytest.mark.parametrize("store_cls",
-                         [MemoryStore, SqliteStore, ShardedStore])
+                         [MemoryStore, SqliteStore, ShardedStore,
+                          RedisStore])
 class TestStores:
     def make(self, store_cls):
         s = store_cls()
-        s.initialize()
+        if store_cls is RedisStore:
+            s.initialize(addr=f"127.0.0.1:{fake_redis().port}")
+        else:
+            s.initialize()
         return s
 
     def test_round_trip(self, store_cls):
