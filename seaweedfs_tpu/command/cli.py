@@ -95,7 +95,11 @@ def cmd_volume(args):
                                  if w]).start()
     print(f"volume server listening on {vs.url}, "
           f"heartbeating to {args.mserver}")
+    prof = _maybe_profiler(args)
     _wait(vs)
+    if prof:
+        prof.stop()
+        print(f"cpu profile (collapsed stacks) -> {args.cpuprofile}")
 
 
 def cmd_server(args):
@@ -239,10 +243,26 @@ def cmd_shell(args):
             break
 
 
+def _maybe_profiler(args):
+    """Start the all-thread stack sampler when -cpuprofile is set
+    (reference -cpuprofile, weed/command/volume.go:71)."""
+    path = getattr(args, "cpuprofile", "")
+    if not path:
+        return None
+    from ..util.profiling import SamplingProfiler
+    return SamplingProfiler(path).start()
+
+
 def cmd_benchmark(args):
     from .benchmark import run_benchmark
-    run_benchmark(args.master, num_files=args.n, file_size=args.size,
-                  concurrency=args.c, collection=args.collection)
+    prof = _maybe_profiler(args)
+    try:
+        run_benchmark(args.master, num_files=args.n, file_size=args.size,
+                      concurrency=args.c, collection=args.collection)
+    finally:
+        if prof:
+            prof.stop()
+            print(f"cpu profile (collapsed stacks) -> {args.cpuprofile}")
 
 
 def cmd_upload(args):
@@ -477,6 +497,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="needle map variant (reference -index flag): "
                         "memory dict, 16B/needle compact arrays, or "
                         "mmap'd sorted file")
+    v.add_argument("-cpuprofile", default="",
+                   help="write an all-thread collapsed-stack CPU "
+                        "profile here on shutdown (flamegraph.pl/"
+                        "speedscope format; reference -cpuprofile)")
     v.add_argument("-jwtKey", default="")
     v.add_argument("-tlsCert", default="")
     v.add_argument("-tlsKey", default="")
@@ -585,6 +609,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-c", type=int, default=16)
     b.add_argument("-collection", default="benchmark")
+    b.add_argument("-cpuprofile", default="",
+                   help="write an all-thread collapsed-stack CPU "
+                        "profile of the run (reference benchmark "
+                        "-cpuprofile)")
     b.set_defaults(fn=cmd_benchmark)
 
     u = sub.add_parser("upload", help="upload files")

@@ -62,12 +62,7 @@ class RespClient:
 
     def close(self):
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self.close_nolock()
 
     def command(self, *args):
         """Run one command; reconnect-and-retry once on a torn
@@ -94,13 +89,42 @@ class RespClient:
                 pass
             self._sock = None
 
-    def _exec(self, *args):
+    def transaction(self, *cmds):
+        """MULTI/EXEC the given command tuples atomically (one
+        pipelined write, one EXEC reply). Same reconnect policy as
+        command()."""
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+                return self._exec_multi(cmds)
+            try:
+                return self._exec_multi(cmds)
+            except (OSError, RedisConnectionError):
+                self.close_nolock()
+                self._connect()
+                return self._exec_multi(cmds)
+
+    def _exec_multi(self, cmds):
+        wire = [self._encode(("MULTI",))]
+        wire += [self._encode(c) for c in cmds]
+        wire.append(self._encode(("EXEC",)))
+        self._sock.sendall(b"".join(wire))
+        self._read_reply()               # +OK for MULTI
+        for _ in cmds:
+            self._read_reply()           # +QUEUED per command
+        return self._read_reply()        # EXEC: array of results
+
+    @staticmethod
+    def _encode(args) -> bytes:
         out = [b"*%d\r\n" % len(args)]
         for a in args:
             b = a if isinstance(a, (bytes, bytearray)) else \
                 str(a).encode()
             out.append(b"$%d\r\n%s\r\n" % (len(b), b))
-        self._sock.sendall(b"".join(out))
+        return b"".join(out)
+
+    def _exec(self, *args):
+        self._sock.sendall(self._encode(args))
         return self._read_reply()
 
     def _read_line(self) -> bytes:
@@ -157,6 +181,7 @@ class RedisStore(FilerStore):
     def initialize(self, addr: str = "127.0.0.1:6379", password: str = "",
                    db: int = 0, timeout: float = 10.0, **options):
         host, _, port = addr.rpartition(":")
+        host = host.strip("[]")  # bracketed IPv6: [::1]:6379
         if not host or not port.isdigit():
             raise ValueError(f"bad redis addr {addr!r}: want host:port")
         self._client = RespClient(host, int(port), password=password,
@@ -166,12 +191,17 @@ class RedisStore(FilerStore):
     # -- FilerStore -------------------------------------------------------
 
     def insert_entry(self, entry: Entry) -> None:
-        self._client.command("SET", entry.full_path, entry.encode())
-        self._client.command("ZADD", _children_key(entry.dir_name),
-                             "0", entry.name)
+        # MULTI/EXEC: the entry and its directory-index membership must
+        # land together — a crash between them would leave an entry that
+        # GETs but never LISTs (or vice versa)
+        self._client.transaction(
+            ("SET", entry.full_path, entry.encode()),
+            ("ZADD", _children_key(entry.dir_name), "0", entry.name))
 
     def update_entry(self, entry: Entry) -> None:
-        self.insert_entry(entry)
+        # the name is already in the parent's set: SET alone suffices
+        # (saves a round trip on the hot metadata-update path)
+        self._client.command("SET", entry.full_path, entry.encode())
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         data = self._client.command("GET", full_path)
@@ -180,10 +210,10 @@ class RedisStore(FilerStore):
         return Entry.decode(full_path, data)
 
     def delete_entry(self, full_path: str) -> None:
-        self._client.command("DEL", full_path)
         d = posixpath.dirname(full_path) or "/"
-        self._client.command("ZREM", _children_key(d),
-                             posixpath.basename(full_path))
+        self._client.transaction(
+            ("DEL", full_path),
+            ("ZREM", _children_key(d), posixpath.basename(full_path)))
 
     @staticmethod
     def _glob_escape(s: str) -> str:

@@ -173,3 +173,33 @@ def test_status_pages_render(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_sampling_profiler_collapsed_stacks(tmp_path):
+    """The all-thread sampler must attribute time to a busy worker
+    thread's frames in folded-stack format."""
+    import threading
+    import time as _time
+
+    from seaweedfs_tpu.util.profiling import SamplingProfiler
+
+    stop = threading.Event()
+
+    def busy_worker_fn():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=busy_worker_fn, name="busy")
+    out = tmp_path / "prof.folded"
+    prof = SamplingProfiler(str(out), interval=0.002).start()
+    t.start()
+    _time.sleep(0.4)
+    stop.set()
+    t.join()
+    prof.stop()
+    text = out.read_text()
+    assert "busy_worker_fn" in text
+    # folded format: "frame;frame;... count"
+    for line in text.splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()

@@ -94,6 +94,7 @@ class FakeRedis:
             out, buf = buf[:n], buf[n + 2:]
             return out
 
+        multi = None  # per-connection MULTI queue
         try:
             while True:
                 line = read_line()
@@ -103,7 +104,20 @@ class FakeRedis:
                     hdr = read_line()
                     assert hdr[:1] == b"$"
                     args.append(read_exact(int(hdr[1:])))
-                conn.sendall(self._dispatch(args))
+                cmd = args[0].decode().upper()
+                if cmd == "MULTI":
+                    multi = []
+                    conn.sendall(b"+OK\r\n")
+                elif cmd == "EXEC" and multi is not None:
+                    replies = [self._dispatch(a) for a in multi]
+                    multi = None
+                    conn.sendall(b"*%d\r\n" % len(replies)
+                                 + b"".join(replies))
+                elif multi is not None:
+                    multi.append(args)
+                    conn.sendall(b"+QUEUED\r\n")
+                else:
+                    conn.sendall(self._dispatch(args))
         except (ConnectionError, OSError):
             pass
         finally:
