@@ -140,7 +140,11 @@ def cmd_server(args):
             stoppables.append(w)
         stoppables.append(f)
     stoppables.append(m)
+    prof = _maybe_profiler(args)
     _wait(*stoppables)
+    if prof:
+        prof.stop()
+        print(f"cpu profile (collapsed stacks) -> {args.cpuprofile}")
 
 
 def _start_s3(filer_server, port: int, host: str, config_path: str):
@@ -514,6 +518,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server", help="master + volume (+filer) combined")
+    s.add_argument("-cpuprofile", default="",
+                   help="write an all-thread collapsed-stack CPU "
+                        "profile here on shutdown")
     s.add_argument("-ip", default="127.0.0.1")
     s.add_argument("-masterPort", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)

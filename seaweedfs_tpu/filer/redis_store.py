@@ -92,17 +92,23 @@ class RespClient:
     def transaction(self, *cmds):
         """MULTI/EXEC the given command tuples atomically (one
         pipelined write, one EXEC reply). Same reconnect policy as
-        command()."""
+        command(); any server error mid-transaction poisons the reply
+        stream (unread QUEUED/EXEC replies), so the connection is
+        dropped before the error propagates."""
         with self._lock:
-            if self._sock is None:
-                self._connect()
-                return self._exec_multi(cmds)
             try:
-                return self._exec_multi(cmds)
-            except (OSError, RedisConnectionError):
+                if self._sock is None:
+                    self._connect()
+                    return self._exec_multi(cmds)
+                try:
+                    return self._exec_multi(cmds)
+                except (OSError, RedisConnectionError):
+                    self.close_nolock()
+                    self._connect()
+                    return self._exec_multi(cmds)
+            except (OSError, RedisError):
                 self.close_nolock()
-                self._connect()
-                return self._exec_multi(cmds)
+                raise
 
     def _exec_multi(self, cmds):
         wire = [self._encode(("MULTI",))]
@@ -199,9 +205,11 @@ class RedisStore(FilerStore):
             ("ZADD", _children_key(entry.dir_name), "0", entry.name))
 
     def update_entry(self, entry: Entry) -> None:
-        # the name is already in the parent's set: SET alone suffices
-        # (saves a round trip on the hot metadata-update path)
-        self._client.command("SET", entry.full_path, entry.encode())
+        # full upsert like every other store (and the reference's redis
+        # UpdateEntry = InsertEntry): Filer.update_entry doesn't require
+        # a prior insert, and a SET without the ZADD would mint an entry
+        # that GETs but never LISTs
+        self.insert_entry(entry)
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         data = self._client.command("GET", full_path)

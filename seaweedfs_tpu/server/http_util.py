@@ -46,6 +46,28 @@ class Request:
             self._body = self.handler.rfile.read(length) if length else b""
         return self._body
 
+    def drain(self, cap: int = 4 << 20):
+        """Discard any unread request body. Keep-alive framing depends
+        on this: a handler that never touches .body would otherwise
+        leave the payload in the socket, where it prepends itself to
+        the next request line on the reused connection. Beyond ``cap``
+        the connection is closed instead — reading a rejected
+        volume-sized upload to completion would stall the thread for
+        the whole transfer (Go's http.Server draws the same line)."""
+        if self._body is not None:
+            return
+        left = int(self.headers.get("Content-Length") or 0)
+        if left > cap:
+            self.handler.close_connection = True
+            self._body = b""
+            return
+        while left > 0:
+            chunk = self.handler.rfile.read(min(left, 1 << 20))
+            if not chunk:
+                break
+            left -= len(chunk)
+        self._body = b""
+
     def json(self) -> dict:
         if not self.body:
             return {}
@@ -150,6 +172,10 @@ class Router:
 def _make_handler(router: Router):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # response headers and small bodies go out in separate writes;
+        # without NODELAY, Nagle holds the second write hostage to the
+        # peer's delayed ACK (millisecond-scale stalls per request)
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet
             pass
@@ -157,7 +183,10 @@ def _make_handler(router: Router):
         def _run(self):
             req = Request(self)
             try:
-                result = router.dispatch(req)
+                try:
+                    result = router.dispatch(req)
+                finally:
+                    req.drain()
             except HttpError as e:
                 self._send_json({"error": e.message or str(e)}, e.status)
                 return
@@ -284,6 +313,7 @@ def configure_tls(cert_file: str = "", key_file: str = "",
     alternative serves plaintext while rewriting outbound URLs to
     https, which only surfaces as baffling handshake errors later."""
     import ssl
+    clear_conn_pool()  # drop conns from the previous config
     if bool(cert_file) != bool(key_file):
         raise ValueError("TLS needs BOTH cert and key (got only one); "
                          "pass just ca for a client-only configuration")
@@ -300,6 +330,7 @@ def configure_tls(cert_file: str = "", key_file: str = "",
 def reset_tls():
     _TLS.update({"cert": "", "key": "", "ca": "", "client_ctx": None,
                  "server_ctx": None})
+    clear_conn_pool()  # pooled conns carry the previous TLS context
 
 
 def tls_enabled() -> bool:
@@ -312,10 +343,50 @@ def _client_url(url: str) -> str:
     return url
 
 
+class _TunedHTTPServer(ThreadingHTTPServer):
+    # the stdlib default backlog of 5 drops SYNs under concurrent
+    # clients (each drop costs a ~200ms+ retransmit — visible as p99
+    # latency spikes); the reference's Go listener uses the OS default
+    # (somaxconn)
+    request_queue_size = 128
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        self._client_socks: set = set()
+        self._conn_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    # track live client sockets so stop() can sever keep-alive
+    # connections — shutdown() only stops the accept loop, and pooled
+    # clients would otherwise keep talking to a "stopped" server
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._conn_lock:
+            self._client_socks.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._client_socks.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self):
+        with self._conn_lock:
+            socks, self._client_socks = list(self._client_socks), set()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
 class HttpServer:
     def __init__(self, port: int, router: Router, host: str = "127.0.0.1"):
-        self.httpd = ThreadingHTTPServer((host, port), _make_handler(router))
-        self.httpd.daemon_threads = True
+        self.httpd = _TunedHTTPServer((host, port), _make_handler(router))
         if _TLS["server_ctx"] is not None:
             self.httpd.socket = _TLS["server_ctx"].wrap_socket(
                 self.httpd.socket, server_side=True)
@@ -332,6 +403,7 @@ class HttpServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.httpd.close_all_connections()
 
 
 def free_port() -> int:
@@ -368,6 +440,138 @@ def parse_range(rng: str, size: int) -> Optional[Tuple[int, int]]:
 
 
 # -- client helpers ---------------------------------------------------------
+#
+# Cluster-internal calls ride a keep-alive connection pool: urllib opens
+# (and tears down) a fresh TCP connection per request, which caps a
+# chatty data plane at connection-churn rate (SYN/FIN per needle write,
+# TIME_WAIT pileups, Nagle stalls on the two-write request pattern).
+# The reference's Go http.Client pools by default; this is the same
+# discipline. External endpoints (webhooks, SQS, cloud sinks) keep the
+# urllib path — low-rate, and their TLS contexts differ.
+
+import http.client as _httpc
+
+_POOL: Dict[Tuple[str, str], List] = {}
+_POOL_LOCK = threading.Lock()
+_POOL_MAX_PER_HOST = 32
+_RETRIABLE_STALE = (_httpc.RemoteDisconnected, _httpc.BadStatusLine,
+                    ConnectionResetError, BrokenPipeError)
+
+
+def _new_conn(scheme: str, netloc: str, timeout: float):
+    if scheme == "https":
+        return _httpc.HTTPSConnection(netloc, timeout=timeout,
+                                      context=_TLS["client_ctx"])
+    return _httpc.HTTPConnection(netloc, timeout=timeout)
+
+
+def _sock_is_stale(sock) -> bool:
+    """A pooled idle socket that polls readable has either a FIN (peer
+    closed the idle connection — the common post-restart case) or
+    unexpected bytes; both mean: don't reuse. One zero-timeout select."""
+    import select
+    try:
+        r, _, _ = select.select([sock], [], [], 0)
+        return bool(r)
+    except (OSError, ValueError):
+        return True
+
+
+def _pool_get(scheme: str, netloc: str, timeout: float):
+    """-> (conn, reused). New connections get TCP_NODELAY on connect."""
+    while True:
+        with _POOL_LOCK:
+            stack = _POOL.get((scheme, netloc))
+            conn = stack.pop() if stack else None
+        if conn is None:
+            return _new_conn(scheme, netloc, timeout), False
+        if conn.sock is not None and _sock_is_stale(conn.sock):
+            conn.close()
+            continue
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        return conn, True
+
+
+def _pool_put(scheme: str, netloc: str, conn):
+    with _POOL_LOCK:
+        stack = _POOL.setdefault((scheme, netloc), [])
+        if len(stack) < _POOL_MAX_PER_HOST:
+            stack.append(conn)
+            return
+    conn.close()
+
+
+def clear_conn_pool():
+    """Drop every pooled connection (tests; TLS reconfiguration)."""
+    with _POOL_LOCK:
+        for stack in _POOL.values():
+            for conn in stack:
+                conn.close()
+        _POOL.clear()
+
+
+def _nodelay(conn):
+    if conn.sock is not None:
+        try:
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                 1)
+        except OSError:
+            pass
+
+
+def _pooled_call(method: str, url: str, body, headers: dict,
+                 timeout: float, max_redirects: int = 5) -> bytes:
+    parsed = urllib.parse.urlsplit(url)
+    netloc, scheme = parsed.netloc, parsed.scheme
+    target = parsed.path or "/"
+    if parsed.query:
+        target += "?" + parsed.query
+    # A stale keep-alive connection fails at send/first-byte; retry once
+    # on a fresh connection — but only for idempotent methods with a
+    # replayable body. A POST whose server died between processing and
+    # responding must NOT silently re-execute (double assign/publish) —
+    # Go's http.Client draws the same line. Streaming bodies cannot be
+    # re-sent at all, so they always go out on a FRESH connection
+    # (their transfer time dwarfs the handshake).
+    replayable = body is None or isinstance(body, (bytes, bytearray))
+    idempotent = method in ("GET", "HEAD", "DELETE", "PUT")
+    attempts = 2 if (replayable and idempotent) else 1
+    for attempt in range(attempts):
+        if replayable:
+            conn, reused = _pool_get(scheme, netloc, timeout)
+        else:
+            conn, reused = _new_conn(scheme, netloc, timeout), False
+        try:
+            if conn.sock is None:
+                conn.connect()
+                _nodelay(conn)
+            conn.request(method, target, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except _RETRIABLE_STALE:
+            conn.close()
+            if reused and attempt + 1 < attempts:
+                continue
+            raise
+        except Exception:
+            conn.close()
+            raise
+        if resp.will_close:
+            conn.close()
+        else:
+            _pool_put(scheme, netloc, conn)
+        if 300 <= resp.status < 400 and resp.getheader("Location") \
+                and method in ("GET", "HEAD") and max_redirects > 0:
+            loc = urllib.parse.urljoin(url, resp.getheader("Location"))
+            return _pooled_call(method, loc, body, headers, timeout,
+                                max_redirects - 1)
+        if resp.status >= 400:
+            detail = data.decode("utf-8", "replace")[:500]
+            raise HttpError(resp.status, f"{method} {url}: {detail}")
+        return data
+    raise HttpError(503, f"{method} {url}: retries exhausted")
+
 
 def http_call(method: str, url: str, body: bytes = None,
               headers: dict = None, timeout: float = 30.0,
@@ -375,16 +579,22 @@ def http_call(method: str, url: str, body: bytes = None,
     """``external=True`` marks a non-cluster endpoint (webhooks, third
     parties): the URL keeps its scheme and https uses the default
     verified context — the cluster TLS rewrite must not break plain-HTTP
-    externals nor weaken hostname checks on real ones."""
-    ctx = None
+    externals nor weaken hostname checks on real ones. Cluster calls go
+    through the keep-alive pool."""
     if not external:
         url = _client_url(url)
-        ctx = _TLS["client_ctx"]
+        try:
+            return _pooled_call(method, url, body, headers or {},
+                                timeout)
+        except HttpError:
+            raise
+        except (OSError, _httpc.HTTPException) as e:
+            raise HttpError(503, f"{method} {url}: {e}") from None
     req = urllib.request.Request(url, data=body, method=method,
                                  headers=headers or {})
     try:
         with urllib.request.urlopen(req, timeout=timeout,
-                                    context=ctx) as resp:
+                                    context=None) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
         detail = e.read().decode("utf-8", "replace")[:500]
