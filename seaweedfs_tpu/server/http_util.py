@@ -56,7 +56,14 @@ class Request:
         the whole transfer (Go's http.Server draws the same line)."""
         if self._body is not None:
             return
-        left = int(self.headers.get("Content-Length") or 0)
+        try:
+            left = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            # malformed header: framing is unknowable — sever instead
+            # of masking the handler's response with a late error
+            self.handler.close_connection = True
+            self._body = b""
+            return
         if left > cap:
             self.handler.close_connection = True
             self._body = b""
@@ -176,6 +183,11 @@ def _make_handler(router: Router):
         # without NODELAY, Nagle holds the second write hostage to the
         # peer's delayed ACK (millisecond-scale stalls per request)
         disable_nagle_algorithm = True
+        # reap idle keep-alive connections: each one pins a handler
+        # thread, and pooled clients keep up to 32 per peer open.
+        # Applies to socket reads only — a long-poll that WAITS before
+        # responding is unaffected; only >75s gaps mid-read close
+        timeout = 75
 
         def log_message(self, fmt, *args):  # quiet
             pass
@@ -564,8 +576,10 @@ def _pooled_call(method: str, url: str, body, headers: dict,
         if 300 <= resp.status < 400 and resp.getheader("Location") \
                 and method in ("GET", "HEAD") and max_redirects > 0:
             loc = urllib.parse.urljoin(url, resp.getheader("Location"))
-            return _pooled_call(method, loc, body, headers, timeout,
-                                max_redirects - 1)
+            # redirect targets are emitted as plain http (volume read
+            # redirects) — re-apply the cluster TLS scheme rewrite
+            return _pooled_call(method, _client_url(loc), body, headers,
+                                timeout, max_redirects - 1)
         if resp.status >= 400:
             detail = data.decode("utf-8", "replace")[:500]
             raise HttpError(resp.status, f"{method} {url}: {detail}")

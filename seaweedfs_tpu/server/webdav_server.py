@@ -4,8 +4,9 @@ Reference weed/server/webdav_server.go + weed/command/webdav.go (the
 reference adapts golang.org/x/net/webdav's FileSystem interface onto
 filer gRPC; here the DAV protocol is handled directly: OPTIONS,
 PROPFIND depth 0/1, GET/HEAD with ranges, PUT, MKCOL, DELETE, MOVE,
-COPY, and class-2 LOCK/UNLOCK stubs so macOS/Windows clients mount
-read-write).
+COPY, and enforced class-2 LOCK/UNLOCK — exclusive write locks with
+timeouts, refresh, and 423 on token-less mutation, the same subset
+golang.org/x/net/webdav's in-memory LockSystem provides).
 
 Works over an in-process `Filer` or a remote `FilerClient`.
 """
@@ -30,11 +31,106 @@ DAV_NS = "DAV:"
 
 
 def _rfc1123(ts: float) -> str:
-    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+    # formatdate, not strftime: day/month names must be English
+    # regardless of LC_TIME — DAV clients parse Last-Modified
+    import email.utils
+    return email.utils.formatdate(ts, usegmt=True)
 
 
 def _iso8601(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+class _Lock:
+    __slots__ = ("token", "owner", "expires")
+
+    def __init__(self, token: str, owner: str, expires: float):
+        self.token = token
+        self.owner = owner
+        self.expires = expires
+
+
+class LockManager:
+    """In-memory exclusive write locks, depth-infinity (the shape
+    golang.org/x/net/webdav's memLS implements and office clients use).
+    A lock on a path covers the path and everything under it."""
+
+    def __init__(self):
+        import threading
+        self._locks: dict = {}       # path -> _Lock
+        self._mu = threading.Lock()
+
+    def _evict_expired(self, now: float):
+        dead = [p for p, lk in self._locks.items() if lk.expires <= now]
+        for p in dead:
+            del self._locks[p]
+
+    def _covering(self, path: str):
+        """(lock_path, lock) whose scope covers `path`, else None."""
+        probe = path
+        while True:
+            lk = self._locks.get(probe)
+            if lk is not None:
+                return probe, lk
+            if probe in ("/", ""):
+                return None
+            probe = posixpath.dirname(probe) or "/"
+
+    def acquire(self, path: str, timeout_s: float, owner: str) -> str:
+        now = time.time()
+        with self._mu:
+            self._evict_expired(now)
+            hit = self._covering(path)
+            if hit is not None:
+                raise HttpError(423, f"locked by {hit[1].owner or 'peer'}")
+            # a descendant lock also conflicts with an infinite-depth
+            # request on the ancestor
+            prefix = path.rstrip("/") + "/"
+            if any(p.startswith(prefix) for p in self._locks):
+                raise HttpError(423, "descendant is locked")
+            token = f"opaquelocktoken:{uuid.uuid4()}"
+            self._locks[path] = _Lock(token, owner, now + timeout_s)
+            return token
+
+    def refresh(self, path: str, if_header: str, timeout_s: float) -> str:
+        now = time.time()
+        with self._mu:
+            self._evict_expired(now)
+            hit = self._covering(path)
+            if hit is None:
+                raise HttpError(412, "no lock to refresh")
+            if hit[1].token not in (if_header or ""):
+                raise HttpError(412, "lock token mismatch")
+            hit[1].expires = now + timeout_s
+            return hit[1].token
+
+    def release(self, path: str, token: str) -> bool:
+        with self._mu:
+            self._evict_expired(time.time())
+            hit = self._covering(path)
+            if hit is None or hit[1].token != token:
+                return False
+            del self._locks[hit[0]]
+            return True
+
+    def require(self, path: str, if_header: str):
+        """Raise 423 unless `path` is unlocked or the covering lock's
+        token appears in the If header (RFC4918 tagged-list parsing is
+        simplified to a substring check, like many servers)."""
+        with self._mu:
+            self._evict_expired(time.time())
+            hit = self._covering(path)
+            if hit is not None and hit[1].token not in (if_header or ""):
+                raise HttpError(423, "resource is locked")
+
+    def forget(self, path: str):
+        """Drop any lock at `path` or below — the resource was deleted
+        or moved away (RFC4918 9.6)."""
+        prefix = path.rstrip("/") + "/"
+        with self._mu:
+            for p in [p for p in self._locks
+                      if p == path or p.startswith(prefix)]:
+                del self._locks[p]
 
 
 class WebDavServer:
@@ -46,6 +142,7 @@ class WebDavServer:
         self.filer = filer
         self.master_url = master_url
         self.chunk_size = chunk_size
+        self.locks = LockManager()
         self.collection = collection
         self.replication = replication
         self._fetch = fetcher
@@ -83,6 +180,16 @@ class WebDavServer:
             return self.propfind(req, path)
         if method in ("GET", "HEAD"):
             return self.get(req, path)
+        # class-2 enforcement: a mutating method on a locked resource
+        # must present the lock token (If header) or draw 423
+        if method in ("PUT", "DELETE", "MKCOL", "PROPPATCH"):
+            self.locks.require(path, req.headers.get("If", ""))
+        if method in ("MOVE", "COPY"):
+            if method == "MOVE":
+                self.locks.require(path, req.headers.get("If", ""))
+            dest = self._dest_path(req)
+            if dest:
+                self.locks.require(dest, req.headers.get("If", ""))
         if method == "PUT":
             return self.put(req, path)
         if method == "MKCOL":
@@ -97,7 +204,7 @@ class WebDavServer:
         if method == "LOCK":
             return self.lock(req, path)
         if method == "UNLOCK":
-            return Response(b"", 204)
+            return self.unlock(req, path)
         raise HttpError(405, method)
 
     # -- handlers -----------------------------------------------------------
@@ -173,15 +280,15 @@ class WebDavServer:
                                     ignore_recursive_error=True)
         except NotFoundError:
             raise HttpError(404, path) from None
+        # RFC4918 9.6: DELETE removes locks on the deleted resource —
+        # otherwise the path stays 423 for the rest of the lock timeout
+        self.locks.forget(path)
         return Response(b"", 204)
 
     def move_copy(self, req: Request, path: str, copy: bool):
-        dest_header = req.headers.get("Destination", "")
-        if not dest_header:
+        dest = self._dest_path(req)
+        if not dest:
             raise HttpError(400, "missing Destination header")
-        dest = urllib.parse.unquote(urllib.parse.urlparse(
-            dest_header).path)
-        dest = posixpath.normpath(dest)
         overwrite = req.headers.get("Overwrite", "T").upper() != "F"
         try:
             self.filer.find_entry(path)  # 404 before touching the dest
@@ -204,10 +311,51 @@ class WebDavServer:
             raise HttpError(404, path) from None
         except FilerError as e:
             raise HttpError(409, str(e)) from None
+        if not copy:
+            # the source no longer exists: its lock goes with it
+            self.locks.forget(path)
         return Response(b"", 204 if dest_existed else 201)
 
+    @staticmethod
+    def _dest_path(req: Request) -> str:
+        dest_header = req.headers.get("Destination", "")
+        if not dest_header:
+            return ""
+        return posixpath.normpath(urllib.parse.unquote(
+            urllib.parse.urlparse(dest_header).path))
+
+    @staticmethod
+    def _parse_timeout(header: str) -> float:
+        """'Second-N', 'Infinite', or comma list — first parsable wins
+        (RFC4918 10.7); capped like golang webdav's maxTimeout."""
+        for part in (header or "").split(","):
+            part = part.strip()
+            if part.lower().startswith("second-"):
+                try:
+                    return min(float(part[7:]), 7 * 24 * 3600.0)
+                except ValueError:
+                    continue
+            if part.lower() == "infinite":
+                return 7 * 24 * 3600.0
+        return 3600.0
+
     def lock(self, req: Request, path: str):
-        token = f"opaquelocktoken:{uuid.uuid4()}"
+        timeout = self._parse_timeout(req.headers.get("Timeout", ""))
+        owner = ""
+        body = req.body
+        if body:
+            try:
+                owner_el = ET.fromstring(body).find(
+                    "{%s}owner" % DAV_NS)
+                if owner_el is not None:
+                    owner = "".join(owner_el.itertext()).strip()
+            except ET.ParseError:
+                raise HttpError(400, "malformed lock body") from None
+            token = self.locks.acquire(path, timeout, owner)
+        else:
+            # bodyless LOCK = refresh of the token in the If header
+            token = self.locks.refresh(
+                path, req.headers.get("If", ""), timeout)
         ns = "{%s}" % DAV_NS
         root = ET.Element(ns + "prop")
         disc = ET.SubElement(root, ns + "lockdiscovery")
@@ -217,13 +365,25 @@ class WebDavServer:
         ET.SubElement(ET.SubElement(active, ns + "lockscope"),
                       ns + "exclusive")
         ET.SubElement(active, ns + "depth").text = "infinity"
-        ET.SubElement(active, ns + "timeout").text = "Second-3600"
+        ET.SubElement(active, ns + "timeout").text = \
+            f"Second-{int(timeout)}"
+        if owner:
+            ET.SubElement(active, ns + "owner").text = owner
         ET.SubElement(ET.SubElement(active, ns + "locktoken"),
                       ns + "href").text = token
         body = b'<?xml version="1.0" encoding="utf-8"?>' + \
             ET.tostring(root)
         return Response(body, 200, "application/xml",
                         {"Lock-Token": f"<{token}>"})
+
+    def unlock(self, req: Request, path: str):
+        header = req.headers.get("Lock-Token", "").strip()
+        token = header.strip("<>")
+        if not token:
+            raise HttpError(400, "missing Lock-Token header")
+        if not self.locks.release(path, token):
+            raise HttpError(409, "no such lock")
+        return Response(b"", 204)
 
     # -- helpers ------------------------------------------------------------
 

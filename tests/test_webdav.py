@@ -114,15 +114,67 @@ def test_mkcol_move_copy_delete(stack):
         dav_call(dav, "PROPFIND", "/mk")
 
 
-def test_lock_unlock_stub(stack):
+LOCK_BODY = (b'<?xml version="1.0"?><D:lockinfo xmlns:D="DAV:">'
+             b'<D:lockscope><D:exclusive/></D:lockscope>'
+             b'<D:locktype><D:write/></D:locktype>'
+             b'<D:owner>alice</D:owner></D:lockinfo>')
+
+
+def test_lock_enforced_and_released(stack):
     _, _, _, dav = stack
     dav_call(dav, "PUT", "/lk.txt", b"z")
-    status, headers, body = dav_call(dav, "LOCK", "/lk.txt",
-                                     body=b"<lockinfo/>")
+    status, headers, _ = dav_call(dav, "LOCK", "/lk.txt",
+                                  body=LOCK_BODY,
+                                  headers={"Timeout": "Second-60"})
     assert status == 200
-    assert headers["Lock-Token"].startswith("<opaquelocktoken:")
-    status, _, _ = dav_call(dav, "UNLOCK", "/lk.txt")
+    token = headers["Lock-Token"].strip("<>")
+    assert token.startswith("opaquelocktoken:")
+    # token-less mutation is refused
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "PUT", "/lk.txt", b"intruder")
+    assert ei.value.code == 423
+    assert dav_call(dav, "GET", "/lk.txt")[2] == b"z"
+    # a second LOCK conflicts
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "LOCK", "/lk.txt", body=LOCK_BODY)
+    assert ei.value.code == 423
+    # the holder writes with the token; refresh works bodyless
+    dav_call(dav, "PUT", "/lk.txt", b"held",
+             headers={"If": f"(<{token}>)"})
+    status, headers2, _ = dav_call(dav, "LOCK", "/lk.txt",
+                                   headers={"If": f"(<{token}>)",
+                                            "Timeout": "Second-120"})
+    assert status == 200
+    assert headers2["Lock-Token"].strip("<>") == token
+    # unlock needs the right token
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "UNLOCK", "/lk.txt",
+                 headers={"Lock-Token": "<opaquelocktoken:nope>"})
+    assert ei.value.code == 409
+    status, _, _ = dav_call(dav, "UNLOCK", "/lk.txt",
+                            headers={"Lock-Token": f"<{token}>"})
     assert status == 204
+    dav_call(dav, "PUT", "/lk.txt", b"free again")
+    assert dav_call(dav, "GET", "/lk.txt")[2] == b"free again"
+
+
+def test_lock_depth_covers_children_and_expires(stack):
+    _, _, _, dav = stack
+    dav_call(dav, "MKCOL", "/ldir")
+    status, headers, _ = dav_call(dav, "LOCK", "/ldir", body=LOCK_BODY,
+                                  headers={"Timeout": "Second-1"})
+    token = headers["Lock-Token"].strip("<>")
+    # the lock covers descendants (depth infinity)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "PUT", "/ldir/child.txt", b"x")
+    assert ei.value.code == 423
+    dav_call(dav, "PUT", "/ldir/child.txt", b"x",
+             headers={"If": f"(<{token}>)"})
+    # and it expires
+    import time as _time
+    _time.sleep(1.2)
+    dav_call(dav, "PUT", "/ldir/child.txt", b"after-expiry")
+    assert dav_call(dav, "GET", "/ldir/child.txt")[2] == b"after-expiry"
 
 
 # -- FilerClient over the metadata API --------------------------------------
