@@ -341,6 +341,93 @@ def cmd_watch(args):
         pass
 
 
+def cmd_filer_copy(args):
+    """Copy local files/directories into the filer (reference
+    `weed filer.copy`, weed/command/filer_copy.go): the last argument
+    is the filer URL destination folder, everything before it is a
+    local source; directories recurse, -include filters by glob, -c
+    uploads files concurrently."""
+    import fnmatch
+    import mimetypes
+    import os
+    import posixpath as pp
+    import urllib.parse
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..server.http_util import http_call
+
+    if len(args.paths) < 2:
+        raise SystemExit("usage: filer.copy <src>... http://filer/dir/")
+    dest = args.paths[-1]
+    sources = args.paths[:-1]
+    parsed = urllib.parse.urlparse(
+        dest if "://" in dest else "http://" + dest)
+    filer = parsed.netloc
+    dest_dir = parsed.path.rstrip("/") or "/"
+
+    work = []  # (local_path, remote_path)
+    for src in sources:
+        if os.path.isdir(src):
+            base = os.path.basename(os.path.abspath(src))
+            for root, _dirs, files in os.walk(src):
+                rel_root = os.path.relpath(root, src)
+                for name in files:
+                    if args.include and not fnmatch.fnmatch(
+                            name, args.include):
+                        continue
+                    rel = name if rel_root == "." else \
+                        os.path.join(rel_root, name)
+                    work.append((os.path.join(root, name),
+                                 pp.join(dest_dir, base,
+                                         rel.replace(os.sep, "/"))))
+        elif os.path.isfile(src):
+            if args.include and not fnmatch.fnmatch(
+                    os.path.basename(src), args.include):
+                continue
+            work.append((src, pp.join(dest_dir, os.path.basename(src))))
+        else:
+            raise SystemExit(f"no such file or directory: {src}")
+
+    q = []
+    if args.collection:
+        q.append(f"collection={urllib.parse.quote(args.collection)}")
+    if args.replication:
+        q.append(f"replication={urllib.parse.quote(args.replication)}")
+    if args.ttl:
+        q.append(f"ttl={urllib.parse.quote(args.ttl)}")
+    suffix = ("?" + "&".join(q)) if q else ""
+
+    def put(item):
+        local, remote = item
+        size = os.path.getsize(local)
+        mime = mimetypes.guess_type(local)[0] or \
+            "application/octet-stream"
+        # stream the file object: -c workers each holding a whole
+        # file in RAM would OOM on volume-sized inputs
+        with open(local, "rb") as f:
+            http_call("PUT",
+                      f"http://{filer}"
+                      f"{urllib.parse.quote(remote)}{suffix}",
+                      f, {"Content-Type": mime,
+                          "Content-Length": str(size)}, timeout=600)
+        return remote, size
+
+    copied = errors = 0
+    with ThreadPoolExecutor(max_workers=args.c) as pool:
+        for fut in [pool.submit(put, item) for item in work]:
+            try:
+                remote, n = fut.result()
+                copied += 1
+                print(f"{remote} ({n} bytes)")
+            except Exception as e:  # noqa: BLE001 - per-file report
+                errors += 1
+                print(f"ERROR: {e}", file=sys.stderr)
+    print(f"copied {copied} files to {filer}{dest_dir}"
+          + (f", {errors} failed" if errors else ""))
+    if errors:
+        raise SystemExit(1)
+
+
 def cmd_filer_replicate(args):
     import json
     from ..replication import (EventSubscriber, FilerSource, Replicator,
@@ -643,6 +730,19 @@ def build_parser() -> argparse.ArgumentParser:
     wt.add_argument("-since", type=float, default=0.0,
                     help="resume from this event timestamp")
     wt.set_defaults(fn=cmd_watch)
+
+    fc = sub.add_parser("filer.copy",
+                        help="copy local files/dirs into the filer")
+    fc.add_argument("paths", nargs="+",
+                    help="src... then http://filer:8888/dest/dir/")
+    fc.add_argument("-include", default="",
+                    help="glob of file names to copy, e.g. *.pdf")
+    fc.add_argument("-collection", default="")
+    fc.add_argument("-replication", default="")
+    fc.add_argument("-ttl", default="")
+    fc.add_argument("-c", type=int, default=8,
+                    help="concurrent file uploads")
+    fc.set_defaults(fn=cmd_filer_copy)
 
     fr = sub.add_parser("filer.replicate",
                         help="continuously replicate filer changes to a "

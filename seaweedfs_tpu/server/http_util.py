@@ -42,7 +42,16 @@ class Request:
     @property
     def body(self) -> bytes:
         if self._body is None:
-            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = -1
+            if length < 0:
+                # malformed/negative: framing is unknowable — refuse
+                # and sever rather than reading until EOF
+                self.handler.close_connection = True
+                self._body = b""
+                raise HttpError(400, "bad Content-Length header")
             self._body = self.handler.rfile.read(length) if length else b""
         return self._body
 

@@ -114,14 +114,21 @@ class LockManager:
             return True
 
     def require(self, path: str, if_header: str):
-        """Raise 423 unless `path` is unlocked or the covering lock's
-        token appears in the If header (RFC4918 tagged-list parsing is
-        simplified to a substring check, like many servers)."""
+        """Raise 423 unless every lock whose scope intersects `path` —
+        a covering ancestor lock OR any descendant lock (a mutation of
+        a directory destroys what's under it) — has its token in the
+        If header (RFC4918 tagged-list parsing is simplified to a
+        substring check, like many servers)."""
         with self._mu:
             self._evict_expired(time.time())
             hit = self._covering(path)
             if hit is not None and hit[1].token not in (if_header or ""):
                 raise HttpError(423, "resource is locked")
+            prefix = path.rstrip("/") + "/"
+            for p, lk in self._locks.items():
+                if p.startswith(prefix) and \
+                        lk.token not in (if_header or ""):
+                    raise HttpError(423, f"{p} is locked")
 
     def forget(self, path: str):
         """Drop any lock at `path` or below — the resource was deleted

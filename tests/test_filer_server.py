@@ -191,3 +191,46 @@ def test_multipart_preserves_trailing_newlines(cluster):
     data2 = b"\r\nstarts and ends with crlf\r\n"
     post_multipart(furl(filer, "/nl2.bin"), "nl2.bin", data2)
     assert http_call("GET", furl(filer, "/nl2.bin")) == data2
+
+
+def test_cli_filer_copy(cluster, tmp_path):
+    """weed filer.copy walks local trees into the filer (reference
+    weed/command/filer_copy.go)."""
+    import os
+    import subprocess
+    import sys
+    _, _, filer = cluster
+    src = tmp_path / "tree"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_bytes(b"alpha")
+    (src / "sub" / "b.pdf").write_bytes(b"%PDF beta")
+    (src / "sub" / "skip.bin").write_bytes(b"nope")
+    single = tmp_path / "single.txt"
+    single.write_bytes(b"solo")
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.command.cli",
+         "filer.copy", str(src), str(single),
+         f"http://{filer.url}/imported/"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert http_call("GET", furl(filer, "/imported/tree/a.txt")) == \
+        b"alpha"
+    assert http_call("GET", furl(filer, "/imported/tree/sub/b.pdf")) == \
+        b"%PDF beta"
+    assert http_call("GET", furl(filer, "/imported/single.txt")) == \
+        b"solo"
+    # -include filters
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.command.cli",
+         "filer.copy", "-include", "*.pdf", str(src),
+         f"http://{filer.url}/pdfonly/"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert http_call("GET", furl(filer, "/pdfonly/tree/sub/b.pdf")) == \
+        b"%PDF beta"
+    import pytest as _pytest
+    from seaweedfs_tpu.server.http_util import HttpError
+    with _pytest.raises(HttpError):
+        http_call("GET", furl(filer, "/pdfonly/tree/a.txt"))

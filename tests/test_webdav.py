@@ -158,6 +158,30 @@ def test_lock_enforced_and_released(stack):
     assert dav_call(dav, "GET", "/lk.txt")[2] == b"free again"
 
 
+def test_locked_child_blocks_parent_mutation(stack):
+    """DELETE/MOVE of a directory must 423 when a descendant holds a
+    lock the caller didn't present — a parent delete would destroy the
+    locked resource."""
+    _, _, _, dav = stack
+    dav_call(dav, "MKCOL", "/pdir")
+    dav_call(dav, "PUT", "/pdir/held.txt", b"h")
+    _, headers, _ = dav_call(dav, "LOCK", "/pdir/held.txt",
+                             body=LOCK_BODY,
+                             headers={"Timeout": "Second-60"})
+    token = headers["Lock-Token"].strip("<>")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_call(dav, "DELETE", "/pdir")
+    assert ei.value.code == 423
+    assert dav_call(dav, "GET", "/pdir/held.txt")[2] == b"h"
+    # with the descendant's token the parent delete proceeds and the
+    # lock dies with the tree
+    status, _, _ = dav_call(dav, "DELETE", "/pdir",
+                            headers={"If": f"(<{token}>)"})
+    assert status == 204
+    dav_call(dav, "MKCOL", "/pdir")
+    dav_call(dav, "PUT", "/pdir/held.txt", b"fresh")  # no 423: lock gone
+
+
 def test_lock_depth_covers_children_and_expires(stack):
     _, _, _, dav = stack
     dav_call(dav, "MKCOL", "/ldir")
