@@ -363,7 +363,9 @@ def cmd_filer_copy(args):
     parsed = urllib.parse.urlparse(
         dest if "://" in dest else "http://" + dest)
     filer = parsed.netloc
-    dest_dir = parsed.path.rstrip("/") or "/"
+    # decode before joining: put() re-quotes the final path, so keeping
+    # the URL encoding here would double-escape ("%20" -> "%2520")
+    dest_dir = urllib.parse.unquote(parsed.path).rstrip("/") or "/"
 
     work = []  # (local_path, remote_path)
     for src in sources:

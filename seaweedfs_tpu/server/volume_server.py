@@ -884,6 +884,19 @@ class VolumeServer:
             return self._chunk_manifest_response(got, req)
         ctype = got.mime.decode() if got.has_mime() \
             else "application/octet-stream"
+        # conditional GET (reference volume_server_handlers_read.go
+        # If-None-Match vs Etag -> 304): immutable needles make etags
+        # exact, so a revalidating client pays zero body bytes.
+        # RFC7232: the header is a comma list of (possibly weak)
+        # validators, or "*" matching any representation.
+        if req is not None:
+            inm = (req.headers.get("If-None-Match") or "").strip()
+            if inm:
+                candidates = {c.strip().removeprefix("W/")
+                              for c in inm.split(",")}
+                if "*" in candidates or f'"{got.etag}"' in candidates:
+                    return Response(b"", 304,
+                                    headers={"Etag": f'"{got.etag}"'})
         headers = {"Etag": f'"{got.etag}"',
                    "Accept-Ranges": "bytes"}
         if got.has_pairs() and got.pairs:

@@ -113,22 +113,25 @@ class LockManager:
             del self._locks[hit[0]]
             return True
 
-    def require(self, path: str, if_header: str):
-        """Raise 423 unless every lock whose scope intersects `path` —
-        a covering ancestor lock OR any descendant lock (a mutation of
-        a directory destroys what's under it) — has its token in the
-        If header (RFC4918 tagged-list parsing is simplified to a
-        substring check, like many servers)."""
+    def require(self, path: str, if_header: str,
+                descendants: bool = False):
+        """Raise 423 unless the lock covering `path` has its token in
+        the If header (RFC4918 tagged-list parsing is simplified to a
+        substring check, like many servers). With ``descendants=True``
+        — for operations that destroy the subtree (DELETE, MOVE,
+        overwriting COPY) — locks held below `path` must be presented
+        too; a PROPPATCH/MKCOL on the parent doesn't touch them."""
         with self._mu:
             self._evict_expired(time.time())
             hit = self._covering(path)
             if hit is not None and hit[1].token not in (if_header or ""):
                 raise HttpError(423, "resource is locked")
-            prefix = path.rstrip("/") + "/"
-            for p, lk in self._locks.items():
-                if p.startswith(prefix) and \
-                        lk.token not in (if_header or ""):
-                    raise HttpError(423, f"{p} is locked")
+            if descendants:
+                prefix = path.rstrip("/") + "/"
+                for p, lk in self._locks.items():
+                    if p.startswith(prefix) and \
+                            lk.token not in (if_header or ""):
+                        raise HttpError(423, f"{p} is locked")
 
     def forget(self, path: str):
         """Drop any lock at `path` or below — the resource was deleted
@@ -188,15 +191,20 @@ class WebDavServer:
         if method in ("GET", "HEAD"):
             return self.get(req, path)
         # class-2 enforcement: a mutating method on a locked resource
-        # must present the lock token (If header) or draw 423
-        if method in ("PUT", "DELETE", "MKCOL", "PROPPATCH"):
-            self.locks.require(path, req.headers.get("If", ""))
+        # must present the lock token (If header) or draw 423; subtree-
+        # destroying operations must also present descendant locks
+        if_header = req.headers.get("If", "")
+        if method in ("PUT", "MKCOL", "PROPPATCH"):
+            self.locks.require(path, if_header)
+        if method == "DELETE":
+            self.locks.require(path, if_header, descendants=True)
         if method in ("MOVE", "COPY"):
             if method == "MOVE":
-                self.locks.require(path, req.headers.get("If", ""))
+                self.locks.require(path, if_header, descendants=True)
             dest = self._dest_path(req)
             if dest:
-                self.locks.require(dest, req.headers.get("If", ""))
+                # an overwriting MOVE/COPY replaces the dest subtree
+                self.locks.require(dest, if_header, descendants=True)
         if method == "PUT":
             return self.put(req, path)
         if method == "MKCOL":

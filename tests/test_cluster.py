@@ -185,3 +185,29 @@ def test_get_bucket_location():
         assert "LocationConstraint" in root.tag
     finally:
         s3.stop()
+
+
+def test_conditional_get_etag_304(cluster):
+    """If-None-Match revalidation returns 304 with no body (reference
+    volume_server_handlers_read.go Etag check)."""
+    import http.client
+    master, _ = cluster
+    a = op.assign(master.url)
+    op.upload(a["url"], a["fid"], b"cacheable-bytes", filename="c.bin")
+    conn = http.client.HTTPConnection(a["url"], timeout=10)
+    conn.request("GET", f"/{a['fid']}")
+    resp = conn.getresponse()
+    body = resp.read()
+    etag = resp.getheader("Etag")
+    assert resp.status == 200 and body == b"cacheable-bytes" and etag
+    conn.request("GET", f"/{a['fid']}",
+                 headers={"If-None-Match": etag})
+    resp = conn.getresponse()
+    assert resp.status == 304
+    assert resp.read() == b""
+    # a stale etag still gets the full body
+    conn.request("GET", f"/{a['fid']}",
+                 headers={"If-None-Match": '"deadbeef"'})
+    resp = conn.getresponse()
+    assert resp.status == 200 and resp.read() == b"cacheable-bytes"
+    conn.close()
