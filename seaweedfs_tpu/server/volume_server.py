@@ -41,6 +41,8 @@ class VolumeServer:
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
         router.add("POST", "/admin/delete_volume", self.admin_delete_volume)
         router.add("POST", "/admin/volume/readonly", self.admin_readonly)
+        router.add("POST", "/admin/volume/configure_replication",
+                   self.admin_configure_replication)
         router.add("POST", "/admin/volume/mount", self.admin_volume_mount)
         router.add("POST", "/admin/volume/unmount",
                    self.admin_volume_unmount)
@@ -355,6 +357,25 @@ class VolumeServer:
         if not self.store.mark_volume_readonly(vid, readonly):
             raise HttpError(404, f"volume {vid} not found")
         return {"volume": vid, "readonly": readonly}
+
+    def admin_configure_replication(self, req: Request):
+        """Rewrite a volume's replica placement in its superblock
+        (reference volume_grpc_admin.go VolumeConfigure)."""
+        from ..storage.types import ReplicaPlacement
+        vid = int(req.query["volume"])
+        try:
+            rp = ReplicaPlacement.parse(req.query.get("replication", ""))
+        except (ValueError, KeyError) as e:
+            raise HttpError(400, f"bad replication: {e}") from None
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise HttpError(404, f"volume {vid} not found")
+        from ..storage.backend import BackendError
+        try:
+            v.configure_replication(rp)
+        except (VolumeError, BackendError) as e:
+            raise HttpError(409, str(e)) from None
+        return {"volume": vid, "replication": str(rp)}
 
     def admin_volume_mount(self, req: Request):
         """Load an on-disk volume into serving (reference

@@ -149,6 +149,24 @@ def fs_rm(env: CommandEnv, args: List[str]):
                                  ignore_recursive_error=False)
 
 
+@command("fs.meta.cat", "<path> : print one entry's raw metadata")
+def fs_meta_cat(env: CommandEnv, args: List[str]):
+    """Reference command_fs_meta_cat.go: the full wire-shape entry
+    (attrs, chunks, extended) as indented JSON."""
+    from ..filer.filer import NotFoundError
+    _flags, operands = parse_flags2(args)
+    if not operands:
+        env.write("usage: fs.meta.cat <path>")
+        return
+    path = env.resolve(operands[0])
+    try:
+        e = env.filer().find_entry(path)
+    except (HttpError, NotFoundError):
+        env.write(f"{path}: not found")
+        return
+    env.write(json.dumps(entry_to_wire(e), indent=2, sort_keys=True))
+
+
 @command("fs.meta.save",
          "[-o out.jsonl] [path] : dump filer metadata to a file")
 def fs_meta_save(env: CommandEnv, args: List[str]):

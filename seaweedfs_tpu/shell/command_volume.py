@@ -31,6 +31,50 @@ def volume_list(env: CommandEnv, args: List[str]):
                                                  key=lambda kv: int(kv[0]))))
 
 
+@command("volume.copy",
+         "-volumeId <id> -target <url> [-source <url>] : copy a volume "
+         "to another server (source kept)")
+def volume_copy(env: CommandEnv, args: List[str]):
+    """Reference command_volume_copy.go: target pulls the volume's
+    files from the source; unlike volume.move the source stays. Shares
+    volume.move's audited freeze/copy/thaw sequence."""
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    target = flags["target"]
+    replicas = env.all_volumes().get(str(vid), [])
+    if not replicas:
+        env.write(f"volume {vid} not found")
+        return
+    source = flags.get("source", replicas[0]["url"])
+    collection = replicas[0].get("collection", "")
+    _frozen_copy(env, vid, collection, source, target, replicas,
+                 delete_source=False)
+    env.write(f"volume {vid}: copied {source} -> {target}")
+
+
+@command("volume.configure.replication",
+         "-volumeId <id> -replication <xyz> : change a volume's "
+         "replica placement")
+def volume_configure_replication(env: CommandEnv, args: List[str]):
+    """Reference command_volume_configure_replication.go: rewrite the
+    superblock placement byte on every holder; the master adopts the
+    new placement from the next heartbeats (repair to the new level is
+    then volume.fix.replication's job)."""
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    replication = flags["replication"]
+    replicas = env.all_volumes().get(str(vid), [])
+    if not replicas:
+        env.write(f"volume {vid} not found")
+        return
+    for r in replicas:
+        env.node_post(r["url"],
+                      f"/admin/volume/configure_replication"
+                      f"?volume={vid}&replication={replication}")
+    env.write(f"volume {vid}: replication -> {replication} on "
+              f"{len(replicas)} holder(s)")
+
+
 @command("volume.move",
          "-volumeId <id> -target <url> : move a volume to another server")
 def volume_move(env: CommandEnv, args: List[str]):
@@ -47,24 +91,30 @@ def volume_move(env: CommandEnv, args: List[str]):
     env.write(f"volume {vid}: {source} -> {target}")
 
 
-def _move_volume(env: CommandEnv, vid: int, collection: str, source: str,
-                 target: str, replicas):
-    """Freeze -> copy -> delete source -> thaw survivors. Without the
-    freeze, writes landing after the .idx snapshot would be lost when the
-    source is deleted (the copy is .idx-then-.dat)."""
-    urls = [r["url"] for r in replicas]
-    for url in urls:
-        env.node_post(url, f"/admin/volume/readonly?volume={vid}")
+def _frozen_copy(env: CommandEnv, vid: int, collection: str, source: str,
+                 target: str, replicas, delete_source: bool):
+    """Freeze -> copy [-> delete source] -> thaw exactly what WE froze.
+    Without the freeze, writes landing after the .idx snapshot would be
+    lost (the copy is .idx-then-.dat). Replicas that were already
+    readonly (an operator's deliberate freeze, a keep-local tiered
+    volume) are left untouched — and left frozen afterwards."""
+    froze = []
     deleted = False
     try:
+        for r in replicas:
+            if r.get("read_only"):
+                continue
+            env.node_post(r["url"],
+                          f"/admin/volume/readonly?volume={vid}")
+            froze.append(r["url"])
         env.node_post(target, f"/admin/volume/copy?volume={vid}"
                               f"&collection={collection}&source={source}")
-        env.node_post(source, f"/admin/delete_volume?volume={vid}")
-        deleted = True
+        if delete_source:
+            env.node_post(source, f"/admin/delete_volume?volume={vid}")
+            deleted = True
     finally:
-        # always thaw whatever replicas still hold the volume, even when
-        # the copy or delete blew up mid-way
-        for url in urls:
+        # thaw our freezes even when the copy or delete blew up mid-way
+        for url in froze:
             if deleted and url == source:
                 continue
             try:
@@ -72,6 +122,12 @@ def _move_volume(env: CommandEnv, vid: int, collection: str, source: str,
                                    f"&readonly=false")
             except Exception:
                 pass
+
+
+def _move_volume(env: CommandEnv, vid: int, collection: str, source: str,
+                 target: str, replicas):
+    _frozen_copy(env, vid, collection, source, target, replicas,
+                 delete_source=True)
 
 
 @command("volume.balance", ": even out volume counts across servers")

@@ -147,6 +147,24 @@ class Volume:
             self.dat.seek(0, os.SEEK_END)
             return self.dat.tell()
 
+    def configure_replication(self, rp) -> None:
+        """Rewrite this volume's replica placement in the superblock
+        (reference command_volume_configure_replication.go →
+        VolumeConfigure: byte 1 of the .dat). The master sees the new
+        placement on the next heartbeat."""
+        with self.lock:
+            if self.readonly:
+                # same guard as every write path: a tiered/parked
+                # volume's local superblock must not silently diverge
+                # from the remote copy — thaw (or tier.download) first
+                raise VolumeError(
+                    f"volume {self.id} is read only; cannot reconfigure "
+                    f"replication")
+            self.super_block.replica_placement = rp
+            self.dat.seek(1)
+            self.dat.write(bytes([rp.to_byte()]))
+            self.dat.flush()
+
     def garbage_level(self) -> float:
         sz = self.size()
         if sz <= SUPER_BLOCK_SIZE:

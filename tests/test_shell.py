@@ -178,3 +178,80 @@ def test_volume_mount_unmount_cycle(cluster3):
     assert "mounted=True" in out2.getvalue()  # the substring 'mounted='
     assert http_call("GET", f"http://{holder.url}/{fid}") \
         == payloads[fid]
+
+
+def test_volume_copy_keeps_source(cluster3):
+    """volume.copy replicates a volume to a target while the source
+    keeps serving (reference command_volume_copy.go)."""
+    master, servers = cluster3
+    vid, payloads = _fill_volume(master.url)
+    env, out = _env(master)
+    replicas = env.all_volumes()[str(vid)]
+    source = replicas[0]["url"]
+    target = next(n["url"] for n in env.cluster_nodes()
+                  if n["url"] != source)
+    run_command(env, f"volume.copy -volumeId {vid} -target {target}")
+    assert "copied" in out.getvalue()
+    time.sleep(1.5)  # both holders reach the master via pulse
+    env2, _ = _env(master)
+    urls = {r["url"] for r in env2.all_volumes()[str(vid)]}
+    assert urls == {source, target}
+    # the data reads identically from both holders
+    import seaweedfs_tpu.server.http_util as hu
+    for fid, data in payloads.items():
+        for u in urls:
+            assert hu.http_call("GET", f"http://{u}/{fid}") == data
+    # the source was thawed: a direct write INTO that volume succeeds
+    out = hu.post_multipart(f"http://{source}/{vid},fe00000000aa",
+                            "thaw.bin", b"post-copy-write")
+    assert out.get("size") == len(b"post-copy-write")
+    # a pre-frozen replica must stay frozen through a copy
+    hu.post_json(f"http://{source}/admin/volume/readonly?volume={vid}")
+    time.sleep(1.5)  # the freeze reaches the master via pulse
+    env3, _ = _env(master)
+    other = next(n["url"] for n in env3.cluster_nodes()
+                 if n["url"] not in (source, target))
+    run_command(env3, f"volume.copy -volumeId {vid} -source {source} "
+                      f"-target {other}")
+    vs_src = next(s for s in servers if s.url == source)
+    assert vs_src.store.find_volume(vid).readonly, \
+        "deliberate freeze was wiped by volume.copy"
+
+
+def test_volume_configure_replication(cluster3):
+    master, servers = cluster3
+    vid, _ = _fill_volume(master.url)
+    env, out = _env(master)
+    run_command(env,
+                f"volume.configure.replication -volumeId {vid} "
+                f"-replication 001")
+    assert "replication -> 001" in out.getvalue()
+    # the superblock byte changed on disk: reload the volume and check
+    holder = env.all_volumes()[str(vid)][0]["url"]
+    vs = next(s for s in servers if s.url == holder)
+    v = vs.store.find_volume(vid)
+    assert str(v.super_block.replica_placement) == "001"
+    # persisted: byte 1 of the .dat
+    with open(v.dat_path, "rb") as f:
+        f.seek(1)
+        assert f.read(1)[0] == 1
+
+
+def test_fs_meta_cat(cluster3, tmp_path):
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    master, _ = cluster3
+    filer = FilerServer(port=0, master_url=master.url).start()
+    try:
+        import seaweedfs_tpu.server.http_util as hu
+        hu.http_call("POST", f"http://{filer.url}/meta/doc.bin",
+                     b"meta-bytes",
+                     {"Content-Type": "application/octet-stream"})
+        env, out = _env(master)
+        env.filer_url = filer.url
+        run_command(env, "fs.meta.cat /meta/doc.bin")
+        import json as _json
+        meta = _json.loads(out.getvalue())
+        assert meta["chunks"] and meta["Mime"]
+        assert meta["FullPath"] == "/meta/doc.bin"
+    finally:
+        filer.stop()
