@@ -307,6 +307,18 @@ def cmd_backup(args):
           f"{out['applied']} records, {out['size']} bytes")
 
 
+def cmd_see(args):
+    from . import volume_tools
+    if args.file.endswith(".idx") or args.file.endswith(".ecx"):
+        n = volume_tools.see_idx(args.file,
+                                 offset_width=args.offsetWidth,
+                                 limit=args.limit)
+        print(f"{n} index records")
+    else:
+        n = volume_tools.see_dat(args.file, limit=args.limit)
+        print(f"{n} needles")
+
+
 def cmd_export(args):
     from .volume_tools import export_volume
     listed = export_volume(args.dir, args.volumeId,
@@ -764,6 +776,16 @@ def build_parser() -> argparse.ArgumentParser:
     bk.add_argument("-volumeId", type=int, required=True)
     bk.add_argument("-collection", default="")
     bk.set_defaults(fn=cmd_backup)
+
+    se = sub.add_parser("see",
+                        help="dump .dat/.idx records as text (reference "
+                             "see_dat/see_idx debug tools)")
+    se.add_argument("file", help="path to a .dat or .idx file")
+    se.add_argument("-offsetWidth", type=int, default=4,
+                    choices=[4, 5], help="idx entry offset width")
+    se.add_argument("-limit", type=int, default=0,
+                    help="stop after N records (0 = all)")
+    se.set_defaults(fn=cmd_see)
 
     ex = sub.add_parser("export", help="export volume needles to tar")
     ex.add_argument("-dir", default=".")

@@ -17,6 +17,7 @@ from typing import Optional
 from ..client import operation as op
 from ..server.http_util import get_json, http_call, http_download
 from ..storage import volume_backup
+from ..storage.needle_map import walk_index_file
 from ..storage.types import TOMBSTONE_FILE_SIZE
 from ..storage.volume import Volume, VolumeError, volume_file_prefix
 
@@ -129,3 +130,70 @@ def compact_volume(dirname: str, vid: int, collection: str = "") -> dict:
         return {"volume": vid, "before": before, "after": v.size()}
     finally:
         v.close()
+
+
+def see_idx(idx_path: str, offset_width: int = 4, out=None,
+            limit: int = 0) -> int:
+    """Print every .idx record as `key offset size` (reference
+    unmaintained/see_idx/see_idx.go). Returns the record count."""
+    import sys as _sys
+    out = out or _sys.stdout
+    count = 0
+    for nid, offset, size in walk_index_file(idx_path, offset_width):
+        print(f"key {nid} offset {offset} size {size}"
+              + (" (tombstone)" if size == TOMBSTONE_FILE_SIZE else ""),
+              file=out)
+        count += 1
+        if limit and count >= limit:
+            break
+    return count
+
+
+def see_dat(dat_path: str, out=None, limit: int = 0) -> int:
+    """Scan a .dat and print each needle record (reference
+    unmaintained/see_dat/see_dat.go): offset, id, cookie, sizes, name,
+    mime. A size-0 record is a delete marker — that is how
+    delete_needle appends tombstones to the .dat (the 0xFFFFFFFF
+    TOMBSTONE_FILE_SIZE value exists only in .idx records). Returns
+    the needle count."""
+    import sys as _sys
+
+    from ..storage.needle import Needle, get_actual_size
+    from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+    out = out or _sys.stdout
+    count = 0
+    with open(dat_path, "rb") as f:
+        sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+        print(f"superblock: version {sb.version} replication "
+              f"{sb.replica_placement} ttl {sb.ttl} "
+              f"compact_revision {sb.compaction_revision}", file=out)
+        f.seek(0, 2)
+        end = f.tell()
+        pos = SUPER_BLOCK_SIZE
+        while pos + 16 <= end and (not limit or count < limit):
+            f.seek(pos)
+            header = f.read(16)
+            if len(header) < 16:
+                break
+            n = Needle.parse_header(header)
+            total = get_actual_size(n.size, sb.version)
+            f.seek(pos)
+            blob = f.read(total)
+            try:
+                full = Needle.from_bytes(blob, sb.version,
+                                         expected_size=n.size)
+                name = full.name.decode("utf-8", "replace") \
+                    if full.has_name() else ""
+                mime = full.mime.decode("utf-8", "replace") \
+                    if full.has_mime() else ""
+            except Exception:  # torn tail
+                name = mime = ""
+            print(f"offset {pos} id {n.id} cookie {n.cookie:08x} "
+                  f"size {n.size}"
+                  + (f" name {name!r}" if name else "")
+                  + (f" mime {mime}" if mime else "")
+                  + (" DELETED" if n.size == 0 else ""), file=out)
+            count += 1
+            pos += total  # get_actual_size is already 8-byte aligned
+    return count

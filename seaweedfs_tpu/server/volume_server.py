@@ -354,9 +354,16 @@ class VolumeServer:
     def admin_readonly(self, req: Request):
         vid = int(req.query["volume"])
         readonly = req.query.get("readonly", "true") == "true"
-        if not self.store.mark_volume_readonly(vid, readonly):
+        v = self.store.find_volume(vid)
+        if v is None:
             raise HttpError(404, f"volume {vid} not found")
-        return {"volume": vid, "readonly": readonly}
+        was = v.readonly
+        v.readonly = readonly
+        # was_readonly lets orchestrators (volume.copy/move freeze)
+        # restore exactly the prior state instead of trusting the
+        # master's heartbeat-delayed view
+        return {"volume": vid, "readonly": readonly,
+                "was_readonly": was}
 
     def admin_configure_replication(self, req: Request):
         """Rewrite a volume's replica placement in its superblock

@@ -67,12 +67,22 @@ def volume_configure_replication(env: CommandEnv, args: List[str]):
     if not replicas:
         env.write(f"volume {vid} not found")
         return
+    done, failed = [], []
     for r in replicas:
-        env.node_post(r["url"],
-                      f"/admin/volume/configure_replication"
-                      f"?volume={vid}&replication={replication}")
+        try:
+            env.node_post(r["url"],
+                          f"/admin/volume/configure_replication"
+                          f"?volume={vid}&replication={replication}")
+            done.append(r["url"])
+        except Exception as e:  # noqa: BLE001 - per-holder report
+            failed.append((r["url"], str(e)))
     env.write(f"volume {vid}: replication -> {replication} on "
-              f"{len(replicas)} holder(s)")
+              f"{len(done)} holder(s)")
+    for url, err in failed:
+        env.write(f"  FAILED on {url}: {err}")
+    if done and failed:
+        env.write(f"  WARNING: holders now disagree on placement — "
+                  f"fix the failures and re-run")
 
 
 @command("volume.move",
@@ -102,11 +112,13 @@ def _frozen_copy(env: CommandEnv, vid: int, collection: str, source: str,
     deleted = False
     try:
         for r in replicas:
-            if r.get("read_only"):
-                continue
-            env.node_post(r["url"],
-                          f"/admin/volume/readonly?volume={vid}")
-            froze.append(r["url"])
+            # freeze unconditionally (idempotent); the response's
+            # was_readonly — the holder's OWN prior state, not the
+            # master's heartbeat-delayed view — decides what to thaw
+            out = env.node_post(r["url"],
+                                f"/admin/volume/readonly?volume={vid}")
+            if not (out or {}).get("was_readonly"):
+                froze.append(r["url"])
         env.node_post(target, f"/admin/volume/copy?volume={vid}"
                               f"&collection={collection}&source={source}")
         if delete_source:

@@ -228,3 +228,37 @@ def test_backup_full_resync_after_compaction(tmp_path, live):
     assert local.super_block.compaction_revision == \
         v.super_block.compaction_revision
     local.close()
+
+
+def test_see_dat_and_see_idx(tmp_path):
+    """The see_dat/see_idx debug dumps (reference unmaintained/) print
+    superblock + per-needle records and raw index entries."""
+    import io as _io
+
+    from seaweedfs_tpu.command.volume_tools import see_dat, see_idx
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 9, create=True)
+    n1 = Needle(id=1, cookie=0xAB, data=b"first")
+    n1.set_name(b"a.txt")
+    n1.set_mime(b"text/plain")
+    v.write_needle(n1)
+    v.write_needle(Needle(id=2, cookie=0xCD, data=b"second"))
+    v.delete_needle(Needle(id=2, cookie=0xCD))
+    v.close()
+
+    out = _io.StringIO()
+    n = see_dat(str(tmp_path / "9.dat"), out=out)
+    text = out.getvalue()
+    assert n >= 2
+    assert "superblock: version" in text
+    assert "name 'a.txt'" in text and "mime text/plain" in text
+    assert "id 2" in text
+
+    out = _io.StringIO()
+    n = see_idx(str(tmp_path / "9.idx"), out=out)
+    text = out.getvalue()
+    assert n >= 2
+    assert "key 1 " in text
+    assert "tombstone" in text  # the delete appended a tombstone entry
