@@ -158,7 +158,7 @@ def see_dat(dat_path: str, out=None, limit: int = 0) -> int:
     the needle count."""
     import sys as _sys
 
-    from ..storage.needle import Needle, get_actual_size
+    from ..storage.needle import Needle
     from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
 
     out = out or _sys.stdout
@@ -170,24 +170,24 @@ def see_dat(dat_path: str, out=None, limit: int = 0) -> int:
               f"compact_revision {sb.compaction_revision}", file=out)
         f.seek(0, 2)
         end = f.tell()
-        pos = SUPER_BLOCK_SIZE
-        while pos + 16 <= end and (not limit or count < limit):
-            f.seek(pos)
-            header = f.read(16)
-            if len(header) < 16:
-                break
-            n = Needle.parse_header(header)
-            total = get_actual_size(n.size, sb.version)
-            f.seek(pos)
-            blob = f.read(total)
+
+        def pread(offset, size):
+            f.seek(offset)
+            return f.read(size)
+
+        # the record framing lives in volume_backup.walk_records (one
+        # place), which also guards against a corrupt 0xFFFFFFFF size
+        # that would otherwise leap the cursor past the file end
+        for n, pos, total in volume_backup.walk_records(
+                pread, sb.version, SUPER_BLOCK_SIZE, end):
             try:
-                full = Needle.from_bytes(blob, sb.version,
+                full = Needle.from_bytes(pread(pos, total), sb.version,
                                          expected_size=n.size)
                 name = full.name.decode("utf-8", "replace") \
                     if full.has_name() else ""
                 mime = full.mime.decode("utf-8", "replace") \
                     if full.has_mime() else ""
-            except Exception:  # torn tail
+            except Exception:  # torn tail / corrupt record
                 name = mime = ""
             print(f"offset {pos} id {n.id} cookie {n.cookie:08x} "
                   f"size {n.size}"
@@ -195,5 +195,6 @@ def see_dat(dat_path: str, out=None, limit: int = 0) -> int:
                   + (f" mime {mime}" if mime else "")
                   + (" DELETED" if n.size == 0 else ""), file=out)
             count += 1
-            pos += total  # get_actual_size is already 8-byte aligned
+            if limit and count >= limit:
+                break
     return count

@@ -354,14 +354,12 @@ class VolumeServer:
     def admin_readonly(self, req: Request):
         vid = int(req.query["volume"])
         readonly = req.query.get("readonly", "true") == "true"
-        v = self.store.find_volume(vid)
-        if v is None:
+        was = self.store.mark_volume_readonly(vid, readonly)
+        if was is None:
             raise HttpError(404, f"volume {vid} not found")
-        was = v.readonly
-        v.readonly = readonly
-        # was_readonly lets orchestrators (volume.copy/move freeze)
-        # restore exactly the prior state instead of trusting the
-        # master's heartbeat-delayed view
+        # was_readonly lets orchestrators (volume.copy/move/tier.upload
+        # freeze) restore exactly the prior state instead of trusting
+        # the master's heartbeat-delayed view
         return {"volume": vid, "readonly": readonly,
                 "was_readonly": was}
 

@@ -256,9 +256,12 @@ def volume_tier_upload(env: CommandEnv, args: List[str]):
     keep = "true" if flags.get("keepLocalDatFile") else "false"
     try:
         for r in replicas:
-            if not r.get("read_only"):
-                env.node_post(r["url"],
-                              f"/admin/volume/readonly?volume={vid}")
+            # freeze unconditionally; the holder's OWN was_readonly
+            # (not the master's heartbeat-delayed view) decides what a
+            # failure path may thaw — same discipline as _frozen_copy
+            out = env.node_post(r["url"],
+                                f"/admin/volume/readonly?volume={vid}")
+            if not (out or {}).get("was_readonly"):
                 frozen.append(r["url"])
         r = replicas[0]
         info = env.node_post(

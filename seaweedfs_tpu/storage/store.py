@@ -88,12 +88,16 @@ class Store:
                 return True
         return False
 
-    def mark_volume_readonly(self, vid: int, readonly: bool = True) -> bool:
+    def mark_volume_readonly(self, vid: int,
+                             readonly: bool = True) -> Optional[bool]:
+        """Set the flag; returns the PREVIOUS readonly state, or None
+        when the volume is absent — orchestrators restore exactly the
+        prior state on failure."""
         v = self.find_volume(vid)
         if v is None:
-            return False
-        v.readonly = readonly
-        return True
+            return None
+        was, v.readonly = v.readonly, readonly
+        return was
 
     # -- data path ---------------------------------------------------------
     def write_needle(self, vid: int, n: Needle) -> int:
