@@ -178,8 +178,10 @@ def see_dat(dat_path: str, out=None, limit: int = 0) -> int:
         # the record framing lives in volume_backup.walk_records (one
         # place), which also guards against a corrupt 0xFFFFFFFF size
         # that would otherwise leap the cursor past the file end
+        tail = SUPER_BLOCK_SIZE  # where the walk stopped
         for n, pos, total in volume_backup.walk_records(
                 pread, sb.version, SUPER_BLOCK_SIZE, end):
+            tail = pos + total
             try:
                 full = Needle.from_bytes(pread(pos, total), sb.version,
                                          expected_size=n.size)
@@ -197,4 +199,13 @@ def see_dat(dat_path: str, out=None, limit: int = 0) -> int:
             count += 1
             if limit and count >= limit:
                 break
+        else:
+            # a complete header with a truncated body at the tail is a
+            # torn append — exactly what a forensic dump must surface
+            if end - tail >= 16:
+                t = Needle.parse_header(pread(tail, 16))
+                print(f"offset {tail} id {t.id} cookie "
+                      f"{t.cookie:08x} size {t.size} TORN "
+                      f"({end - tail} bytes of record present)",
+                      file=out)
     return count

@@ -270,11 +270,15 @@ def volume_tier_upload(env: CommandEnv, args: List[str]):
     except Exception:
         # thaw exactly the replicas this command froze — a failure at
         # any point (a later freeze included) must not leave the
-        # volume permanently unwritable
+        # volume permanently unwritable; one unreachable node must not
+        # stop the others from thawing or mask the original error
         for url in frozen:
-            env.node_post(
-                url, f"/admin/volume/readonly?volume={vid}"
-                     f"&readonly=false")
+            try:
+                env.node_post(
+                    url, f"/admin/volume/readonly?volume={vid}"
+                         f"&readonly=false")
+            except Exception:
+                pass
         raise
     env.write(f"volume {vid} @ {r['url']}: .dat -> "
               f"{info['remote']['backend']}/{info['remote']['key']} "

@@ -131,3 +131,19 @@ def test_volume_torn_tail_truncated_on_reload(tmp_path, seed):
             b"post-crash"
     finally:
         v.close()
+
+
+def test_mark_volume_readonly_returns_prior_state(tmp_path):
+    """Freeze orchestrators (volume.copy/move/tier.upload) restore
+    exactly the state each holder reports; the store method must
+    return the PREVIOUS flag, and the admin endpoint must expose it
+    as was_readonly."""
+    from seaweedfs_tpu.storage.store import Store
+    store = Store([str(tmp_path)], max_volume_counts=[4])
+    store.add_volume(1, "")
+    assert store.mark_volume_readonly(1, True) is False   # was writable
+    assert store.mark_volume_readonly(1, True) is True    # idempotent
+    assert store.mark_volume_readonly(1, False) is True   # was frozen
+    assert store.mark_volume_readonly(1, False) is False
+    assert store.mark_volume_readonly(99, True) is None   # absent
+    store.close()
