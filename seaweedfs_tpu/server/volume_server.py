@@ -483,24 +483,33 @@ class VolumeServer:
         base = volume_file_prefix(loc.directory, collection, vid)
         name = os.path.basename(base)
         exts = [to_ext(s) for s in shard_ids]
+        optional = []
         if copy_ecx:
-            exts += [".ecx", ".vif"]
-            if self._remote_file_exists(source, name + ".ecj"):
-                exts.append(".ecj")
-        for ext in exts:
-            data = http_call(
-                "GET", f"http://{source}/admin/file?name={name}{ext}",
-                timeout=300)
+            exts.append(".ecx")
+            # .vif (volume version + offset width) is written by every
+            # encode but can be legitimately gone (operator tooling,
+            # pre-fix deployments where deleting the original volume
+            # wiped it); .ecj exists only after EC deletes. A 404 on
+            # either must not fail the copy — but ONLY a 404: any other
+            # status (503 network blip) must propagate, or a silently
+            # skipped .vif turns into a wrong offset-width guess on a
+            # parity-only holder.
+            optional = [".vif", ".ecj"]
+        copied = []
+        for ext in exts + optional:
+            try:
+                data = http_call(
+                    "GET", f"http://{source}/admin/file?name={name}{ext}",
+                    timeout=300)
+            except HttpError as e:
+                if ext in optional and e.status == 404:
+                    continue
+                raise
             with open(base + ext, "wb") as f:
                 f.write(data)
-        return {"volume": vid, "copied": exts}
+            copied.append(ext)
+        return {"volume": vid, "copied": copied}
 
-    def _remote_file_exists(self, source: str, name: str) -> bool:
-        try:
-            get_json(f"http://{source}/admin/file?name={name}&stat=true")
-            return True
-        except HttpError:
-            return False
 
     def admin_ec_delete_shards(self, req: Request):
         """Unmount + remove shard files (reference VolumeEcShardsDelete);

@@ -503,7 +503,13 @@ class Volume:
 
     def destroy(self):
         self.close()
-        for ext in (".dat", ".idx", ".cpd", ".cpx", ".vif"):
+        exts = [".dat", ".idx", ".cpd", ".cpx"]
+        # the .vif sidecar is shared with the EC lifecycle: after
+        # ec.encode deletes the original volume, parity-only holders
+        # still need its offset_width — keep it while shard files exist
+        if not os.path.exists(self.file_name() + ".ecx"):
+            exts.append(".vif")
+        for ext in exts:
             p = self.file_name() + ext
             if os.path.exists(p):
                 os.remove(p)

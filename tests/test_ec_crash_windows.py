@@ -137,3 +137,48 @@ def test_rebuild_is_idempotent_and_converges(cluster):
     assert len(ec["shards"]) == 14
     for fid, data in payloads.items():
         assert op.read_file(master.url, fid) == data, fid
+
+
+def test_vif_survives_original_volume_delete(cluster):
+    """ec.encode deletes the original .dat/.idx; the .vif sidecar must
+    SURVIVE (parity-only holders read offset_width from it), and shard
+    copies must carry it when present — while a legitimately absent
+    .vif (or .ecj) must not fail the copy."""
+    import os
+
+    master, servers = cluster
+    vid, _payloads = fill(master.url)
+    src_vs = next(vs for vs in servers if vs.store.find_volume(vid))
+    post_json(f"http://{src_vs.url}/admin/volume/readonly?volume={vid}")
+    post_json(f"http://{src_vs.url}/admin/ec/generate?volume={vid}"
+              f"&collection=cw")
+
+    def base_of(vs):
+        for loc in vs.store.locations:
+            cand = os.path.join(loc.directory, f"cw_{vid}")
+            if os.path.exists(cand + ".ecx"):
+                return cand
+        return None
+
+    base = base_of(src_vs)
+    assert base and os.path.exists(base + ".vif")
+    # delete the original volume: .dat/.idx go, .vif stays
+    post_json(f"http://{src_vs.url}/admin/delete_volume?volume={vid}")
+    assert not os.path.exists(base + ".dat")
+    assert os.path.exists(base + ".vif"), \
+        ".vif wiped with the original volume"
+    # a rebuilder-style pull with copy_ecx=true brings .vif along
+    dst = next(vs for vs in servers if vs is not src_vs)
+    post_json(f"http://{dst.url}/admin/ec/copy?volume={vid}"
+              f"&collection=cw&source={src_vs.url}&shards=0"
+              f"&copy_ecx=true")
+    dbase = base_of(dst)
+    assert dbase and os.path.exists(dbase + ".vif")
+    # now remove the source .vif and copy again: optional, not fatal
+    os.remove(base + ".vif")
+    os.remove(dbase + ".ecx")
+    os.remove(dbase + ".vif")
+    out = post_json(f"http://{dst.url}/admin/ec/copy?volume={vid}"
+                    f"&collection=cw&source={src_vs.url}&shards=1"
+                    f"&copy_ecx=true")
+    assert ".ecx" in out["copied"] and ".vif" not in out["copied"]
