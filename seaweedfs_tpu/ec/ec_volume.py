@@ -156,6 +156,17 @@ class EcVolume:
                 self.version = self.version or sb.version
                 self.offset_width = self.offset_width or sb.offset_width
             except Exception:
+                # last resort: defaults. Loud, not silent — a wrong
+                # offset width misparses every .ecx record on this
+                # holder (5B volumes), and the operator needs to know
+                # to restore the .vif (ec.rebuild from a holder that
+                # has it, or recreate it by hand)
+                from ..util import glog
+                glog.V(0).infof(
+                    "ec volume %s: no .vif and no local data shard; "
+                    "ASSUMING version=3 offset_width=4 — wrong for "
+                    "5-byte-offset volumes; restore %s.vif",
+                    self.base_name, self.base_name)
                 self.version = self.version or 3
                 self.offset_width = self.offset_width or 4
 
