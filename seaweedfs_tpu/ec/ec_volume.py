@@ -162,13 +162,19 @@ class EcVolume:
                 # to restore the .vif (ec.rebuild from a holder that
                 # has it, or recreate it by hand)
                 from ..util import glog
-                glog.V(0).infof(
-                    "ec volume %s: no .vif and no local data shard; "
-                    "ASSUMING version=3 offset_width=4 — wrong for "
-                    "5-byte-offset volumes; restore %s.vif",
-                    self.base_name, self.base_name)
+                defaulted = [f for f, val in
+                             (("version", self.version),
+                              ("offset_width", self.offset_width))
+                             if val is None]
                 self.version = self.version or 3
                 self.offset_width = self.offset_width or 4
+                glog.V(0).infof(
+                    "ec volume %s: no usable .vif and no local data "
+                    "shard; DEFAULTED %s (now version=%s "
+                    "offset_width=%s) — wrong for 5-byte-offset "
+                    "volumes; restore %s.vif",
+                    self.base_name, ",".join(defaulted), self.version,
+                    self.offset_width, self.base_name)
 
     # -- shard management --------------------------------------------------
     def add_shard(self, shard_id: int) -> bool:
