@@ -55,6 +55,7 @@ class Volume:
         prefix = volume_file_prefix(dirname, self.collection, vid)
         self.dat_path = prefix + ".dat"
         self.idx_path = prefix + ".idx"
+        self._finish_interrupted_commit(prefix)
 
         # a .vif sidecar marks a tiered volume: the .dat lives on a
         # remote backend and reads are range requests — but only when
@@ -436,6 +437,27 @@ class Volume:
             self._compacting = False
         return deleted_size
 
+    def _finish_interrupted_commit(self, prefix: str):
+        """Redo a compaction commit that crashed mid-rename. The
+        `.commit` marker exists only between _makeup_diff completing
+        and both renames landing, so whatever of .cpd/.cpx is still
+        present is strictly newer than its .dat/.idx counterpart and
+        the renames are safe to replay in any crash state."""
+        marker = prefix + ".commit"
+        if not os.path.exists(marker):
+            return
+        for src, dst in ((prefix + ".cpd", self.dat_path),
+                         (prefix + ".cpx", self.idx_path)):
+            if os.path.exists(src):
+                os.replace(src, dst)
+        # mirror commit_compact's in-window sidecar cleanup: a stale
+        # .sdx whose watermark happens to match the new .idx size would
+        # serve pre-compaction offsets into the compacted .dat
+        for ext in (".sdx", ".sdx.meta"):
+            if os.path.exists(prefix + ext):
+                os.remove(prefix + ext)
+        os.remove(marker)
+
     def commit_compact(self):
         with self.lock:
             prefix = self.file_name()
@@ -445,13 +467,36 @@ class Volume:
             self._makeup_diff(cpd, cpx)
             self.dat.close()
             self.nm.close()
+            # intent marker makes the two renames redo-able: a crash
+            # between them otherwise leaves new .dat + old .idx, whose
+            # stale offsets the boot integrity check could silently
+            # truncate into a wrong-but-plausible volume. (The
+            # reference has this window, volume_vacuum.go CommitCompact;
+            # the marker closes it — finish_interrupted_commit below.)
+            marker = prefix + ".commit"
+            with open(marker, "w") as f:
+                f.write("compact-commit")
+                f.flush()
+                os.fsync(f.fileno())
+            # the marker's DIRECTORY ENTRY must be durable before the
+            # renames: a journaled rename surviving a crash that lost
+            # the marker dirent would reopen the exact window the
+            # marker closes
+            dfd = os.open(os.path.dirname(marker) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
             os.replace(cpd, self.dat_path)
             os.replace(cpx, self.idx_path)
-            # the compacted .idx can coincidentally match a stale .sdx
-            # watermark size — drop the sidecar so sortedfile maps rebuild
+            # sidecar cleanup stays INSIDE the marker window: the
+            # compacted .idx can coincidentally match a stale .sdx
+            # watermark size, and a crash after marker removal would
+            # leave nothing to redo the cleanup
             for ext in (".sdx", ".sdx.meta"):
                 if os.path.exists(prefix + ext):
                     os.remove(prefix + ext)
+            os.remove(marker)
             with open(self.dat_path, "rb") as f:
                 self.super_block = SuperBlock.from_bytes(
                     f.read(SUPER_BLOCK_SIZE))

@@ -70,3 +70,109 @@ def test_volume_ttl_stamped_on_needles(tmp_path):
     assert got.has_ttl() and got.ttl == TTL.parse("3h")
     assert got.has_last_modified()
     v.close()
+
+
+def test_crash_between_compact_and_commit_recovers(tmp_path):
+    """A crash after compact() (stale .cpd/.cpx on disk) must leave the
+    live volume untouched on reload, and a later compact+commit must
+    converge — the two-phase design's whole point."""
+    import os
+
+    v = Volume(str(tmp_path), "", 1, create=True)
+    for i in range(1, 6):
+        v.write_needle(Needle(id=i, cookie=9, data=b"d%d" % i * 100))
+    v.delete_needle(Needle(id=2, cookie=9))
+    v.compact()
+    v.close()  # crash: commit never runs
+    assert os.path.exists(tmp_path / "1.cpd")
+    v2 = Volume(str(tmp_path), "", 1)
+    for i in (1, 3, 4, 5):
+        assert v2.read_needle(Needle(id=i, cookie=9)).data == \
+            b"d%d" % i * 100
+    with pytest.raises(Exception):
+        v2.read_needle(Needle(id=2, cookie=9))
+    # the interrupted pass's artifacts don't poison a fresh cycle
+    v2.compact()
+    v2.commit_compact()
+    assert not os.path.exists(tmp_path / "1.cpd")
+    for i in (1, 3, 4, 5):
+        assert v2.read_needle(Needle(id=i, cookie=9)).data == \
+            b"d%d" % i * 100
+    v2.close()
+
+
+@pytest.mark.parametrize("crash_state", ["before_renames",
+                                         "between_renames",
+                                         "after_renames"])
+def test_crash_mid_commit_rename_is_redone(tmp_path, crash_state):
+    """The .commit intent marker closes the mid-commit crash window
+    (new .dat + old .idx would otherwise boot as a wrong-but-plausible
+    volume). Each crash state must recover to the fully-committed
+    result on reload."""
+    import os
+    import shutil
+
+    v = Volume(str(tmp_path), "", 1, create=True)
+    for i in range(1, 6):
+        v.write_needle(Needle(id=i, cookie=9, data=b"d%d" % i * 100))
+    v.delete_needle(Needle(id=2, cookie=9))
+    v.compact()
+    # run the makeup diff exactly as commit would, then hand-craft the
+    # crash state instead of letting commit finish
+    prefix = v.file_name()
+    cpd, cpx = prefix + ".cpd", prefix + ".cpx"
+    v._makeup_diff(cpd, cpx)
+    v.dat.close()
+    v.nm.close()
+    marker = prefix + ".commit"
+    open(marker, "w").write("compact-commit")
+    if crash_state == "before_renames":
+        pass  # .cpd and .cpx both still present
+    elif crash_state == "between_renames":
+        os.replace(cpd, v.dat_path)       # first rename landed
+    else:
+        os.replace(cpd, v.dat_path)
+        os.replace(cpx, v.idx_path)       # both landed, marker remains
+    # poison detector: in the between_renames state the OLD .idx pairs
+    # with the NEW .dat — a boot without redo would misinterpret it
+    v2 = Volume(str(tmp_path), "", 1)
+    assert not os.path.exists(marker)
+    assert not os.path.exists(cpd) and not os.path.exists(cpx)
+    for i in (1, 3, 4, 5):
+        assert v2.read_needle(Needle(id=i, cookie=9)).data == \
+            b"d%d" % i * 100, (crash_state, i)
+    with pytest.raises(Exception):
+        v2.read_needle(Needle(id=2, cookie=9))
+    # compacted: the deleted needle's bytes are gone from the .dat
+    assert v2.size() < 5 * 300 + 600
+    v2.close()
+
+
+def test_crash_recovery_drops_stale_sdx(tmp_path):
+    """A sortedfile-index volume recovering from a mid-commit crash
+    must rebuild its .sdx — a stale one whose watermark matches the
+    new .idx size would serve pre-compaction offsets."""
+    import os
+
+    v = Volume(str(tmp_path), "", 1, create=True,
+               index_kind="sortedfile")
+    for i in range(1, 6):
+        v.write_needle(Needle(id=i, cookie=9, data=b"d%d" % i * 100))
+    v.delete_needle(Needle(id=2, cookie=9))
+    v.close()
+    v = Volume(str(tmp_path), "", 1, index_kind="sortedfile")
+    v.compact()
+    prefix = v.file_name()
+    cpd, cpx = prefix + ".cpd", prefix + ".cpx"
+    v._makeup_diff(cpd, cpx)
+    v.dat.close()
+    v.nm.close()
+    open(prefix + ".commit", "w").write("compact-commit")
+    os.replace(cpd, v.dat_path)  # crash between the renames
+    assert os.path.exists(prefix + ".sdx")
+    v2 = Volume(str(tmp_path), "", 1, index_kind="sortedfile")
+    assert not os.path.exists(prefix + ".commit")
+    for i in (1, 3, 4, 5):
+        assert v2.read_needle(Needle(id=i, cookie=9)).data == \
+            b"d%d" % i * 100
+    v2.close()
