@@ -14,7 +14,6 @@ import os
 import numpy as np
 
 from .codec import ReedSolomonCodec
-from . import gf256
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "native",
                          "libseaweed_ec.so")

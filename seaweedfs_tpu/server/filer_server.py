@@ -25,8 +25,8 @@ from ..filer.filer import FilerError, NotFoundError
 from ..filer.log_buffer import LogBuffer, event_notification
 from ..filer.filerstore import make_store
 from ..filer.stream import read_chunked
-from .http_util import (HttpError, HttpServer, Request, Response, Router,
-                        http_call)
+from .http_util import (HttpError, HttpServer, Request, Response,
+                        Router)
 
 CHUNK_SIZE_DEFAULT = 32 << 20  # reference -maxMB=32 autochunk default
 

@@ -12,12 +12,11 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Optional
 
-from ..storage.types import TTL, ReplicaPlacement, parse_file_id
+from ..storage.types import TTL, ReplicaPlacement
 from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
-from .http_util import (HttpError, HttpServer, Request, Router, get_json,
+from .http_util import (HttpError, HttpServer, Request, Router,
                         post_json, post_multipart)
 
 

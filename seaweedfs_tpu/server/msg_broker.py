@@ -15,7 +15,7 @@ import time
 from typing import Dict
 
 from ..filer.log_buffer import LogBuffer
-from .http_util import HttpError, HttpServer, Request, Response, Router
+from .http_util import HttpError, HttpServer, Request, Router
 
 
 class MsgBrokerServer:

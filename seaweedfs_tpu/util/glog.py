@@ -14,7 +14,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, Optional, TextIO
+from typing import Dict, TextIO
 
 _verbosity = 0
 _vmodule: Dict[str, int] = {}
