@@ -233,9 +233,14 @@ def _filer_master(filer_url: str) -> str:
 def cmd_shell(args):
     import seaweedfs_tpu.shell  # noqa: F401  (registers all commands)
     from ..shell.command_env import CommandEnv, run_command
+    from ..shell.command_env import split_script
     env = CommandEnv(args.master, filer_url=args.filer)
     if args.c:
-        run_command(env, args.c)
+        # ';'-separated command lines (quote-aware), same convention as
+        # the master's -maintenanceScripts cron; 'exit' stops the script
+        for line in split_script(args.c):
+            if not run_command(env, line):
+                break
         return
     print("seaweedfs_tpu shell; 'help' lists commands, 'exit' quits")
     while True:

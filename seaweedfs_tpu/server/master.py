@@ -76,9 +76,8 @@ class MasterServer:
         # cron'd embedded shell (reference startAdminScripts,
         # master_server.go:187-253): ';'-separated command lines run
         # against this master on an interval, leader-only
-        self.maintenance_scripts = [
-            line.strip() for line in maintenance_scripts.split(";")
-            if line.strip()]
+        from ..shell.command_env import split_script
+        self.maintenance_scripts = split_script(maintenance_scripts)
         self.maintenance_interval = float(maintenance_interval)
         self._maintenance_runs = 0
         self._maintenance_thread = None

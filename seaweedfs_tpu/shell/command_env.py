@@ -71,6 +71,28 @@ class CommandEnv:
         return self.master_get("/cluster/ec_status").get("volumes", {})
 
 
+def split_script(script: str) -> List[str]:
+    """Split a ';'-separated command script into lines, ignoring
+    semicolons inside single/double quotes — shared by `shell -c` and
+    the master's maintenance cron."""
+    parts, cur, quote = [], [], None
+    for ch in script:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == ";":
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
 def run_command(env: CommandEnv, line: str) -> bool:
     """Execute one shell line. Returns False on 'exit'."""
     line = line.strip()
@@ -78,7 +100,12 @@ def run_command(env: CommandEnv, line: str) -> bool:
         return True
     if line in ("exit", "quit"):
         return False
-    parts = shlex.split(line)
+    try:
+        parts = shlex.split(line)
+    except ValueError as e:
+        # unbalanced quotes must not kill the REPL/script
+        env.write(f"error: {e}")
+        return True
     name, args = parts[0], parts[1:]
     if name == "help":
         if args and args[0] in HELP:

@@ -255,3 +255,19 @@ def test_fs_meta_cat(cluster3, tmp_path):
         assert meta["FullPath"] == "/meta/doc.bin"
     finally:
         filer.stop()
+
+
+def test_split_script_quote_aware_and_exit_sentinel():
+    from seaweedfs_tpu.shell.command_env import split_script
+    assert split_script("a; b ;c") == ["a", "b", "c"]
+    assert split_script('fs.rm "/dir;old"; volume.list') == \
+        ['fs.rm "/dir;old"', "volume.list"]
+    assert split_script("x 'a;b' y") == ["x 'a;b' y"]
+    assert split_script("") == []
+
+
+def test_run_command_survives_unbalanced_quote(cluster3):
+    master, _ = cluster3
+    env, out = _env(master)
+    assert run_command(env, 'volume.list "oops') is True
+    assert "error" in out.getvalue().lower()
