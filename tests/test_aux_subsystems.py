@@ -203,3 +203,40 @@ def test_sampling_profiler_collapsed_stacks(tmp_path):
     for line in text.splitlines():
         stack, _, count = line.rpartition(" ")
         assert stack and count.isdigit()
+
+
+def test_tls_redirect_rewrites_scheme(tmp_path):
+    """A 301 whose Location is plain http (the volume read-redirect
+    shape) must be refetched over TLS when the cluster runs TLS — the
+    pooled client re-applies the scheme rewrite on redirect targets."""
+    cert, key = _make_cert(tmp_path)
+    router = Router()
+    hits = []
+
+    def redirecting(req):
+        hits.append("redirector")
+        from seaweedfs_tpu.server.http_util import Response
+        return Response(b"", 301,
+                        headers={"Location":
+                                 f"http://127.0.0.1:{target.port}/data"})
+
+    def data(req):
+        hits.append("target")
+        return {"ok": True}
+
+    router.add("GET", "/hop", redirecting)
+    t_router = Router()
+    t_router.add("GET", "/data", data)
+    try:
+        configure_tls(cert, key)
+        target = HttpServer(0, t_router, "127.0.0.1")
+        target.start()
+        srv = HttpServer(0, router, "127.0.0.1")
+        srv.start()
+        out = get_json(f"http://127.0.0.1:{srv.port}/hop")
+        assert out == {"ok": True}
+        assert hits == ["redirector", "target"]
+        srv.stop()
+        target.stop()
+    finally:
+        reset_tls()
