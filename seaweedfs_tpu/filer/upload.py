@@ -54,12 +54,53 @@ def split_and_upload(master_url: str, data: bytes, filename: str,
                 blob, is_gzipped = gz, True
         if cipher:
             blob, key = encrypt(blob)
-        a = operation.assign(master_url, collection=collection,
-                             replication=replication, ttl=ttl)
-        up = operation.upload(a["url"], a["fid"], blob, filename=filename,
-                              content_type=content_type, ttl=ttl,
-                              jwt=a.get("auth", ""))
+        a, up = _assign_and_upload(master_url, blob, filename,
+                                   content_type, collection,
+                                   replication, ttl)
         chunks.append(FileChunk(fid=a["fid"], offset=i, size=len(piece),
                                 mtime=now_ns + i, etag=up.get("eTag", ""),
                                 cipher_key=key, is_compressed=is_gzipped))
     return chunks, md5.hexdigest()
+
+
+def _assign_and_upload(master_url: str, blob: bytes, filename: str,
+                       content_type: str, collection: str,
+                       replication: str, ttl: str, attempts: int = 3):
+    """Assign a fid and upload; a volume frozen or unrouted BETWEEN the
+    assign and the upload (maintenance: volume.move/balance/tier or an
+    ec.encode freeze) re-assigns to a fresh volume instead of failing
+    the client's write — maintenance windows must be invisible to
+    writers."""
+    from ..server.http_util import HttpError
+    for attempt in range(attempts):
+        a = operation.assign(master_url, collection=collection,
+                             replication=replication, ttl=ttl)
+        try:
+            up = operation.upload(a["url"], a["fid"], blob,
+                                  filename=filename,
+                                  content_type=content_type, ttl=ttl,
+                                  jwt=a.get("auth", ""))
+            return a, up
+        except HttpError as e:
+            # 503 = transport-level (server gone mid-maintenance,
+            # connection refused — http_util wraps those); 500 with a
+            # freeze/unroute message = write landed on a frozen volume
+            retriable = e.status == 503 or (
+                e.status == 500 and ("read only" in str(e)
+                                     or "not found" in str(e)))
+            if not retriable or attempt + 1 == attempts:
+                raise
+            # a partial-replication failure may have landed the needle
+            # on the primary before the fan-out failed: best-effort
+            # delete so the retry's fresh fid doesn't strand it
+            try:
+                from ..server.http_util import http_call
+                headers = {"Authorization": f"Bearer {a['auth']}"} \
+                    if a.get("auth") else None
+                http_call("DELETE", f"http://{a['url']}/{a['fid']}",
+                          headers=headers)
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                pass
+            # brief pause: the freeze usually reaches the master within
+            # a pulse, after which assigns stop routing to that volume
+            time.sleep(0.2 * (attempt + 1))

@@ -234,3 +234,51 @@ def test_cli_filer_copy(cluster, tmp_path):
     from seaweedfs_tpu.server.http_util import HttpError
     with _pytest.raises(HttpError):
         http_call("GET", furl(filer, "/pdfonly/tree/a.txt"))
+
+
+def test_upload_retries_past_frozen_volume(cluster):
+    """A volume frozen between assign and upload (maintenance window)
+    must not fail the client's write: split_and_upload re-assigns."""
+    import seaweedfs_tpu.client.operation as op_mod
+    master, servers, filer = cluster
+    # prime: make at least one writable volume exist
+    post_multipart(furl(filer, "/warm/x.bin"), "x.bin", b"warm")
+    # freeze EVERY current volume directly on the holders (the master
+    # won't know until the next pulse — exactly the race window)
+    frozen = []
+    for vs in servers:
+        for loc in vs.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                if not v.readonly:
+                    v.readonly = True
+                    frozen.append((vs, vid))
+    assert frozen
+    try:
+        # first upload attempt(s) will hit a frozen volume and 500;
+        # thaw after the first rejection so a retry can land (mimics
+        # the maintenance window ending / master rerouting)
+        orig_upload = op_mod.upload
+        state = {"rejections": 0}
+
+        def flaky_upload(url, fid, data, **kw):
+            try:
+                return orig_upload(url, fid, data, **kw)
+            except Exception:
+                state["rejections"] += 1
+                for vs, vid in frozen:
+                    vs.store.mark_volume_readonly(vid, False)
+                raise
+
+        op_mod.upload = flaky_upload
+        try:
+            r = post_multipart(furl(filer, "/warm/retry.bin"),
+                               "retry.bin", b"written-through-freeze")
+        finally:
+            op_mod.upload = orig_upload
+        assert r["size"] == len(b"written-through-freeze")
+        assert state["rejections"] >= 1, "freeze never hit: test vacuous"
+        got = http_call("GET", furl(filer, "/warm/retry.bin"))
+        assert got == b"written-through-freeze"
+    finally:
+        for vs, vid in frozen:
+            vs.store.mark_volume_readonly(vid, False)
