@@ -65,42 +65,85 @@ def split_and_upload(master_url: str, data: bytes, filename: str,
 
 def _assign_and_upload(master_url: str, blob: bytes, filename: str,
                        content_type: str, collection: str,
-                       replication: str, ttl: str, attempts: int = 3):
-    """Assign a fid and upload; a volume frozen or unrouted BETWEEN the
-    assign and the upload (maintenance: volume.move/balance/tier or an
-    ec.encode freeze) re-assigns to a fresh volume instead of failing
-    the client's write — maintenance windows must be invisible to
-    writers."""
-    from ..server.http_util import HttpError
+                       replication: str, ttl: str, attempts: int = 6):
+    """Assign a fid and upload; a volume frozen, unrouted, or with a
+    dead replica BETWEEN the assign and the upload (maintenance:
+    volume.move/balance/tier, an ec.encode freeze, or a crashed node
+    whose heartbeat hasn't expired yet) re-assigns to a fresh volume
+    instead of failing the client's write — maintenance windows and
+    node-death windows must be invisible to writers. A fresh assign
+    usually lands on an unaffected volume immediately; once the
+    master's heartbeat expiry fires it always does."""
+    from ..server.http_util import HttpError, http_call
+    failed_vids: set = set()
+    failed_urls: set = set()
     for attempt in range(attempts):
-        a = operation.assign(master_url, collection=collection,
-                             replication=replication, ttl=ttl)
+        if attempt:
+            # backoff spanning roughly a heartbeat-expiry window: the
+            # master stops routing to a frozen volume within a pulse
+            # and prunes a dead node within a few; each failure also
+            # blacklists a sick volume or node, so the walk converges
+            time.sleep(min(0.3 * (2 ** (attempt - 1)), 1.5))
+        a = None
         try:
+            a = _fresh_assign(master_url, collection, replication, ttl,
+                              failed_vids, failed_urls)
             up = operation.upload(a["url"], a["fid"], blob,
                                   filename=filename,
                                   content_type=content_type, ttl=ttl,
                                   jwt=a.get("auth", ""))
             return a, up
         except HttpError as e:
-            # 503 = transport-level (server gone mid-maintenance,
-            # connection refused — http_util wraps those); 500 with a
-            # freeze/unroute message = write landed on a frozen volume
+            if a is None:
+                # the ASSIGN failed: retriable when the master is mid
+                # leader-transition (503) or every volume is briefly
+                # frozen/unroutable (406); anything else is config-level
+                if e.status not in (503, 406) or \
+                        attempt + 1 == attempts:
+                    raise
+                continue
+            # the UPLOAD failed: 503 = transport-level (node gone —
+            # http_util wraps connection errors); 500 with a freeze/
+            # unroute/replica-death message = this volume can't take
+            # the write right now, but another one can
             retriable = e.status == 503 or (
                 e.status == 500 and ("read only" in str(e)
-                                     or "not found" in str(e)))
+                                     or "not found" in str(e)
+                                     or "replication failed" in str(e)))
             if not retriable or attempt + 1 == attempts:
                 raise
-            # a partial-replication failure may have landed the needle
-            # on the primary before the fan-out failed: best-effort
-            # delete so the retry's fresh fid doesn't strand it
-            try:
-                from ..server.http_util import http_call
-                headers = {"Authorization": f"Bearer {a['auth']}"} \
-                    if a.get("auth") else None
-                http_call("DELETE", f"http://{a['url']}/{a['fid']}",
-                          headers=headers)
-            except Exception:  # noqa: BLE001 - cleanup is best-effort
-                pass
-            # brief pause: the freeze usually reaches the master within
-            # a pulse, after which assigns stop routing to that volume
-            time.sleep(0.2 * (attempt + 1))
+            if e.status == 503:
+                # the whole node is unreachable: skip every volume it
+                # fronts, not just this one
+                failed_urls.add(a["url"])
+            failed_vids.add(a["fid"].split(",")[0])
+            if "replication failed" in str(e):
+                # the only branch where a needle may have landed (on
+                # the primary, before the fan-out failed): best-effort
+                # delete so the retry's fresh fid doesn't strand it
+                try:
+                    headers = {"Authorization": f"Bearer {a['auth']}"} \
+                        if a.get("auth") else None
+                    http_call("DELETE",
+                              f"http://{a['url']}/{a['fid']}",
+                              headers=headers, timeout=5)
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
+
+
+def _fresh_assign(master_url: str, collection: str, replication: str,
+                  ttl: str, failed_vids: set, failed_urls: set,
+                  rolls: int = 6) -> dict:
+    """Assign, re-rolling past volumes/nodes that just refused us (the
+    master hands out random writable volumes and only unroutes a sick
+    one after a pulse/expiry). After ``rolls`` tries the last pick is
+    returned anyway — with everything blacklisted, attempting a known-
+    sick volume still beats failing without trying."""
+    a = None
+    for _ in range(rolls):
+        a = operation.assign(master_url, collection=collection,
+                             replication=replication, ttl=ttl)
+        if a["fid"].split(",")[0] not in failed_vids and \
+                a["url"] not in failed_urls:
+            break
+    return a
