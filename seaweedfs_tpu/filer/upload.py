@@ -117,16 +117,20 @@ def _assign_and_upload(master_url: str, blob: bytes, filename: str,
                 # fronts, not just this one
                 failed_urls.add(a["url"])
             failed_vids.add(a["fid"].split(",")[0])
-            if "replication failed" in str(e):
-                # the only branch where a needle may have landed (on
-                # the primary, before the fan-out failed): best-effort
-                # delete so the retry's fresh fid doesn't strand it
+            if "replication failed" in str(e) or e.status == 503:
+                # branches where a needle MAY have landed: the primary
+                # wrote before the fan-out failed, or the response was
+                # lost after a commit (timeout/reset → 503). Best-
+                # effort delete with a short timeout so the retry's
+                # fresh fid doesn't strand it; against a truly dead
+                # node this fails fast (connection refused) or costs
+                # at most the 3s cap
                 try:
                     headers = {"Authorization": f"Bearer {a['auth']}"} \
                         if a.get("auth") else None
                     http_call("DELETE",
                               f"http://{a['url']}/{a['fid']}",
-                              headers=headers, timeout=5)
+                              headers=headers, timeout=3)
                 except Exception:  # noqa: BLE001 - best-effort
                     pass
 

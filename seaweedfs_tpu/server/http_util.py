@@ -392,15 +392,18 @@ class _TunedHTTPServer(ThreadingHTTPServer):
         super().shutdown_request(request)
 
     def close_all_connections(self):
+        # shutdown ONLY — never close() a socket another thread may be
+        # mid-write on: close frees the fd number, a concurrently
+        # opened socket (e.g. this process's own client pool) can
+        # reuse it, and the handler's buffered response bytes would
+        # land inside an unrelated connection. shutdown wakes the
+        # owning handler thread (EOF/EPIPE), which closes the fd
+        # exactly once via shutdown_request.
         with self._conn_lock:
-            socks, self._client_socks = list(self._client_socks), set()
+            socks = list(self._client_socks)
         for s in socks:
             try:
                 s.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                s.close()
             except OSError:
                 pass
 

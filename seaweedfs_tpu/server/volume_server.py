@@ -285,6 +285,13 @@ class VolumeServer:
         except EcShardNotFound as e:
             raise HttpError(503, f"ec volume {vid}: {e}") from None
         got = Needle.from_bytes(blob, ev.version)
+        if got.id != key:
+            # the blob parsed as a VALID needle but not the requested
+            # one: the interval assembly went to the wrong place —
+            # surface it, never serve another needle's bytes (cookies
+            # alone don't disambiguate; they can collide)
+            raise HttpError(
+                500, f"ec read of {fid} assembled needle {got.id:x}")
         if got.cookie != cookie:
             raise HttpError(404, "cookie mismatch")
         return got

@@ -240,3 +240,32 @@ def test_tls_redirect_rewrites_scheme(tmp_path):
         target.stop()
     finally:
         reset_tls()
+
+
+def test_server_stop_severs_keepalive_without_fd_close_race():
+    """stop() must sever established keep-alive connections (a stopped
+    server stops serving) via shutdown — the owning handler thread
+    closes the fd, so a concurrent in-process client can never inherit
+    a reused fd mid-response."""
+    import http.client
+    import time as _time
+
+    router = Router()
+    router.add("GET", "/ping", lambda req: {"pong": True})
+    srv = HttpServer(0, router, "127.0.0.1")
+    srv.start()
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+    conn.request("GET", "/ping")
+    assert conn.getresponse().read() == b'{"pong": true}'
+    srv.stop()
+    # the established keep-alive connection is dead now
+    with pytest.raises((ConnectionError, http.client.HTTPException,
+                        OSError)):
+        conn.request("GET", "/ping")
+        conn.getresponse().read()
+    conn.close()
+    # handler threads owned the close: tracked set drains
+    deadline = _time.time() + 5
+    while _time.time() < deadline and srv.httpd._client_socks:
+        _time.sleep(0.05)
+    assert not srv.httpd._client_socks

@@ -282,3 +282,109 @@ def test_upload_retries_past_frozen_volume(cluster):
     finally:
         for vs, vid in frozen:
             vs.store.mark_volume_readonly(vid, False)
+
+
+def test_fresh_assign_blacklist_re_rolls(monkeypatch):
+    """_fresh_assign skips blacklisted volumes and nodes, and falls
+    back to the last roll when everything is blacklisted."""
+    from seaweedfs_tpu.filer.upload import _fresh_assign
+
+    picks = [{"fid": "3,aa", "url": "dead:1"},
+             {"fid": "5,bb", "url": "live:1"},
+             {"fid": "7,cc", "url": "live:2"}]
+    i = [0]
+
+    def fake_assign(master_url, **kw):
+        a = picks[i[0] % len(picks)]
+        i[0] += 1
+        return a
+
+    import seaweedfs_tpu.client.operation as op_mod
+    monkeypatch.setattr(op_mod, "assign", fake_assign)
+    # vid 3 blacklisted -> lands on the next pick
+    a = _fresh_assign("m", "", "", "", {"3"}, set())
+    assert a["fid"] == "5,bb"
+    # node blacklisted -> skips every volume it fronts
+    i[0] = 0
+    a = _fresh_assign("m", "", "", "", set(), {"dead:1"})
+    assert a["url"] != "dead:1"
+    # everything blacklisted -> still returns a pick (last roll)
+    i[0] = 0
+    a = _fresh_assign("m", "", "", "", {"3", "5", "7"}, set())
+    assert a is not None
+
+
+def test_assign_level_failures_retry(monkeypatch):
+    """A master mid leader-transition (503) or an all-frozen moment
+    (406) during ASSIGN retries instead of failing the write."""
+    from seaweedfs_tpu.filer.upload import _assign_and_upload
+    from seaweedfs_tpu.server.http_util import HttpError
+
+    import seaweedfs_tpu.client.operation as op_mod
+    calls = {"assign": 0, "upload": 0}
+
+    def flaky_assign(master_url, **kw):
+        calls["assign"] += 1
+        if calls["assign"] == 1:
+            raise HttpError(503, "no raft leader elected yet")
+        if calls["assign"] == 2:
+            raise HttpError(406, "no free volumes")
+        return {"fid": "9,dd", "url": "srv:1"}
+
+    def ok_upload(url, fid, data, **kw):
+        calls["upload"] += 1
+        return {"size": len(data)}
+
+    monkeypatch.setattr(op_mod, "assign", flaky_assign)
+    monkeypatch.setattr(op_mod, "upload", ok_upload)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    a, up = _assign_and_upload("m", b"x", "f", "t", "", "", "")
+    assert a["fid"] == "9,dd" and calls["upload"] == 1
+    # a 400-class assign error is NOT retried
+    def fatal_assign(master_url, **kw):
+        raise HttpError(400, "bad replication")
+    monkeypatch.setattr(op_mod, "assign", fatal_assign)
+    with pytest.raises(HttpError) as ei:
+        _assign_and_upload("m", b"x", "f", "t", "", "", "")
+    assert ei.value.status == 400
+
+
+def test_ec_read_never_serves_wrong_needle(cluster, tmp_path):
+    """A blob that parses as a VALID needle with the wrong id must 500,
+    not be served (cookies can collide; id is the identity)."""
+    import numpy as np
+
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import HttpError, post_json
+    master, servers, _ = cluster
+    a = op.assign(master.url, collection="wrid")
+    vid = int(a["fid"].split(",")[0])
+    rng = np.random.default_rng(3)
+    for i in range(1, 6):
+        op.upload(a["url"], f"{vid},{i:x}00000001",
+                  rng.integers(0, 256, 50_000).astype(np.uint8).tobytes(),
+                  filename=f"f{i}")
+    holder = next(vs for vs in servers if vs.store.find_volume(vid))
+    post_json(f"http://{holder.url}/admin/volume/readonly?volume={vid}")
+    post_json(f"http://{holder.url}/admin/ec/generate?volume={vid}"
+              f"&collection=wrid")
+    post_json(f"http://{holder.url}/admin/ec/mount?volume={vid}"
+              f"&collection=wrid&shards="
+              + ",".join(str(s) for s in range(14)))
+    post_json(f"http://{holder.url}/admin/delete_volume?volume={vid}")
+    # sanity: EC reads serve the right needles
+    from seaweedfs_tpu.server.http_util import http_call
+    assert http_call("GET", f"http://{holder.url}/{vid},100000001")
+    # monkey-wrench the index lookup to return needle 2's location for
+    # needle 1: the id check must refuse to serve it
+    ev = holder.store.find_ec_volume(vid)
+    real_locate = ev.locate_needle
+
+    def wrong_locate(key):
+        return real_locate(2) if key == 1 else real_locate(key)
+
+    ev.locate_needle = wrong_locate
+    with pytest.raises(HttpError) as ei:
+        http_call("GET", f"http://{holder.url}/{vid},100000001")
+    assert ei.value.status == 500 and "assembled needle" in str(ei.value)
+    ev.locate_needle = real_locate
