@@ -90,8 +90,15 @@ class VidCache:
         self._cache[vid] = (time.time(), urls)
         return urls
 
-    def invalidate(self, vid: int):
+    def invalidate(self, vid: int, failed_urls=()):
+        """Drop cached routes; with ``failed_urls`` the push-updated
+        vid map also discards those holders (a bare TTL-cache pop
+        cannot help a watch-backed cache — the map would keep serving
+        the same stale route until the master's delta lands)."""
         self._cache.pop(vid, None)
+        if self._vid_map is not None:
+            for url in failed_urls:
+                self._vid_map.discard_url(vid, url)
 
 
 def lookup(master_url: str, vid: int) -> List[str]:

@@ -63,6 +63,22 @@ class VidMap:
         with self._lock:
             return vid in self._locations
 
+    def discard_url(self, vid: int, url: str):
+        """Drop one holder a caller just observed failing. The push
+        stream remains authoritative (the master's next delta restores
+        reality); this only stops retries of a dead route in the
+        window before that delta arrives. An emptied entry is removed
+        so lookups fall back to a direct /dir/lookup."""
+        with self._lock:
+            locs = self._locations.get(vid)
+            if not locs:
+                return
+            kept = [l for l in locs if l["url"] != url]
+            if kept:
+                self._locations[vid] = kept
+            else:
+                del self._locations[vid]
+
     # -- poll loop ---------------------------------------------------------
     def _apply(self, out: dict):
         with self._lock:

@@ -19,19 +19,33 @@ def default_fetcher(master_url: str):
     from ..storage.types import parse_file_id
     cache = VidCache(master_url, watch=True)
 
+    import time as _time
+
     def fetch(fid: str, offset: int, size: int) -> bytes:
         vid, _, _ = parse_file_id(fid)
         headers = {}
         if size >= 0:
             headers["Range"] = f"bytes={offset}-{offset + size - 1}"
         last: Optional[Exception] = None
-        for url in cache.lookup(vid):
-            try:
-                return http_call("GET", f"http://{url}/{fid}",
-                                 headers=headers)
-            except HttpError as e:
-                last = e
-                cache.invalidate(vid)
+        # two rounds: if every cached holder fails at transport/server
+        # level (node died between the lookup and the read), discard
+        # the dead routes — including from the push-updated vid map —
+        # and try the refreshed set once more. Deterministic 4xx (a
+        # vacuumed chunk) never retries: it would just double latency.
+        for round_ in range(2):
+            failed = []
+            for url in cache.lookup(vid):
+                try:
+                    return http_call("GET", f"http://{url}/{fid}",
+                                     headers=headers)
+                except HttpError as e:
+                    last = e
+                    failed.append(url)
+            cache.invalidate(vid, failed_urls=failed)
+            if last is not None and last.status < 500:
+                break
+            if round_ == 0:
+                _time.sleep(0.5)
         raise last or HttpError(404, f"no locations for {fid}")
 
     return fetch
