@@ -108,13 +108,34 @@ def lookup(master_url: str, vid: int) -> List[str]:
 
 def read_file(master_url: str, fid: str,
               cache: Optional[VidCache] = None) -> bytes:
+    return read_file_named(master_url, fid, cache)[0]
+
+
+def read_file_named(master_url: str, fid: str,
+                    cache: Optional[VidCache] = None):
+    """Fetch a needle and its stored filename (from Content-Disposition;
+    reference download.go names output files this way).
+    -> (data, name_or_empty). read_file delegates here so the lookup/
+    failover loop exists once."""
+    import email.message as _em
+
+    from ..server.http_util import http_get_with_headers
     from ..storage.types import parse_file_id
     vid, _, _ = parse_file_id(fid)
     urls = cache.lookup(vid) if cache else lookup(master_url, vid)
     last_err = None
     for u in urls:
         try:
-            return http_call("GET", f"http://{u}/{fid}")
+            data, headers = http_get_with_headers(f"http://{u}/{fid}")
+            cd = {k.lower(): v for k, v in headers.items()}.get(
+                "content-disposition", "")
+            # stdlib header parsing handles quoting/escapes that a
+            # naive regex would truncate on
+            msg = _em.Message()
+            msg["content-disposition"] = cd
+            name = msg.get_param("filename",
+                                 header="content-disposition") or ""
+            return data, (name if isinstance(name, str) else "")
         except HttpError as e:
             last_err = e
     raise last_err or HttpError(404, f"no locations for {fid}")

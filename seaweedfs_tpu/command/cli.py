@@ -295,10 +295,16 @@ def cmd_upload(args):
 
 
 def cmd_download(args):
+    import os
+
     from ..client import operation as op
+    os.makedirs(args.dir, exist_ok=True)
     for fid in args.fids:
-        data = op.read_file(args.master, fid)
-        out = fid.replace(",", "_")
+        data, name = op.read_file_named(args.master, fid)
+        # basename only: the stored name is uploader-controlled and
+        # must never traverse outside -dir (or crash on subdirs)
+        name = os.path.basename(name.replace("\\", "/"))
+        out = os.path.join(args.dir, name or fid.replace(",", "_"))
         with open(out, "wb") as f:
             f.write(data)
         print(f"{fid} -> {out} ({len(data)} bytes)")
@@ -741,6 +747,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     d = sub.add_parser("download", help="download files by fid")
     d.add_argument("-master", default="127.0.0.1:9333")
+    d.add_argument("-dir", default=".",
+                   help="output directory (reference download -dir); "
+                        "files keep their stored names when present")
     d.add_argument("fids", nargs="+")
     d.set_defaults(fn=cmd_download)
 

@@ -114,10 +114,12 @@ class Request:
                 continue
             head, data = part.split(b"\r\n\r\n", 1)
             head_s = head.decode("utf-8", "replace")
-            fn = re.search(r'filename="([^"]*)"', head_s)
+            fn = re.search(r'filename="((?:[^"\\]|\\.)*)"', head_s)
             ct = re.search(r"Content-Type:\s*([^\r\n]+)", head_s, re.I)
             if fn is not None:
-                return (fn.group(1), ct.group(1).strip() if ct else "",
+                name = fn.group(1).replace('\\"', '"') \
+                    .replace("\\\\", "\\")
+                return (name, ct.group(1).strip() if ct else "",
                         data)
         return None
 
@@ -545,7 +547,8 @@ def _nodelay(conn):
 
 
 def _pooled_call(method: str, url: str, body, headers: dict,
-                 timeout: float, max_redirects: int = 5) -> bytes:
+                 timeout: float, max_redirects: int = 5,
+                 want_headers: bool = False):
     parsed = urllib.parse.urlsplit(url)
     netloc, scheme = parsed.netloc, parsed.scheme
     target = parsed.path or "/"
@@ -591,12 +594,29 @@ def _pooled_call(method: str, url: str, body, headers: dict,
             # redirect targets are emitted as plain http (volume read
             # redirects) — re-apply the cluster TLS scheme rewrite
             return _pooled_call(method, _client_url(loc), body, headers,
-                                timeout, max_redirects - 1)
+                                timeout, max_redirects - 1,
+                                want_headers)
         if resp.status >= 400:
             detail = data.decode("utf-8", "replace")[:500]
             raise HttpError(resp.status, f"{method} {url}: {detail}")
+        if want_headers:
+            return data, dict(resp.getheaders())
         return data
     raise HttpError(503, f"{method} {url}: retries exhausted")
+
+
+def http_get_with_headers(url: str, timeout: float = 30.0):
+    """Cluster GET returning (body, response headers) — for callers
+    that need metadata the body doesn't carry (stored filename in
+    Content-Disposition, etags)."""
+    url = _client_url(url)
+    try:
+        return _pooled_call("GET", url, None, {}, timeout,
+                            want_headers=True)
+    except HttpError:
+        raise
+    except (OSError, _httpc.HTTPException) as e:
+        raise HttpError(503, f"GET {url}: {e}") from None
 
 
 def http_call(method: str, url: str, body: bytes = None,
@@ -663,6 +683,11 @@ def post_json(url: str, obj=None, timeout: float = 30.0) -> dict:
     return json.loads(out or b"{}")
 
 
+def _quote_name(name: str) -> str:
+    """Escape a filename for a quoted-string header parameter."""
+    return name.replace("\\", "\\\\").replace('"', '\\"')
+
+
 def post_multipart(url: str, filename: str, data: bytes,
                    content_type: str = "application/octet-stream",
                    timeout: float = 60.0,
@@ -670,7 +695,7 @@ def post_multipart(url: str, filename: str, data: bytes,
     boundary = uuid.uuid4().hex
     body = (f"--{boundary}\r\n"
             f'Content-Disposition: form-data; name="file"; '
-            f'filename="{filename or "file"}"\r\n'
+            f'filename="{_quote_name(filename or "file")}"\r\n'
             f"Content-Type: {content_type}\r\n\r\n").encode() \
         + data + f"\r\n--{boundary}--\r\n".encode()
     all_headers = {"Content-Type":
@@ -723,7 +748,7 @@ def post_multipart_file(url: str, filename: str, fileobj, size: int,
     boundary = uuid.uuid4().hex
     prologue = (f"--{boundary}\r\n"
                 f'Content-Disposition: form-data; name="file"; '
-                f'filename="{filename or "file"}"\r\n'
+                f'filename="{_quote_name(filename or "file")}"\r\n'
                 f"Content-Type: {content_type}\r\n\r\n").encode()
     epilogue = f"\r\n--{boundary}--\r\n".encode()
     body = _ChainReader([prologue, (fileobj, size), epilogue])

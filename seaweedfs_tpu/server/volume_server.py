@@ -951,8 +951,12 @@ class VolumeServer:
             except (ValueError, AttributeError):
                 pass
         if got.has_name():
+            # escape quotes/backslashes: the name is uploader-controlled
+            # and lands inside a quoted-string header parameter
+            name = got.name.decode("utf-8", "replace") \
+                .replace("\\", "\\\\").replace('"', '\\"')
             headers["Content-Disposition"] = \
-                f'inline; filename="{got.name.decode("utf-8", "replace")}"'
+                f'inline; filename="{name}"'
         body = got.data
         # image ops on read (reference volume_server_handlers_read.go
         # resize-on-GET + images/orientation.go) — ONLY on explicit

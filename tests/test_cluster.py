@@ -211,3 +211,26 @@ def test_conditional_get_etag_304(cluster):
     resp = conn.getresponse()
     assert resp.status == 200 and resp.read() == b"cacheable-bytes"
     conn.close()
+
+
+def test_filename_quoting_and_download_sanitization(cluster, tmp_path):
+    """Names with quotes/backslashes round-trip through multipart
+    upload and Content-Disposition; `weed download` never lets an
+    uploader-controlled name traverse outside -dir."""
+    import subprocess
+    import sys
+
+    master, _ = cluster
+    fid = op.upload_data(master.url, b"q", filename='we"ird\\name.txt')
+    data, name = op.read_file_named(master.url, fid)
+    assert (data, name) == (b"q", 'we"ird\\name.txt')
+
+    evil = op.upload_data(master.url, b"t", filename="../../../esc.sh")
+    outdir = tmp_path / "dl"
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.command.cli", "download",
+         "-master", master.url, "-dir", str(outdir), evil],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-300:]
+    assert sorted(p.name for p in outdir.iterdir()) == ["esc.sh"]
