@@ -1,14 +1,14 @@
 """Async geo-replication (reference weed/replication/).
 
 Event-sourced: a subscriber follows the source filer's metadata event
-log, the Replicator routes each create/update/delete to a sink
-(another filer cluster, an S3 bucket, or — stubbed pending SDKs —
-GCS/Azure/B2), and the sink fetches chunk bytes from the source cluster
-on demand.
+log, the Replicator routes each create/update/delete to a sink —
+another filer cluster, any S3-compatible bucket (AWS/GCS-interop/B2),
+or Azure Blob via SharedKey REST — and the sink fetches chunk bytes
+from the source cluster on demand. All five sinks are real, no SDKs.
 """
 
 from .replicator import Replicator  # noqa: F401
-from .sink import (B2Sink, FilerSink, GcsSink,  # noqa: F401
+from .sink import (AzureSink, B2Sink, FilerSink, GcsSink,  # noqa: F401
                    ReplicationSink, SinkError, make_sink)
 from .source import FilerSource  # noqa: F401
 from .sub import EventSubscriber  # noqa: F401
