@@ -84,3 +84,30 @@ def test_make_fused_encode_fn_roundtrip():
     data = RNG.integers(0, 256, (k, n), dtype=np.uint8)
     got = np.asarray(fn(jnp.asarray(bitmat), data))
     assert np.array_equal(got, NumpyCodec(k, m).encode(data))
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (20, 4)])
+def test_fused_kernel_lowers_for_tpu_target(k, m):
+    """AOT-lower the NATIVE (non-interpret) fused kernel for the TPU
+    platform via jax.export: Mosaic runs at lowering time, so a kernel
+    that would fail on real hardware (unsupported op, bad tiling)
+    fails HERE, on the CPU test mesh — no tunnel required."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from seaweedfs_tpu.ops import gf256
+    from seaweedfs_tpu.ops.rs_pallas import (_fused_fn, fuse_bitmat,
+                                             pick_tile)
+
+    n = 1 << 18
+    matrix = gf256.build_matrix(k, k + m, "vandermonde")
+    fuse_bitmat(matrix[k:])  # host-side lift must build too
+    fn = _fused_fn(k, m, n, pick_tile(k, m, n), False)
+    exported = jexport.export(fn, platforms=["tpu"])(
+        jax.ShapeDtypeStruct((8 * m, 8 * k), jnp.int8),
+        jax.ShapeDtypeStruct((k, n), jnp.uint8))
+    assert exported.platforms == ("tpu",)
+    text = exported.mlir_module()
+    assert "tpu_custom_call" in text or "mosaic" in text.lower(), \
+        "kernel did not lower through Mosaic"
