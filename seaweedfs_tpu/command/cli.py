@@ -8,8 +8,10 @@ Commands: master, volume, server, shell, benchmark, upload, download,
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
+import threading
 import time
 
 
@@ -511,7 +513,97 @@ def cmd_mount(args):
     except FuseError as e:
         raise SystemExit(str(e))
     print(f"mounting {args.filer} at {args.dir}", flush=True)
+    _spawn_unmount_watchdog(args.dir)
     raise SystemExit(mount.run())
+
+
+def _spawn_unmount_watchdog(mountpoint):
+    """Exit the process once the mountpoint is externally unmounted.
+
+    Normally libfuse's event loop returns ENODEV after `fusermount -u`
+    and `mount.run()` exits on its own; on some kernels (observed on the
+    4.4-era sandbox this ships in) the read on /dev/fuse blocks forever
+    instead. Detection must happen OUTSIDE this process: from inside the
+    FUSE server, both /proc/self/mounts (mount-namespace lock) and
+    stat-based os.path.ismount (GETATTR racing mount setup) were observed
+    to block indefinitely. So spawn a tiny watcher subprocess that polls
+    /proc/mounts and TERM-then-KILLs us once the mountpoint entry has
+    appeared and then disappeared. The watcher exits on its own if we die
+    first, and stands down if the mount never appears (startup failure is
+    mount.run()'s to report).
+    """
+    # /proc/mounts records the symlink-resolved path, octal-escaping
+    # space, tab, newline and backslash.
+    esc = (os.path.realpath(mountpoint)
+           .replace("\\", "\\134").replace(" ", "\\040")
+           .replace("\t", "\\011").replace("\n", "\\012"))
+
+    def count_entries():
+        try:
+            with open("/proc/mounts") as f:
+                return sum(1 for line in f
+                           if len(p := line.split()) > 1 and p[1] == esc)
+        except OSError:
+            return -1
+
+    # Baseline BEFORE any FUSE activity (a pre-existing bind/tmpfs mount
+    # at the same target must not satisfy "our mount appeared", nor keep
+    # "our mount is gone" false after fusermount -u removes only ours).
+    # Taken in the parent so the watcher can't race mount.run().
+    baseline = count_entries()
+    if baseline < 0:
+        return   # no usable /proc/mounts; watchdog can't help here
+    watcher_src = r"""
+import os, signal, sys, time
+esc, pid, baseline = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+def alive():
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+def count():
+    try:
+        with open("/proc/mounts") as f:
+            return sum(1 for line in f
+                       if len(p := line.split()) > 1 and p[1] == esc)
+    except OSError:
+        return baseline + 1   # can't tell; don't kill a healthy mount
+
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline and count() <= baseline:
+    if not alive():
+        sys.exit(0)
+    time.sleep(0.2)
+if count() <= baseline:
+    sys.exit(0)       # never mounted; not ours to clean up
+while count() > baseline:
+    if not alive():
+        sys.exit(0)
+    time.sleep(0.5)
+time.sleep(2.0)       # grace: let fuse_main return on its own
+for sig in (signal.SIGTERM, signal.SIGKILL):
+    if not alive():
+        sys.exit(0)
+    try:
+        os.kill(pid, sig)
+    except OSError:
+        sys.exit(0)
+    time.sleep(2.0)
+"""
+    import subprocess
+    try:
+        # -S: the watcher is stdlib-only; skip site/sitecustomize (which
+        # can pull heavyweight deps or touch accelerator runtimes).
+        subprocess.Popen(
+            [sys.executable, "-S", "-c", watcher_src, esc,
+             str(os.getpid()), str(baseline)],
+            stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+    except OSError:
+        pass   # watchdog is best-effort; never block the mount itself
 
 
 def cmd_msg_broker(args):
@@ -536,7 +628,7 @@ def _wait(*stoppables):
     (reference util/signal_handling.go OnInterrupt) — a clean volume
     server shutdown sends /cluster/goodbye so watch subscribers reroute
     immediately instead of waiting out heartbeat expiry."""
-    done = __import__("threading").Event()
+    done = threading.Event()
 
     def on_signal(signum, frame):
         done.set()
