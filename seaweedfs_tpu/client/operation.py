@@ -84,11 +84,29 @@ class VidCache:
                 return urls
         hit = self._cache.get(vid)
         if hit and time.time() - hit[0] < self.ttl:
-            return hit[1]
+            return [l["url"] for l in hit[1]]
+        return [l["url"] for l in self._lookup_locations(vid)]
+
+    def lookup_read(self, vid: int) -> List[str]:
+        """Read-preferred routes: each holder's native read plane
+        (fastUrl) first, then its regular url as the fallback."""
+        if self._vid_map is not None:
+            urls = self._vid_map.lookup_read(vid)
+            if urls is not None:
+                return urls
+        hit = self._cache.get(vid)
+        if hit and time.time() - hit[0] < self.ttl:
+            locs = hit[1]
+        else:
+            locs = self._lookup_locations(vid)
+        from .vid_map import _read_routes
+        return _read_routes(locs)
+
+    def _lookup_locations(self, vid: int) -> List[dict]:
         out = get_json(f"http://{self.master_url}/dir/lookup?volumeId={vid}")
-        urls = [l["url"] for l in out.get("locations", [])]
-        self._cache[vid] = (time.time(), urls)
-        return urls
+        locs = out.get("locations", [])
+        self._cache[vid] = (time.time(), locs)
+        return locs
 
     def invalidate(self, vid: int, failed_urls=()):
         """Drop cached routes; with ``failed_urls`` the push-updated
@@ -104,6 +122,12 @@ class VidCache:
 def lookup(master_url: str, vid: int) -> List[str]:
     out = get_json(f"http://{master_url}/dir/lookup?volumeId={vid}")
     return [l["url"] for l in out.get("locations", [])]
+
+
+def lookup_read(master_url: str, vid: int) -> List[str]:
+    from .vid_map import _read_routes
+    out = get_json(f"http://{master_url}/dir/lookup?volumeId={vid}")
+    return _read_routes(out.get("locations", []))
 
 
 def read_file(master_url: str, fid: str,
@@ -122,7 +146,10 @@ def read_file_named(master_url: str, fid: str,
     from ..server.http_util import http_get_with_headers
     from ..storage.types import parse_file_id
     vid, _, _ = parse_file_id(fid)
-    urls = cache.lookup(vid) if cache else lookup(master_url, vid)
+    # reads prefer a holder's native plane; deletes/writes never do (the
+    # pooled client only follows redirects for GET/HEAD)
+    urls = cache.lookup_read(vid) if cache \
+        else lookup_read(master_url, vid)
     last_err = None
     for u in urls:
         try:

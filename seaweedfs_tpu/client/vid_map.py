@@ -59,21 +59,45 @@ class VidMap:
             locs = self._locations.get(vid)
             return [l["url"] for l in locs] if locs else None
 
+    def lookup_read(self, vid: int) -> Optional[List[str]]:
+        """Like lookup(), but read-preferred: each holder's native read
+        plane (fastUrl) first, then its regular url as the fallback —
+        a plane hiccup must degrade to the Python server, never make a
+        healthy holder unreachable."""
+        if not self._ready.is_set():
+            if self._thread is None or not self._thread.is_alive():
+                if time.monotonic() - self._last_start > 5:
+                    self.start()
+            return None
+        with self._lock:
+            locs = self._locations.get(vid)
+            if not locs:
+                return None
+            return _read_routes(locs)
+
     def known(self, vid: int) -> bool:
         with self._lock:
             return vid in self._locations
 
     def discard_url(self, vid: int, url: str):
-        """Drop one holder a caller just observed failing. The push
+        """Drop one route a caller just observed failing. The push
         stream remains authoritative (the master's next delta restores
         reality); this only stops retries of a dead route in the
-        window before that delta arrives. An emptied entry is removed
-        so lookups fall back to a direct /dir/lookup."""
+        window before that delta arrives. A failing fast plane strips
+        only the fastUrl (the holder's Python server stays routable);
+        a failing holder url drops the holder. An emptied entry is
+        removed so lookups fall back to a direct /dir/lookup."""
         with self._lock:
             locs = self._locations.get(vid)
             if not locs:
                 return
-            kept = [l for l in locs if l["url"] != url]
+            kept = []
+            for l in locs:
+                if l["url"] == url:
+                    continue
+                if l.get("fastUrl") == url:
+                    l = {k: v for k, v in l.items() if k != "fastUrl"}
+                kept.append(l)
             if kept:
                 self._locations[vid] = kept
             else:
@@ -90,6 +114,8 @@ class VidMap:
                 vid = int(ev["vid"])
                 entry = {"url": ev["url"],
                          "publicUrl": ev.get("publicUrl", ev["url"])}
+                if ev.get("fastUrl"):
+                    entry["fastUrl"] = ev["fastUrl"]
                 locs = self._locations.setdefault(vid, [])
                 if ev["type"] == "new":
                     if all(l["url"] != entry["url"] for l in locs):
@@ -119,6 +145,18 @@ class VidMap:
                 if failures >= self.MAX_CONSECUTIVE_FAILURES:
                     return           # park; a later lookup() revives us
                 self._stop.wait(min(2.0, 0.2 * failures))
+
+
+def _read_routes(locs) -> List[str]:
+    """Per holder: fastUrl (when advertised) then the regular url, so
+    reads prefer the native plane but always have the Python fallback."""
+    out: List[str] = []
+    for l in locs:
+        fast = l.get("fastUrl")
+        if fast:
+            out.append(fast)
+        out.append(l["url"])
+    return out
 
 
 _shared: Dict[str, VidMap] = {}

@@ -92,11 +92,14 @@ def cmd_volume(args):
                       ec_backend=args.ec_backend,
                       jwt_signing_key=args.jwtKey,
                       index_kind=args.index,
+                      fast_port=args.fastPort,
                       compaction_mbps=args.compactionMBps,
                       whitelist=[w for w in args.whiteList.split(",")
                                  if w]).start()
     print(f"volume server listening on {vs.url}, "
           f"heartbeating to {args.mserver}")
+    if vs.fast_plane is not None:
+        print(f"native read plane on {vs.fast_url}")
     prof = _maybe_profiler(args)
     _wait(vs)
     if prof:
@@ -121,8 +124,11 @@ def cmd_server(args):
                       rack=args.rack, pulse_seconds=args.pulseSeconds,
                       max_volume_counts=maxes,
                       ec_backend=args.ec_backend,
+                      fast_port=args.fastPort,
                       jwt_signing_key=args.jwtKey).start()
     print(f"master on {m.url}, volume server on {vs.url}")
+    if vs.fast_plane is not None:
+        print(f"native read plane on {vs.fast_url}")
     stoppables = [vs]
     if args.filer or args.s3 or args.webdav:
         from ..server.filer_server import FilerServer
@@ -697,6 +703,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-pulseSeconds", type=int, default=5)
     v.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu", "mesh"])
+    v.add_argument("-fastPort", type=int, default=0,
+                   help="native C++ read plane port (0 = auto-pick, "
+                        "-1 = disabled); plain needle GETs are served "
+                        "there without the Python GIL")
     v.add_argument("-compactionMBps", type=int, default=0,
                    help="throttle vacuum/compaction writes (MB/s, "
                         "0 = unthrottled; reference compactionMBps)")
@@ -745,6 +755,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-webdavPort", type=int, default=7333)
     s.add_argument("-ec.backend", dest="ec_backend", default="auto",
                    choices=["auto", "numpy", "native", "tpu", "mesh"])
+    s.add_argument("-fastPort", type=int, default=0,
+                   help="native C++ read plane port (0 = auto-pick, "
+                        "-1 = disabled)")
     s.add_argument("-jwtKey", default="")
     s.add_argument("-tlsCert", default="")
     s.add_argument("-tlsKey", default="")

@@ -34,7 +34,7 @@ def default_fetcher(master_url: str):
         # vacuumed chunk) never retries: it would just double latency.
         for round_ in range(2):
             failed = []
-            for url in cache.lookup(vid):
+            for url in cache.lookup_read(vid):
                 try:
                     return http_call("GET", f"http://{url}/{fid}",
                                      headers=headers)

@@ -330,7 +330,7 @@ class MasterServer:
                         if self.topology.location_listener is not None:
                             self.topology.location_listener(
                                 "deleted", vid, node.url,
-                                node.public_url)
+                                node.public_url, node.fast_url)
                 if reaped:
                     expired.append(vid)
         return {"vacuumed": results, "ttl_expired": expired}
@@ -404,6 +404,7 @@ class MasterServer:
                 ip=hb.get("ip", "127.0.0.1"),
                 port=int(hb.get("port", 0)),
                 public_url=hb.get("public_url", ""),
+                fast_url=hb.get("fast_url", ""),
                 max_volume_count=int(hb.get("max_volume_count", 7)),
                 volumes=hb.get("volumes", []),
                 ec_shards=ec_shards,
@@ -558,7 +559,9 @@ class MasterServer:
             for node in self.topology.all_nodes():
                 for vid in node.volumes:
                     out.setdefault(str(vid), []).append(
-                        {"url": node.url, "publicUrl": node.public_url})
+                        {"url": node.url, "publicUrl": node.public_url,
+                         **({"fastUrl": node.fast_url}
+                            if node.fast_url else {})})
             return out
 
     def cluster_watch(self, req: Request):
@@ -583,8 +586,10 @@ class MasterServer:
         if not locs:
             raise HttpError(404, f"volume {vid} not found")
         return {"volumeId": vid_s,
-                "locations": [{"url": n.url, "publicUrl": n.public_url}
-                              for n in locs]}
+                "locations": [
+                    {"url": n.url, "publicUrl": n.public_url,
+                     **({"fastUrl": n.fast_url} if n.fast_url else {})}
+                    for n in locs]}
 
     def ec_lookup(self, req: Request):
         fwd = self._leader_forward(req)

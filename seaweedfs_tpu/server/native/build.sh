@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the native volume-server read plane (thread-per-connection HTTP
+# server serving needle reads without the Python GIL in the loop) and
+# the keep-alive load generator used to measure it.
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -std=c++17 -fPIC -shared -pthread -o libseaweed_http.so http_plane.cc
+g++ -O2 -std=c++17 -pthread -o loadgen loadgen.cc
+echo "built $(pwd)/libseaweed_http.so and loadgen"

@@ -1,0 +1,802 @@
+// Native volume-server read plane.
+//
+// The reference's data plane is Go: goroutine-per-connection HTTP serving
+// needle reads straight off the volume files (reference
+// weed/server/volume_server_handlers_read.go). The Python server keeps
+// full semantics but is GIL-bound (~2.7k reads/s/process); this library
+// is the native equivalent of the reference's hot read loop: a
+// thread-per-connection keep-alive HTTP/1.1 server that parses
+// `GET /<vid>,<fid>`, looks the needle up in an in-process index mirror
+// (synced from Python over ctypes), preads the needle blob, validates
+// cookie/CRC/TTL, and answers — no Python in the loop.
+//
+// Scope is the FAST PATH only. Anything with semantics beyond a plain
+// stored needle — gzip-stored payloads, chunk manifests, Seaweed-* pair
+// headers, image resize queries, EC volumes, remote volumes — is answered
+// with a 307 redirect to the Python server (`fallback`), which remains
+// the source of truth. Correctness parity for the served cases is pinned
+// by tests/test_native_plane.py against the Python responses.
+//
+// Needle layout parsed here == storage/needle.py (byte-compatible with
+// reference weed/storage/needle/needle_read_write.go):
+//   header: Cookie(4) Id(8) Size(4) big-endian
+//   v2/v3 body: DataSize(4) Data Flags(1) [Name] [Mime] [LastModified(5)]
+//               [TTL(2)] [PairsSize(2) Pairs] CRC(4) [AppendAtNs(8)] pad8
+// CRC is masked Castagnoli over Data (reference crc.go:25).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32c
+struct CrcTables {
+  uint32_t t[8][256];
+  CrcTables() {
+    const uint32_t poly = 0x82F63B78u;
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+      t[0][i] = c;
+    }
+    for (int j = 1; j < 8; j++)
+      for (uint32_t i = 0; i < 256; i++)
+        t[j][i] = t[j - 1][i] >> 8 ^ t[0][t[j - 1][i] & 0xFF];
+  }
+};
+const CrcTables g_crc;
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  uint32_t crc = ~0u;
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    crc ^= static_cast<uint32_t>(data[i]) |
+           (static_cast<uint32_t>(data[i + 1]) << 8) |
+           (static_cast<uint32_t>(data[i + 2]) << 16) |
+           (static_cast<uint32_t>(data[i + 3]) << 24);
+    crc = g_crc.t[7][crc & 0xFF] ^ g_crc.t[6][(crc >> 8) & 0xFF] ^
+          g_crc.t[5][(crc >> 16) & 0xFF] ^ g_crc.t[4][crc >> 24] ^
+          g_crc.t[3][data[i + 4]] ^ g_crc.t[2][data[i + 5]] ^
+          g_crc.t[1][data[i + 6]] ^ g_crc.t[0][data[i + 7]];
+  }
+  for (; i < n; i++) crc = g_crc.t[0][(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t masked_crc(uint32_t crc) {  // reference crc.go:25
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// --------------------------------------------------------------- needles
+constexpr int kHeaderSize = 16;
+constexpr int kChecksumSize = 4;
+constexpr int kTimestampSize = 8;
+constexpr int kPaddingSize = 8;
+constexpr uint32_t kTombstoneSize = 0xFFFFFFFFu;
+
+constexpr uint8_t kFlagGzip = 0x01;
+constexpr uint8_t kFlagHasName = 0x02;
+constexpr uint8_t kFlagHasMime = 0x04;
+constexpr uint8_t kFlagHasLastModified = 0x08;
+constexpr uint8_t kFlagHasTtl = 0x10;
+constexpr uint8_t kFlagHasPairs = 0x20;
+constexpr uint8_t kFlagChunkManifest = 0x80;
+
+uint64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = v << 8 | p[i];
+  return v;
+}
+uint32_t be32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) << 24 | static_cast<uint32_t>(p[1]) << 16 |
+         static_cast<uint32_t>(p[2]) << 8 | p[3];
+}
+
+int64_t actual_size(uint32_t size, int version) {
+  int64_t base = kHeaderSize + static_cast<int64_t>(size) + kChecksumSize;
+  if (version == 3) base += kTimestampSize;
+  // reference PaddingLength never returns 0 (needle_read_write.go:287)
+  return base + (kPaddingSize - base % kPaddingSize);
+}
+
+// minutes per TTL unit (storage/types.py _UNIT_MINUTES)
+int64_t ttl_minutes(uint8_t count, uint8_t unit) {
+  static const int64_t per[] = {0, 1, 60, 1440, 10080, 44640, 525600};
+  return unit < 7 ? count * per[unit] : 0;
+}
+
+struct ParsedNeedle {
+  uint32_t cookie = 0;
+  uint64_t id = 0;
+  uint32_t size = 0;
+  const uint8_t* data = nullptr;  // into the read buffer
+  uint32_t data_size = 0;
+  uint8_t flags = 0;
+  std::string name, mime;
+  int64_t last_modified = 0;  // unix seconds
+  uint8_t ttl_count = 0, ttl_unit = 0;
+  uint32_t checksum = 0;  // stored masked crc
+};
+
+// Returns 0 ok, -1 corrupt.
+int parse_needle(const uint8_t* blob, size_t len, int version,
+                 ParsedNeedle* out) {
+  if (len < kHeaderSize) return -1;
+  out->cookie = be32(blob);
+  out->id = be64(blob + 4);
+  out->size = be32(blob + 12);
+  size_t size = out->size;
+  if (kHeaderSize + size + kChecksumSize > len) return -1;
+  const uint8_t* b = blob + kHeaderSize;
+  if (version == 1) {
+    out->data = b;
+    out->data_size = out->size;
+    out->flags = 0;
+  } else {
+    // v2/v3 body of `size` bytes
+    size_t idx = 0;
+    if (size > 0) {
+      if (idx + 4 > size) return -1;
+      out->data_size = be32(b + idx);
+      idx += 4;
+      if (idx + out->data_size >= size) return -1;  // flags byte must follow
+      out->data = b + idx;
+      idx += out->data_size;
+      out->flags = b[idx++];
+    }
+    if (idx < size && (out->flags & kFlagHasName)) {
+      uint8_t n = b[idx++];
+      if (idx + n > size) return -1;
+      out->name.assign(reinterpret_cast<const char*>(b + idx), n);
+      idx += n;
+    }
+    if (idx < size && (out->flags & kFlagHasMime)) {
+      uint8_t n = b[idx++];
+      if (idx + n > size) return -1;
+      out->mime.assign(reinterpret_cast<const char*>(b + idx), n);
+      idx += n;
+    }
+    if (idx < size && (out->flags & kFlagHasLastModified)) {
+      if (idx + 5 > size) return -1;
+      int64_t v = 0;
+      for (int i = 0; i < 5; i++) v = v << 8 | b[idx + i];
+      out->last_modified = v;
+      idx += 5;
+    }
+    if (idx < size && (out->flags & kFlagHasTtl)) {
+      if (idx + 2 > size) return -1;
+      out->ttl_count = b[idx];
+      out->ttl_unit = b[idx + 1];
+      idx += 2;
+    }
+  }
+  out->checksum = be32(b + size);
+  return 0;
+}
+
+// ---------------------------------------------------------------- server
+struct VolumeRec {
+  int fd = -1;
+  int version = 3;
+  std::unordered_map<uint64_t, std::pair<uint64_t, uint32_t>> index;
+  mutable std::shared_mutex mu;
+  ~VolumeRec() {
+    if (fd >= 0) close(fd);
+  }
+};
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  std::string fallback;  // host:port of the Python server
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> served{0}, redirected{0}, errors{0};
+  std::atomic<int> live{0};
+  int max_conns = 1024;
+  int64_t max_fastpath_bytes = 64ll << 20;
+  std::thread acceptor;
+  std::unordered_map<uint32_t, std::shared_ptr<VolumeRec>> vols;
+  mutable std::shared_mutex vols_mu;
+
+  std::shared_ptr<VolumeRec> find(uint32_t vid) const {
+    std::shared_lock<std::shared_mutex> l(vols_mu);
+    auto it = vols.find(vid);
+    return it == vols.end() ? nullptr : it->second;
+  }
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+// header+body in one syscall (syscalls dominate small-needle serving)
+bool send_two(int fd, const void* a, size_t an, const void* b, size_t bn) {
+  struct iovec iov[2] = {{const_cast<void*>(a), an},
+                         {const_cast<void*>(b), bn}};
+  size_t idx = 0;
+  while (idx < 2) {
+    ssize_t w = writev(fd, iov + idx, static_cast<int>(2 - idx));
+    if (w <= 0) return false;
+    size_t done = static_cast<size_t>(w);
+    while (idx < 2 && done >= iov[idx].iov_len) {
+      done -= iov[idx].iov_len;
+      idx++;
+    }
+    if (idx < 2 && done > 0) {
+      iov[idx].iov_base = static_cast<char*>(iov[idx].iov_base) + done;
+      iov[idx].iov_len -= done;
+    }
+  }
+  return true;
+}
+
+struct Request {
+  std::string method, target;
+  bool keepalive = true;
+  bool http10 = false;
+  std::string if_none_match, range;
+  int64_t content_length = 0;
+  bool chunked = false;
+};
+
+// Reads one request off the socket (blocking). Returns 1 ok, 0 clean EOF,
+// -1 error/overflow.
+int read_request(int fd, std::string* acc, Request* out) {
+  // acc may already hold pipelined bytes from the previous read
+  size_t scanned = 0;
+  for (;;) {
+    size_t pos = acc->find("\r\n\r\n", scanned > 3 ? scanned - 3 : 0);
+    if (pos != std::string::npos) {
+      std::string head = acc->substr(0, pos);
+      acc->erase(0, pos + 4);
+      // request line
+      size_t sp1 = head.find(' ');
+      size_t sp2 = head.find(' ', sp1 + 1);
+      size_t eol = head.find("\r\n");
+      if (sp1 == std::string::npos || sp2 == std::string::npos ||
+          sp2 > (eol == std::string::npos ? head.size() : eol))
+        return -1;
+      out->method = head.substr(0, sp1);
+      out->target = head.substr(sp1 + 1, sp2 - sp1 - 1);
+      out->http10 = head.compare(sp2 + 1, 8, "HTTP/1.0") == 0;
+      out->keepalive = !out->http10;
+      // headers we care about
+      size_t ls = (eol == std::string::npos) ? head.size() : eol + 2;
+      while (ls < head.size()) {
+        size_t le = head.find("\r\n", ls);
+        if (le == std::string::npos) le = head.size();
+        size_t colon = head.find(':', ls);
+        if (colon != std::string::npos && colon < le) {
+          std::string k = head.substr(ls, colon - ls);
+          size_t vs = colon + 1;
+          while (vs < le && head[vs] == ' ') vs++;
+          std::string v = head.substr(vs, le - vs);
+          for (auto& c : k) c = static_cast<char>(tolower(c));
+          if (k == "connection") {
+            std::string lv = v;
+            for (auto& c : lv) c = static_cast<char>(tolower(c));
+            if (lv.find("close") != std::string::npos) out->keepalive = false;
+            if (out->http10 && lv.find("keep-alive") != std::string::npos)
+              out->keepalive = true;
+          } else if (k == "if-none-match") {
+            out->if_none_match = v;
+          } else if (k == "range") {
+            out->range = v;
+          } else if (k == "content-length") {
+            char* end = nullptr;
+            out->content_length = strtoll(v.c_str(), &end, 10);
+            if (out->content_length < 0 || (end && *end != '\0'))
+              out->content_length = 0;
+          } else if (k == "transfer-encoding") {
+            out->chunked = true;  // no body framing here: close after
+          }
+        }
+        ls = le + 2;
+      }
+      return 1;
+    }
+    if (acc->size() > 16384) return -1;  // header cap
+    scanned = acc->size();
+    char buf[4096];
+    ssize_t r = recv(fd, buf, sizeof buf, 0);
+    if (r == 0) return acc->empty() ? 0 : -1;
+    if (r < 0) return -1;
+    acc->append(buf, static_cast<size_t>(r));
+  }
+}
+
+void respond_simple(int fd, int code, const char* reason,
+                    const std::string& body, bool keepalive,
+                    const std::string& extra_headers = "",
+                    const char* ctype = "text/plain") {
+  std::string head = "HTTP/1.1 " + std::to_string(code) + " " + reason +
+                     "\r\nContent-Length: " + std::to_string(body.size()) +
+                     "\r\nContent-Type: " + ctype + "\r\n" + extra_headers +
+                     "Connection: " +
+                     (keepalive ? "keep-alive" : "close") + "\r\n\r\n";
+  if (body.empty())
+    send_all(fd, head.data(), head.size());
+  else
+    send_two(fd, head.data(), head.size(), body.data(), body.size());
+}
+
+void redirect_to_fallback(Server* s, int fd, const Request& req) {
+  s->redirected++;
+  std::string loc = "http://" + s->fallback + req.target;
+  std::string hdr = "Location: " + loc + "\r\n";
+  // 307 preserves method+body; our fallback is the authoritative server
+  respond_simple(fd, 307, "Temporary Redirect", "", req.keepalive, hdr);
+}
+
+// `%xx` unescape for the path (fids are plain hex, but be tolerant)
+std::string unescape(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); i++) {
+    if (in[i] == '%' && i + 2 < in.size() && isxdigit(in[i + 1]) &&
+        isxdigit(in[i + 2])) {
+      out.push_back(static_cast<char>(
+          strtol(in.substr(i + 1, 2).c_str(), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(in[i]);
+    }
+  }
+  return out;
+}
+
+// Parse "/<vid>,<keyhex><cookie8>" (also '/' separator). Returns false if
+// the target is not a plain fid path (query string, extension, etc).
+bool parse_fid_path(const std::string& target, uint32_t* vid, uint64_t* key,
+                    uint32_t* cookie) {
+  if (target.empty() || target[0] != '/') return false;
+  if (target.find('?') != std::string::npos) return false;
+  std::string p = unescape(target.substr(1));
+  size_t sep = p.find(',');
+  if (sep == std::string::npos) sep = p.find('/');
+  if (sep == std::string::npos || sep == 0) return false;
+  uint64_t v = 0;
+  for (size_t i = 0; i < sep; i++) {
+    if (!isdigit(p[i])) return false;
+    v = v * 10 + static_cast<uint64_t>(p[i] - '0');
+    if (v > 0xFFFFFFFFull) return false;
+  }
+  std::string kh = p.substr(sep + 1);
+  // mirror storage/types.py parse_key_hash: 8 < len <= 24, last 8 hex
+  // chars are the cookie
+  if (kh.size() <= 8 || kh.size() > 24) return false;
+  for (char c : kh)
+    if (!isxdigit(c)) return false;
+  if (kh.size() % 2) kh = "0" + kh;
+  uint64_t k = 0;
+  for (size_t i = 0; i + 8 < kh.size(); i++)
+    k = k << 4 | static_cast<uint64_t>(strtol(kh.substr(i, 1).c_str(),
+                                              nullptr, 16));
+  uint32_t ck = static_cast<uint32_t>(
+      strtoul(kh.substr(kh.size() - 8).c_str(), nullptr, 16));
+  *vid = static_cast<uint32_t>(v);
+  *key = k;
+  *cookie = ck;
+  return true;
+}
+
+// Single-range parse: "bytes=a-b" / "bytes=a-" / "bytes=-n" (mirrors
+// server/http_util.parse_range; multi-range -> not handled -> full body)
+bool all_digits(const std::string& s) {
+  if (s.empty()) return false;
+  for (char c : s)
+    if (!isdigit(static_cast<unsigned char>(c))) return false;
+  return true;
+}
+
+bool parse_range_header(const std::string& r, int64_t total, int64_t* start,
+                        int64_t* length) {
+  if (r.compare(0, 6, "bytes=") != 0) return false;
+  std::string spec = r.substr(6);
+  if (spec.find(',') != std::string::npos) return false;
+  size_t dash = spec.find('-');
+  if (dash == std::string::npos) return false;
+  std::string a = spec.substr(0, dash), b = spec.substr(dash + 1);
+  if (a.empty() && b.empty()) return false;
+  if ((!a.empty() && !all_digits(a)) || (!b.empty() && !all_digits(b)))
+    return false;  // malformed bounds -> not parseable (Python: 416)
+  if (a.empty()) {  // suffix: last n bytes
+    int64_t n = strtoll(b.c_str(), nullptr, 10);
+    if (n <= 0) return false;
+    if (n > total) n = total;
+    *start = total - n;
+    *length = n;
+    return true;
+  }
+  int64_t s = strtoll(a.c_str(), nullptr, 10);
+  if (s >= total) return false;
+  int64_t e = b.empty() ? total - 1 : strtoll(b.c_str(), nullptr, 10);
+  if (e >= total) e = total - 1;
+  if (e < s) return false;
+  *start = s;
+  *length = e - s + 1;
+  return true;
+}
+
+void quote_escape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    if (c == '\\' || c == '"') out->push_back('\\');
+    out->push_back(c);
+  }
+}
+
+void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
+                  uint64_t key, uint32_t cookie) {
+  auto vol = s->find(vid);
+  if (!vol) {
+    redirect_to_fallback(s, fd, req);  // EC / remote / replica logic
+    return;
+  }
+  uint64_t offset;
+  uint32_t size;
+  {
+    std::shared_lock<std::shared_mutex> l(vol->mu);
+    auto it = vol->index.find(key);
+    if (it == vol->index.end() || it->second.first == 0 ||
+        it->second.second == kTombstoneSize) {
+      // The index here is only a MIRROR: during a re-sync window
+      // (compaction commit, volume copy, tail receive) or after a
+      // put/delete reorder it can transiently miss live needles. A
+      // miss therefore redirects to the authoritative Python server —
+      // a true miss still ends as its 404, a windowed miss is served.
+      l.unlock();
+      redirect_to_fallback(s, fd, req);
+      return;
+    }
+    offset = it->second.first;
+    size = it->second.second;
+  }
+  int64_t want = actual_size(size, vol->version);
+  if (want > s->max_fastpath_bytes) {  // huge blob: let Python stream it
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  std::vector<uint8_t> blob(static_cast<size_t>(want));
+  ssize_t got = pread(vol->fd, blob.data(), blob.size(),
+                      static_cast<off_t>(offset));
+  if (got < want) {
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error", "short read",
+                   req.keepalive);
+    return;
+  }
+  ParsedNeedle n;
+  if (parse_needle(blob.data(), blob.size(), vol->version, &n) != 0 ||
+      n.size != size) {
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error", "corrupt needle",
+                   req.keepalive);
+    return;
+  }
+  if (n.cookie != cookie) {
+    respond_simple(fd, 404, "Not Found", "cookie mismatch", req.keepalive);
+    return;
+  }
+  if (size > 0 && masked_crc(crc32c(n.data, n.data_size)) != n.checksum) {
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error", "crc mismatch",
+                   req.keepalive);
+    return;
+  }
+  // TTL expiry (volume.read_needle)
+  if ((n.flags & kFlagHasTtl) && (n.flags & kFlagHasLastModified)) {
+    int64_t mins = ttl_minutes(n.ttl_count, n.ttl_unit);
+    if (mins > 0 &&
+        time(nullptr) - n.last_modified > mins * 60) {
+      respond_simple(fd, 404, "Not Found", "needle expired", req.keepalive);
+      return;
+    }
+  }
+  // semantics beyond the fast path live in Python
+  if (n.flags & (kFlagGzip | kFlagChunkManifest | kFlagHasPairs)) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  char etag[16];
+  snprintf(etag, sizeof etag, "%02x%02x%02x%02x", n.checksum >> 24 & 0xFF,
+           n.checksum >> 16 & 0xFF, n.checksum >> 8 & 0xFF,
+           n.checksum & 0xFF);
+  // conditional GET (RFC7232 comma list, weak validators, "*")
+  if (!req.if_none_match.empty()) {
+    std::string quoted = std::string("\"") + etag + "\"";
+    std::string inm = req.if_none_match;
+    bool match = false;
+    size_t pos = 0;
+    while (pos <= inm.size()) {
+      size_t comma = inm.find(',', pos);
+      std::string c = inm.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      // trim + strip weak prefix
+      size_t b = c.find_first_not_of(" \t");
+      size_t e = c.find_last_not_of(" \t");
+      if (b != std::string::npos) {
+        c = c.substr(b, e - b + 1);
+        if (c.compare(0, 2, "W/") == 0) c = c.substr(2);
+        if (c == "*" || c == quoted) {
+          match = true;
+          break;
+        }
+      }
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+    if (match) {
+      // header set mirrors the Python 304 (Etag + default octet-stream)
+      std::string hdr = "Etag: " + quoted + "\r\n";
+      respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
+                     "application/octet-stream");
+      s->served++;
+      return;
+    }
+  }
+  const char* ctype = "application/octet-stream";
+  std::string mime_hold;
+  if ((n.flags & kFlagHasMime) && !n.mime.empty()) {
+    mime_hold = n.mime;
+    ctype = mime_hold.c_str();
+  }
+  // image resize queries never reach here (any '?' redirects), so a
+  // plain GET of an image serves stored bytes — same as Python with no
+  // width/height args.
+  const uint8_t* body = n.data;
+  int64_t total = n.data_size;
+  int64_t start = 0, length = total;
+  bool ranged = false;
+  if (!req.range.empty()) {
+    if (parse_range_header(req.range, total, &start, &length)) {
+      ranged = true;
+    } else if (req.range.compare(0, 6, "bytes=") == 0) {
+      // unsatisfiable/multi range: Python answers 416 for bad single
+      // ranges; multi-ranges fall through to full body there. Redirect
+      // so every edge keeps one source of truth.
+      redirect_to_fallback(s, fd, req);
+      return;
+    }
+  }
+  std::string head;
+  head.reserve(512);
+  head += ranged ? "HTTP/1.1 206 Partial Content\r\n" : "HTTP/1.1 200 OK\r\n";
+  head += "Content-Length: " + std::to_string(length) + "\r\n";
+  head += "Content-Type: ";
+  head += ctype;
+  head += "\r\nEtag: \"";
+  head += etag;
+  head += "\"\r\nAccept-Ranges: bytes\r\n";
+  if (n.flags & kFlagHasName) {
+    std::string esc;
+    quote_escape(n.name, &esc);
+    head += "Content-Disposition: inline; filename=\"" + esc + "\"\r\n";
+  }
+  if (ranged)
+    head += "Content-Range: bytes " + std::to_string(start) + "-" +
+            std::to_string(start + length - 1) + "/" +
+            std::to_string(total) + "\r\n";
+  head += req.keepalive ? "Connection: keep-alive\r\n\r\n"
+                        : "Connection: close\r\n\r\n";
+  if (req.method == "HEAD")
+    send_all(fd, head.data(), head.size());
+  else
+    send_two(fd, head.data(), head.size(), body + start,
+             static_cast<size_t>(length));
+  s->served++;
+}
+
+void handle_conn(Server* s, int fd) {
+  struct timeval tv = {30, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  std::string acc;
+  while (!s->stop.load(std::memory_order_relaxed)) {
+    Request req;
+    int r = read_request(fd, &acc, &req);
+    if (r <= 0) break;
+    if (req.chunked) req.keepalive = false;  // body framing not parsed
+    // drain any request body so leftover bytes can't desync the next
+    // keep-alive request (redirected POST/PUT carry Content-Length)
+    if (req.content_length > 0) {
+      int64_t remaining = req.content_length;
+      int64_t from_acc =
+          std::min<int64_t>(remaining, static_cast<int64_t>(acc.size()));
+      acc.erase(0, static_cast<size_t>(from_acc));
+      remaining -= from_acc;
+      char sink[8192];
+      while (remaining > 0) {
+        ssize_t got2 = recv(fd, sink,
+                            std::min<int64_t>(remaining,
+                                              static_cast<int64_t>(
+                                                  sizeof sink)),
+                            0);
+        if (got2 <= 0) {
+          req.keepalive = false;
+          break;
+        }
+        remaining -= got2;
+      }
+    }
+    if (req.method == "GET" || req.method == "HEAD") {
+      uint32_t vid, cookie;
+      uint64_t key;
+      if (parse_fid_path(req.target, &vid, &key, &cookie)) {
+        serve_needle(s, fd, req, vid, key, cookie);
+      } else {
+        redirect_to_fallback(s, fd, req);
+      }
+    } else {
+      redirect_to_fallback(s, fd, req);
+    }
+    if (!req.keepalive) break;
+  }
+  close(fd);
+  s->live--;
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stop.load()) return;
+      usleep(10000);  // EMFILE/transient: don't busy-spin a core
+      continue;
+    }
+    if (s->stop.load()) {
+      close(fd);
+      return;
+    }
+    if (s->live.load() >= s->max_conns) {
+      respond_simple(fd, 503, "Service Unavailable", "too many connections",
+                     false);
+      close(fd);
+      continue;
+    }
+    s->live++;
+    std::thread(handle_conn, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (nullptr on failure). `fallback` is the
+// host:port of the owning Python volume server (redirect target).
+void* swhp_start(const char* host, uint16_t port, const char* fallback,
+                 int max_conns) {
+  auto s = std::make_unique<Server>();
+  s->fallback = fallback ? fallback : "";
+  if (max_conns > 0) s->max_conns = max_conns;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  addr.sin_addr.s_addr =
+      host && *host ? inet_addr(host) : htonl(INADDR_LOOPBACK);
+  if (addr.sin_addr.s_addr == INADDR_NONE ||
+      bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(fd, 256) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->listen_fd = fd;
+  Server* raw = s.release();
+  raw->acceptor = std::thread(accept_loop, raw);
+  return raw;
+}
+
+uint16_t swhp_port(void* h) { return static_cast<Server*>(h)->port; }
+
+// Registers (or re-registers, e.g. after compaction) a volume. Opens its
+// own fd on the .dat; the index starts empty — push entries with
+// swhp_put/swhp_put_bulk. Returns 0 ok, -1 open failure.
+int swhp_add_volume(void* h, uint32_t vid, const char* dat_path,
+                    int version) {
+  Server* s = static_cast<Server*>(h);
+  int fd = open(dat_path, O_RDONLY);
+  if (fd < 0) return -1;
+  auto rec = std::make_shared<VolumeRec>();
+  rec->fd = fd;
+  rec->version = version;
+  std::unique_lock<std::shared_mutex> l(s->vols_mu);
+  s->vols[vid] = std::move(rec);
+  return 0;
+}
+
+int swhp_remove_volume(void* h, uint32_t vid) {
+  Server* s = static_cast<Server*>(h);
+  std::unique_lock<std::shared_mutex> l(s->vols_mu);
+  return s->vols.erase(vid) ? 0 : -1;
+}
+
+int swhp_put(void* h, uint32_t vid, uint64_t key, uint64_t offset,
+             uint32_t size) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  std::unique_lock<std::shared_mutex> l(vol->mu);
+  vol->index[key] = {offset, size};
+  return 0;
+}
+
+// Bulk load: parallel arrays (numpy-friendly).
+int swhp_put_bulk(void* h, uint32_t vid, const uint64_t* keys,
+                  const uint64_t* offsets, const uint32_t* sizes,
+                  int64_t count) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  std::unique_lock<std::shared_mutex> l(vol->mu);
+  vol->index.reserve(vol->index.size() + static_cast<size_t>(count));
+  for (int64_t i = 0; i < count; i++)
+    vol->index[keys[i]] = {offsets[i], sizes[i]};
+  return 0;
+}
+
+int swhp_delete(void* h, uint32_t vid, uint64_t key) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  std::unique_lock<std::shared_mutex> l(vol->mu);
+  vol->index.erase(key);
+  return 0;
+}
+
+uint64_t swhp_served(void* h) { return static_cast<Server*>(h)->served; }
+uint64_t swhp_redirected(void* h) {
+  return static_cast<Server*>(h)->redirected;
+}
+
+void swhp_stop(void* h) {
+  Server* s = static_cast<Server*>(h);
+  s->stop = true;
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  if (s->acceptor.joinable()) s->acceptor.join();
+  // give in-flight connection threads a beat to observe stop and finish
+  for (int i = 0; i < 200 && s->live.load() > 0; i++)
+    usleep(10000);
+  // Leak s if connections are stuck: a crash on a wedged shutdown is
+  // worse than 1KB at process exit.
+  if (s->live.load() == 0) delete s;
+}
+
+}  // extern "C"

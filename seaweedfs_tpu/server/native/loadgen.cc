@@ -1,0 +1,162 @@
+// Minimal keep-alive HTTP GET load generator (benchmark client).
+//
+// The Python benchmark client tops out around ~350 req/s/process on
+// this kernel (syscall + interpreter overhead), which cannot exercise
+// the native read plane. This tool is the measuring instrument: N
+// threads, each with one keep-alive connection, issuing GETs for a
+// fixed duration and validating status codes.
+//
+//   ./loadgen <host> <port> <seconds> <threads> <path-file>
+//
+// path-file: newline-separated request paths (e.g. /3,01637037d6);
+// each thread cycles through them starting at a random offset.
+// Prints one line: total requests, elapsed seconds, req/s, errors.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::atomic<uint64_t> g_requests{0}, g_errors{0};
+std::atomic<bool> g_stop{false};
+
+int dial(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in a{};
+  a.sin_family = AF_INET;
+  a.sin_port = htons(static_cast<uint16_t>(port));
+  a.sin_addr.s_addr = inet_addr(host);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&a), sizeof a) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  return fd;
+}
+
+// Reads one HTTP response off the socket; returns status or -1.
+// Handles Content-Length framing only (both our planes always send it).
+int read_response(int fd, std::string* buf) {
+  size_t header_end;
+  for (;;) {
+    header_end = buf->find("\r\n\r\n");
+    if (header_end != std::string::npos) break;
+    char tmp[8192];
+    ssize_t r = recv(fd, tmp, sizeof tmp, 0);
+    if (r <= 0) return -1;
+    buf->append(tmp, static_cast<size_t>(r));
+  }
+  int status = -1;
+  if (buf->size() > 12) status = atoi(buf->c_str() + 9);
+  int64_t clen = 0;
+  // case-insensitive content-length scan within the header block
+  for (size_t pos = 0; pos < header_end;) {
+    size_t eol = buf->find("\r\n", pos);
+    if (eol == std::string::npos || eol > header_end) break;
+    if (strncasecmp(buf->c_str() + pos, "content-length:", 15) == 0)
+      clen = atoll(buf->c_str() + pos + 15);
+    pos = eol + 2;
+  }
+  size_t need = header_end + 4 + static_cast<size_t>(clen);
+  while (buf->size() < need) {
+    char tmp[16384];
+    ssize_t r = recv(fd, tmp, sizeof tmp, 0);
+    if (r <= 0) return -1;
+    buf->append(tmp, static_cast<size_t>(r));
+  }
+  buf->erase(0, need);
+  return status;
+}
+
+void run(const char* host, int port, const std::vector<std::string>* paths,
+         size_t start) {
+  int fd = dial(host, port);
+  std::string buf;
+  size_t i = start;
+  while (!g_stop.load(std::memory_order_relaxed)) {
+    if (fd < 0) {
+      fd = dial(host, port);
+      if (fd < 0) {
+        g_errors++;
+        usleep(10000);
+        continue;
+      }
+      buf.clear();
+    }
+    const std::string& p = (*paths)[i++ % paths->size()];
+    std::string req = "GET " + p + " HTTP/1.1\r\nHost: x\r\n\r\n";
+    if (send(fd, req.data(), req.size(), MSG_NOSIGNAL) !=
+        static_cast<ssize_t>(req.size())) {
+      close(fd);
+      fd = -1;
+      continue;
+    }
+    int status = read_response(fd, &buf);
+    if (status == 200) {
+      g_requests++;
+    } else if (status < 0) {
+      close(fd);
+      fd = -1;
+    } else {
+      g_errors++;
+      g_requests++;
+    }
+  }
+  if (fd >= 0) close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr,
+            "usage: %s <host> <port> <seconds> <threads> <path-file>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* host = argv[1];
+  int port = atoi(argv[2]);
+  double seconds = atof(argv[3]);
+  int nthreads = atoi(argv[4]);
+  std::vector<std::string> paths;
+  std::ifstream f(argv[5]);
+  for (std::string line; std::getline(f, line);)
+    if (!line.empty()) paths.push_back(line);
+  if (paths.empty()) {
+    fprintf(stderr, "no paths\n");
+    return 2;
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<std::thread> ts;
+  for (int i = 0; i < nthreads; i++)
+    ts.emplace_back(run, host, port, &paths,
+                    static_cast<size_t>(i) * 7919);
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds(static_cast<int>(seconds * 1000)));
+  g_stop = true;
+  for (auto& t : ts) t.join();
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+  printf("{\"requests\": %llu, \"seconds\": %.3f, \"rps\": %.1f, "
+         "\"errors\": %llu}\n",
+         static_cast<unsigned long long>(g_requests.load()), dt,
+         g_requests.load() / dt,
+         static_cast<unsigned long long>(g_errors.load()));
+  return 0;
+}

@@ -1,0 +1,157 @@
+"""ctypes wrapper for the native volume-server read plane.
+
+The C++ library (`server/native/http_plane.cc`) serves plain needle GETs
+on a second advertised port without the Python GIL in the loop — the
+native analog of the reference's Go data plane (reference
+weed/server/volume_server_handlers_read.go). The Python server stays the
+source of truth: the plane answers only the fast path and 307-redirects
+everything else (EC volumes, gzip-stored payloads, chunk manifests,
+Seaweed-* pairs, resize queries) back to the owning Python server.
+
+The index the plane serves from is a mirror, pushed from Python:
+  - `register_volume(volume)` bulk-loads the needle map after a volume
+    is loaded/created (and re-syncs after compaction commit);
+  - `put`/`delete` mirror every write/delete as it happens (the .dat is
+    flushed before the index update, so the plane's independent fd sees
+    the bytes through the page cache).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_LIB_PATH = os.path.join(_LIB_DIR, "libseaweed_http.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib or None
+        try:
+            if not os.path.exists(_LIB_PATH):
+                # compile only the library (build.sh also builds the
+                # loadgen tool, which server startup must not wait for)
+                import subprocess
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                     "-pthread", "-o", _LIB_PATH,
+                     os.path.join(_LIB_DIR, "http_plane.cc")],
+                    check=True, capture_output=True, timeout=60)
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception:
+            _lib = False
+            return None
+        lib.swhp_start.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                   ctypes.c_char_p, ctypes.c_int]
+        lib.swhp_start.restype = ctypes.c_void_p
+        lib.swhp_port.argtypes = [ctypes.c_void_p]
+        lib.swhp_port.restype = ctypes.c_uint16
+        lib.swhp_add_volume.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                        ctypes.c_char_p, ctypes.c_int]
+        lib.swhp_add_volume.restype = ctypes.c_int
+        lib.swhp_remove_volume.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.swhp_remove_volume.restype = ctypes.c_int
+        lib.swhp_put.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                 ctypes.c_uint64, ctypes.c_uint64,
+                                 ctypes.c_uint32]
+        lib.swhp_put.restype = ctypes.c_int
+        lib.swhp_put_bulk.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                      ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_void_p, ctypes.c_int64]
+        lib.swhp_put_bulk.restype = ctypes.c_int
+        lib.swhp_delete.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    ctypes.c_uint64]
+        lib.swhp_delete.restype = ctypes.c_int
+        lib.swhp_served.argtypes = [ctypes.c_void_p]
+        lib.swhp_served.restype = ctypes.c_uint64
+        lib.swhp_redirected.argtypes = [ctypes.c_void_p]
+        lib.swhp_redirected.restype = ctypes.c_uint64
+        lib.swhp_stop.argtypes = [ctypes.c_void_p]
+        lib.swhp_stop.restype = None
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeReadPlane:
+    """One native fast-read server owned by a VolumeServer."""
+
+    def __init__(self, host: str, port: int, fallback_hostport: str,
+                 max_conns: int = 1024):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libseaweed_http.so unavailable")
+        self._lib = lib
+        self._h = lib.swhp_start(host.encode(), port,
+                                 fallback_hostport.encode(), max_conns)
+        if not self._h:
+            raise RuntimeError(
+                f"native read plane failed to listen on {host}:{port}")
+        self.host = host
+        self.port = lib.swhp_port(self._h)
+
+    # -- volume lifecycle --------------------------------------------------
+    def register_volume(self, volume) -> bool:
+        """Open the .dat and bulk-load the volume's live needle map.
+
+        The plane answers index misses with a redirect to the Python
+        server, so the add-then-fill window is safe (windowed misses
+        are served by the fallback, never 404'd). The needle map is
+        snapshotted under the volume lock — it mutates under writes."""
+        rc = self._lib.swhp_add_volume(
+            self._h, volume.id, volume.dat_path.encode(), volume.version)
+        if rc != 0:
+            return False
+        import numpy as np
+        with volume.lock:
+            entries = list(volume.nm.items())
+        keys, offsets, sizes = [], [], []
+        for key, nv in entries:
+            keys.append(key)
+            offsets.append(nv.offset)
+            sizes.append(nv.size)
+        if keys:
+            ka = np.asarray(keys, dtype=np.uint64)
+            oa = np.asarray(offsets, dtype=np.uint64)
+            sa = np.asarray(sizes, dtype=np.uint32)
+            self._lib.swhp_put_bulk(
+                self._h, volume.id,
+                ka.ctypes.data_as(ctypes.c_void_p),
+                oa.ctypes.data_as(ctypes.c_void_p),
+                sa.ctypes.data_as(ctypes.c_void_p), len(keys))
+        return True
+
+    def unregister_volume(self, vid: int):
+        self._lib.swhp_remove_volume(self._h, vid)
+
+    # -- per-needle mirror -------------------------------------------------
+    def put(self, vid: int, key: int, offset: int, size: int):
+        self._lib.swhp_put(self._h, vid, key, offset, size)
+
+    def delete(self, vid: int, key: int):
+        self._lib.swhp_delete(self._h, vid, key)
+
+    # -- stats / lifecycle -------------------------------------------------
+    @property
+    def served(self) -> int:
+        return int(self._lib.swhp_served(self._h))
+
+    @property
+    def redirected(self) -> int:
+        return int(self._lib.swhp_redirected(self._h))
+
+    def stop(self):
+        if self._h:
+            self._lib.swhp_stop(self._h)
+            self._h = None

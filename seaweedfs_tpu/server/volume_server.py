@@ -35,7 +35,7 @@ class VolumeServer:
                  public_url: str = "", read_redirect: bool = True,
                  ec_backend: str = "auto", jwt_signing_key: str = "",
                  whitelist=(), index_kind: str = "memory",
-                 compaction_mbps: int = 0):
+                 compaction_mbps: int = 0, fast_port: int = 0):
         router = Router()
         router.add("*", "/status", self.status)
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
@@ -117,6 +117,26 @@ class VolumeServer:
         self._ec_loc_cache = EcShardLocationCache(
             self._fetch_ec_shard_locations)
         self._stop = threading.Event()
+        # native read plane (reference: the Go data plane itself; here
+        # a C++ thread-per-connection server on a second advertised
+        # port, serving plain needle GETs without the GIL — anything
+        # non-trivial 307s back to this Python server). Gated off when
+        # read auth or TLS is configured: the plane speaks open HTTP.
+        self.fast_plane = None
+        from .http_util import tls_enabled
+        if fast_port >= 0 and not whitelist and not tls_enabled():
+            try:
+                from .native_plane import NativeReadPlane
+                self.fast_plane = NativeReadPlane(
+                    host, fast_port,
+                    public_url or f"{host}:{self.port}")
+                for loc in self.store.locations:
+                    for v in loc.volumes.values():
+                        self.fast_plane.register_volume(v)
+            except Exception as e:  # noqa: BLE001 - plane is optional
+                from ..util import glog
+                glog.V(0).infof("native read plane unavailable: %s", e)
+                self.fast_plane = None
         # delta-heartbeat state: last volume set acked, and by whom
         self._hb_acked_master = None
         self._hb_acked_volumes = None
@@ -146,12 +166,50 @@ class VolumeServer:
                       {"url": self.url}, timeout=2)
         except Exception:  # noqa: BLE001 - master may already be gone
             pass
+        if self.fast_plane is not None:
+            self.fast_plane.stop()
         self.server.stop()
         self.store.close()
 
     @property
     def url(self) -> str:
         return f"{self.host}:{self.port}"
+
+    @property
+    def fast_url(self) -> str:
+        return f"{self.host}:{self.fast_plane.port}" \
+            if self.fast_plane else ""
+
+    # -- native-plane index mirror ----------------------------------------
+    def _fast_put(self, vid: int, nid: int):
+        if self.fast_plane is None:
+            return
+        v = self.store.find_volume(vid)
+        if v is None:
+            return
+        nv = v.nm.get(nid)
+        if nv is not None:
+            self.fast_plane.put(vid, nid, nv.offset, nv.size)
+
+    def _fast_delete(self, vid: int, nid: int):
+        if self.fast_plane is not None:
+            self.fast_plane.delete(vid, nid)
+
+    def _fast_sync(self, vid: int):
+        """Re-register a volume after a structural change (create,
+        mount, compaction commit, copy, tail-receive, EC decode) or
+        unregister it when it's gone."""
+        if self.fast_plane is None:
+            return
+        v = self.store.find_volume(vid)
+        if v is None:
+            self.fast_plane.unregister_volume(vid)
+        else:
+            self.fast_plane.register_volume(v)
+
+    def _fast_unregister(self, vid: int):
+        if self.fast_plane is not None:
+            self.fast_plane.unregister_volume(vid)
 
     def _heartbeat_loop(self):
         from ..util import glog
@@ -199,6 +257,8 @@ class VolumeServer:
         giving up — startup must not die because the first listed seed
         happens to be the down one."""
         hb = self.store.collect_heartbeat()
+        if self.fast_plane is not None:
+            hb["fast_url"] = self.fast_url
         last = None
         for _ in range(len(self._seed_masters)):
             try:
@@ -225,7 +285,14 @@ class VolumeServer:
 
     # -- admin -------------------------------------------------------------
     def status(self, req: Request):
-        return self.store.status()
+        out = self.store.status()
+        if self.fast_plane is not None:
+            out["fast_plane"] = {
+                "url": self.fast_url,
+                "served": self.fast_plane.served,
+                "redirected": self.fast_plane.redirected,
+            }
+        return out
 
     def query_handler(self, req: Request):
         """S3-Select-ish query over JSON needles (reference Query RPC,
@@ -347,6 +414,7 @@ class VolumeServer:
         self.store.add_volume(vid, req.query.get("collection", ""),
                               req.query.get("replication", "000"),
                               req.query.get("ttl", ""))
+        self._fast_sync(vid)
         self.heartbeat_once()
         return {"volume": vid}
 
@@ -354,6 +422,7 @@ class VolumeServer:
         vid = int(req.query["volume"])
         if not self.store.delete_volume(vid):
             raise HttpError(404, f"volume {vid} not found")
+        self._fast_unregister(vid)
         self._lookup_cache.pop(vid, None)
         self.heartbeat_once()
         return {"deleted": vid}
@@ -397,6 +466,7 @@ class VolumeServer:
             return {"volume": vid, "mounted": False}  # already serving
         for loc in self.store.locations:
             if loc.load_volume(vid) is not None:
+                self._fast_sync(vid)
                 self.heartbeat_once()
                 return {"volume": vid, "mounted": True}
         raise HttpError(404, f"volume {vid} files not found")
@@ -407,6 +477,7 @@ class VolumeServer:
         vid = int(req.query["volume"])
         for loc in self.store.locations:
             if loc.unload_volume(vid):
+                self._fast_unregister(vid)
                 self.heartbeat_once()
                 return {"volume": vid, "unmounted": True}
         raise HttpError(404, f"volume {vid} not mounted")
@@ -434,7 +505,12 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise HttpError(404, f"volume {vid} not found")
+        # the commit swaps .dat/.idx under the volume: take the plane
+        # offline for this vid first so it can't serve old offsets
+        # against the new file, then re-sync from the fresh needle map
+        self._fast_unregister(vid)
         v.commit_compact()
+        self._fast_sync(vid)
         return {"volume": vid, "committed": True}
 
     # -- EC admin (reference volume_grpc_erasure_coding.go) ----------------
@@ -562,6 +638,7 @@ class VolumeServer:
         for ext in (".idx", ".dat"):
             self._pull_file(source, name + ext, base + ext)
         loc.load_existing_volumes()
+        self._fast_sync(vid)
         self.heartbeat_once()
         return {"volume": vid, "copied": True}
 
@@ -624,6 +701,7 @@ class VolumeServer:
         for loc in self.store.locations:
             if os.path.dirname(base) == loc.directory:
                 loc.load_existing_volumes()
+        self._fast_sync(vid)
         self.heartbeat_once()
         return {"volume": vid, "dat_size": dat_size}
 
@@ -646,12 +724,20 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise HttpError(404, f"volume {vid} not found")
+        # plane offline first: once the local .dat is removed its pinned
+        # fd would keep serving "local" reads AND hold the inode's disk
+        # space — defeating the tiering. The Python server reads via the
+        # remote backend from here on.
+        self._fast_unregister(vid)
         try:
             info = volume_tier.upload_dat(
                 v, req.query["dest"],
                 keep_local=req.query.get("keep_local") == "true")
         except (VolumeError, BackendError) as e:
+            self._fast_sync(vid)   # nothing moved; resume fast serving
             raise HttpError(400, str(e))
+        if req.query.get("keep_local") == "true":
+            self._fast_sync(vid)
         self.heartbeat_once()
         return info
 
@@ -669,6 +755,7 @@ class VolumeServer:
                 v, delete_remote=req.query.get("delete_remote") == "true")
         except (VolumeError, BackendError) as e:
             raise HttpError(400, str(e))
+        self._fast_sync(vid)   # fresh local .dat: (re)open + reload
         self.heartbeat_once()
         return out
 
@@ -728,6 +815,8 @@ class VolumeServer:
                 v, req.body, int(since) if since is not None else None)
         except VolumeError as e:
             raise HttpError(400, str(e))
+        if applied:
+            self._fast_sync(vid)
         return {"applied": applied, "cursor_ns": cursor}
 
     def admin_file(self, req: Request):
@@ -831,6 +920,7 @@ class VolumeServer:
             size = len(data)  # reference reports DataSize, not needle Size
         except VolumeError as e:
             raise HttpError(500, str(e)) from None
+        self._fast_put(vid, key)
         # synchronous replica fan-out, all-must-succeed (reference
         # store_replicate.go:20-83): attempt every replica, then fail the
         # request if any write is missing so the client knows the needle is
@@ -1213,6 +1303,7 @@ class VolumeServer:
             freed = self.store.delete_needle(vid, n)
         except VolumeError as e:
             raise HttpError(500, str(e)) from None
+        self._fast_delete(vid, key)
         if req.query.get("type") != "replicate":
             from ..security.jwt import jwt_from_request
             from ..util.fanout import fan_out

@@ -31,13 +31,16 @@ class WatchHub:
         self._seq = 1
         self._cond = threading.Condition()
 
-    def publish(self, etype: str, vid: int, url: str, public_url: str = ""):
+    def publish(self, etype: str, vid: int, url: str, public_url: str = "",
+                fast_url: str = ""):
         """Emit one VolumeLocation delta (etype: 'new' | 'deleted')."""
         with self._cond:
             self._seq += 1
-            self._events.append((self._seq, {
-                "type": etype, "vid": vid, "url": url,
-                "publicUrl": public_url or url}))
+            ev = {"type": etype, "vid": vid, "url": url,
+                  "publicUrl": public_url or url}
+            if fast_url:
+                ev["fastUrl"] = fast_url
+            self._events.append((self._seq, ev))
             self._cond.notify_all()
 
     def wait(self, since: int, timeout: float = 20.0) -> dict:

@@ -61,6 +61,9 @@ class DataNode:
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
+        # native read plane, when the server advertises one (empty
+        # otherwise); read paths prefer it for plain needle GETs
+        self.fast_url = ""
         self.max_volume_count = max_volume_count
         self.volumes: Dict[int, VolumeInfo] = {}
         self.ec_shards: Dict[int, ShardBits] = {}  # vid -> bits

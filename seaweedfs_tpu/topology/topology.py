@@ -183,13 +183,15 @@ class Topology:
                            volumes: List[dict],
                            ec_shards: Dict[int, int] = None,
                            ec_collections: Dict[int, str] = None,
-                           max_file_key: int = 0) -> DataNode:
+                           max_file_key: int = 0,
+                           fast_url: str = "") -> DataNode:
         with self.lock:
             dc = self.get_or_create_dc(dc_id or "DefaultDataCenter")
             rack = dc.get_or_create_rack(rack_id or "DefaultRack")
             node = rack.get_or_create_node(ip, port, public_url,
                                            max_volume_count)
             node.last_seen = time.time()
+            node.fast_url = fast_url
             self.sequencer.set_max(max_file_key)
 
             infos = [VolumeInfo.from_dict(v) for v in volumes]
@@ -209,10 +211,12 @@ class Topology:
             if self.location_listener is not None:
                 for vid in new_vids - old_vids:
                     self.location_listener("new", vid, node.url,
-                                           node.public_url)
+                                           node.public_url,
+                                           node.fast_url)
                 for vid in old_vids - new_vids:
                     self.location_listener("deleted", vid, node.url,
-                                           node.public_url)
+                                           node.public_url,
+                                           node.fast_url)
 
             if ec_shards is not None:
                 node.update_ec_shards(ec_shards, ec_collections or {})
@@ -243,7 +247,8 @@ class Topology:
                 layout.register_volume(vi, node)
                 if not was_known and self.location_listener is not None:
                     self.location_listener("new", vi.id, node.url,
-                                           node.public_url)
+                                           node.public_url,
+                                           node.fast_url)
             for vid in deleted_volumes:
                 was_present = node.volumes.pop(vid, None) is not None
                 for layout in self.layouts.values():
@@ -253,7 +258,8 @@ class Topology:
                 # subscribers see duplicate events every pulse
                 if was_present and self.location_listener is not None:
                     self.location_listener("deleted", vid, node.url,
-                                           node.public_url)
+                                           node.public_url,
+                                           node.fast_url)
             if ec_shards is not None:
                 node.update_ec_shards(ec_shards, ec_collections or {})
                 self._sync_ec_shards(node)
@@ -294,7 +300,8 @@ class Topology:
             if self.location_listener is not None:
                 for vid in list(node.volumes):
                     self.location_listener("deleted", vid, node.url,
-                                           node.public_url)
+                                           node.public_url,
+                                           node.fast_url)
             for per_shard in self.ec_shard_map.values():
                 for holders in per_shard:
                     if node in holders:

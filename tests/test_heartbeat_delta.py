@@ -23,7 +23,7 @@ def test_topology_delta_apply_and_resync_signal():
     topo = Topology(pulse_seconds=1)
     events = []
     topo.location_listener = \
-        lambda t, vid, url, pub: events.append((t, vid))
+        lambda t, vid, url, pub, fast="": events.append((t, vid))
     # unknown node -> resync required
     assert not topo.apply_heartbeat_delta("1.2.3.4:80", [hb_volume(1)], [])
     topo.register_heartbeat(

@@ -1,0 +1,264 @@
+"""Native C++ read plane: byte/semantic parity with the Python server.
+
+The plane (server/native/http_plane.cc) serves plain needle GETs on a
+second port; everything it answers must be indistinguishable from the
+Python server's answer for the same request, and everything it can't
+serve must 307 to the Python server (which the pooled client follows
+transparently for GET/HEAD).
+"""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.http_util import (HttpError, http_call,
+                                            http_get_with_headers,
+                                            post_json, post_multipart)
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.native_plane import available
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="libseaweed_http.so unavailable")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v0")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[10], ec_backend="numpy").start()
+    assert vs.fast_plane is not None, "plane should start by default"
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def assign_and_upload(master, data, filename="f.bin",
+                      ctype="application/octet-stream", headers=None):
+    a = post_json(f"http://{master.url}/dir/assign", {})
+    post_multipart(f"http://{a['url']}/{a['fid']}", filename, data, ctype,
+                   headers=headers)
+    return a["fid"], a["url"]
+
+
+def raw_get(hostport, path, headers=None, method="GET"):
+    """Single-socket HTTP roundtrip WITHOUT redirect following, so
+    the plane's own status codes are observable."""
+    import http.client
+    c = http.client.HTTPConnection(hostport, timeout=10)
+    c.request(method, path, headers=headers or {})
+    r = c.getresponse()
+    body = r.read()
+    out = (r.status, dict((k.lower(), v) for k, v in r.getheaders()), body)
+    c.close()
+    return out
+
+
+class TestParity:
+    def compare(self, vs, fid, headers=None, method="GET"):
+        """Same request to both planes; status/body and the semantic
+        headers must match."""
+        ps, ph, pb = raw_get(vs.url, f"/{fid}", headers, method)
+        fs, fh, fb = raw_get(vs.fast_url, f"/{fid}", headers, method)
+        assert ps == fs
+        if ps < 400:  # payloads must be identical; error TEXT may differ
+            assert pb == fb
+            for h in ("content-type", "etag", "content-disposition",
+                      "content-range", "accept-ranges"):
+                assert ph.get(h) == fh.get(h), \
+                    f"{h}: {ph.get(h)!r} != {fh.get(h)!r}"
+        return fs, fh, fb
+
+    def test_plain_roundtrip(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"hello-native-plane" * 100)
+        before = vs.fast_plane.served
+        st, _, body = self.compare(vs, fid)
+        assert st == 200 and body == b"hello-native-plane" * 100
+        assert vs.fast_plane.served > before
+
+    def test_named_mime_disposition(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"x" * 64, filename='we"ird.txt',
+                                   ctype="text/plain")
+        st, fh, _ = self.compare(vs, fid)
+        assert st == 200
+        assert fh["content-type"] == "text/plain"
+        assert 'we\\"ird.txt' in fh["content-disposition"]
+
+    def test_cookie_mismatch_404(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"data")
+        bad = fid[:-8] + ("0" * 8 if not fid.endswith("0" * 8) else "1" * 8)
+        st, _, _ = self.compare(vs, bad)
+        assert st == 404
+
+    def test_missing_needle_redirects_to_404(self, cluster):
+        """An index miss is NOT authoritative on the plane (it could be
+        a re-sync window): it 307s to Python, whose 404 is final."""
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"data")
+        vid = fid.split(",")[0]
+        st, _, _ = raw_get(vs.fast_url, f"/{vid},deadbeef00000001")
+        assert st == 307
+        with pytest.raises(HttpError) as ei:
+            http_get_with_headers(
+                f"http://{vs.fast_url}/{vid},deadbeef00000001")
+        assert ei.value.status == 404
+
+    def test_deleted_needle_404(self, cluster):
+        master, vs = cluster
+        fid, url = assign_and_upload(master, b"to-die")
+        http_call("DELETE", f"http://{url}/{fid}")
+        st, _, _ = raw_get(vs.fast_url, f"/{fid}")
+        assert st == 307  # deletion removed the mirror entry -> miss
+        with pytest.raises(HttpError) as ei:
+            http_get_with_headers(f"http://{vs.fast_url}/{fid}")
+        assert ei.value.status == 404
+
+    def test_range_request(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, bytes(range(200)))
+        st, fh, body = self.compare(vs, fid,
+                                    headers={"Range": "bytes=10-19"})
+        assert st == 206 and body == bytes(range(10, 20))
+        assert fh["content-range"] == "bytes 10-19/200"
+        # suffix range
+        st, _, body = self.compare(vs, fid, headers={"Range": "bytes=-5"})
+        assert st == 206 and body == bytes(range(195, 200))
+
+    def test_if_none_match_304(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"etag-me")
+        _, h, _ = raw_get(vs.fast_url, f"/{fid}")
+        etag = h["etag"]
+        st, fh, body = self.compare(
+            vs, fid, headers={"If-None-Match": etag})
+        assert st == 304 and body == b""
+        st, _, _ = self.compare(vs, fid, headers={"If-None-Match": "*"})
+        assert st == 304
+
+    def test_head(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"head-me" * 10)
+        st, fh, body = self.compare(vs, fid, method="HEAD")
+        assert st == 200 and body == b""
+        assert fh["content-length"] == str(70)
+
+    def test_pairs_needle_redirects_but_serves(self, cluster):
+        """Seaweed-* pairs are beyond the fast path: the plane must 307
+        and the followed response must equal the Python answer."""
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"pairs",
+                                   headers={"Seaweed-color": "azure"})
+        st, fh, _ = raw_get(vs.fast_url, f"/{fid}")
+        assert st == 307
+        assert fh["location"] == f"http://{vs.url}/{fid}"
+        # the pooled client follows it and lands on the full semantics
+        data, headers = http_get_with_headers(
+            f"http://{vs.fast_url}/{fid}")
+        assert data == b"pairs"
+        assert {k.lower(): v for k, v in headers.items()}[
+            "seaweed-color"] == "azure"
+
+    def test_query_string_redirects(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"q")
+        st, _, _ = raw_get(vs.fast_url, f"/{fid}?cm=false")
+        assert st == 307
+
+    def test_survives_compaction(self, cluster):
+        master, vs = cluster
+        keep, _ = assign_and_upload(master, b"keeper" * 50)
+        die, url = assign_and_upload(master, b"victim" * 50)
+        http_call("DELETE", f"http://{url}/{die}")
+        vid = int(keep.split(",")[0])
+        post_json(f"http://{vs.url}/admin/vacuum/compact?volume={vid}", {})
+        post_json(f"http://{vs.url}/admin/vacuum/commit?volume={vid}", {})
+        st, _, body = self.compare(vs, keep)
+        assert st == 200 and body == b"keeper" * 50
+        st, _, _ = raw_get(vs.fast_url, f"/{die}")
+        assert st == 307  # compacted away -> mirror miss -> fallback
+        with pytest.raises(HttpError) as ei:
+            http_get_with_headers(f"http://{vs.fast_url}/{die}")
+        assert ei.value.status == 404
+
+    def test_unmounted_volume_redirects(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"bye")
+        vid = int(fid.split(",")[0])
+        post_json(f"http://{vs.url}/admin/volume/unmount?volume={vid}", {})
+        st, _, _ = raw_get(vs.fast_url, f"/{fid}")
+        assert st == 307  # plane no longer owns it; Python answers 404
+
+    def test_post_redirects_with_body_drain(self, cluster):
+        """Keep-alive connection: a POST (with body) then a GET on the
+        same socket — the drained body must not desync parsing."""
+        import http.client
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"after-post")
+        c = http.client.HTTPConnection(vs.fast_url, timeout=10)
+        c.request("POST", f"/{fid}", body=b"x" * 4096,
+                  headers={"Content-Type": "application/octet-stream"})
+        r = c.getresponse()
+        r.read()
+        assert r.status == 307
+        c.request("GET", f"/{fid}")
+        r = c.getresponse()
+        assert r.status == 200 and r.read() == b"after-post"
+        c.close()
+
+
+class TestClusterIntegration:
+    def test_lookup_carries_fast_url_and_reads_use_it(self, cluster):
+        master, vs = cluster
+        from seaweedfs_tpu.client import operation
+        fid, _ = assign_and_upload(master, b"routed-fast")
+        out = post_json if False else None  # noqa: F841
+        from seaweedfs_tpu.server.http_util import get_json
+        vid = fid.split(",")[0]
+        looked = get_json(
+            f"http://{master.url}/dir/lookup?volumeId={vid}")
+        assert looked["locations"][0].get("fastUrl") == vs.fast_url
+        before = vs.fast_plane.served
+        got = operation.read_file(master.url, fid)
+        assert got == b"routed-fast"
+        assert vs.fast_plane.served > before
+
+    def test_read_routes_fall_back_to_python_url(self, cluster):
+        """A broken fast plane must degrade to the holder's Python url,
+        and discarding the fast route must not evict the holder."""
+        from seaweedfs_tpu.client.vid_map import _read_routes
+        locs = [{"url": "h1:80", "publicUrl": "h1:80",
+                 "fastUrl": "h1:81"},
+                {"url": "h2:80", "publicUrl": "h2:80"}]
+        assert _read_routes(locs) == ["h1:81", "h1:80", "h2:80"]
+
+    def test_discard_fast_url_keeps_holder(self, cluster):
+        from seaweedfs_tpu.client.vid_map import VidMap
+        vm = VidMap("unused:0")
+        vm._locations = {7: [{"url": "h1:80", "publicUrl": "h1:80",
+                              "fastUrl": "h1:81"}]}
+        vm._ready.set()
+        vm.discard_url(7, "h1:81")
+        assert vm.lookup(7) == ["h1:80"]          # holder survives
+        assert vm.lookup_read(7) == ["h1:80"]     # fast route gone
+        vm.discard_url(7, "h1:80")
+        assert vm.lookup(7) is None or vm.lookup(7) == []
+
+    def test_watch_event_carries_fast_url(self, cluster):
+        master, vs = cluster
+        from seaweedfs_tpu.server.http_util import get_json
+        fid, _ = assign_and_upload(master, b"watched")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            snap = get_json(f"http://{master.url}/cluster/watch?since=0"
+                            f"&timeout=1")
+            locs = (snap.get("locations") or {}).get(fid.split(",")[0])
+            if locs:
+                assert locs[0].get("fastUrl") == vs.fast_url
+                return
+            time.sleep(0.2)
+        raise AssertionError("volume never appeared in watch snapshot")
