@@ -308,6 +308,21 @@ def ha_cluster(tmp_path):
         m.stop()
 
 
+def _wait_vs_registered(masters, vs, timeout=20.0, alive=None):
+    """Wait until the CURRENT leader's topology actually lists the
+    volume server — the real registration signal (vs.master_url is a
+    seed-list guess before the first heartbeat lands, so comparing it
+    to the leader can pass vacuously). Re-resolves the leader each
+    poll: elections churn under 2-core full-suite load."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leader = _wait_http_leader(masters, alive=alive)
+        if leader.topology.find_node(vs.url) is not None:
+            return leader
+        time.sleep(0.2)
+    raise AssertionError(f"{vs.url} never registered with the leader")
+
+
 def _wait_http_leader(masters, timeout=10.0, alive=None):
     alive = alive if alive is not None else masters
     deadline = time.time() + timeout
@@ -321,16 +336,26 @@ def _wait_http_leader(masters, timeout=10.0, alive=None):
 
 def test_ha_assign_via_any_master(ha_cluster):
     masters, vs = ha_cluster
-    leader = _wait_http_leader(masters)
+    _wait_http_leader(masters)
     vs.start()
-    time.sleep(2.5)        # volume server finds + registers with leader
-    assert vs.master_url == leader.url
+    _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import HttpError
     # every master answers assigns — followers proxy to the leader
-    # (reference proxyToLeader)
+    # (reference proxyToLeader). First assign may race registration;
+    # retry briefly like a real HA client would.
     for m in masters:
-        fid = op.upload_data(m.url, b"ha-data-" + m.url.encode(),
-                             filename="ha.bin")
+        deadline = time.time() + 15
+        while True:
+            try:
+                fid = op.upload_data(m.url,
+                                     b"ha-data-" + m.url.encode(),
+                                     filename="ha.bin")
+                break
+            except HttpError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
         assert op.read_file(m.url, fid) == b"ha-data-" + m.url.encode()
 
 
@@ -340,7 +365,7 @@ def test_ha_multipart_submit_via_follower(ha_cluster):
     masters, vs = ha_cluster
     leader = _wait_http_leader(masters)
     vs.start()
-    time.sleep(2.5)
+    leader = _wait_vs_registered(masters, vs)
     follower = next(m for m in masters if m is not leader)
     from seaweedfs_tpu.server.http_util import http_call, post_multipart
     out = post_multipart(f"http://{follower.url}/submit", "s.bin",
@@ -354,7 +379,7 @@ def test_ha_leader_failover(ha_cluster):
     masters, vs = ha_cluster
     leader = _wait_http_leader(masters)
     vs.start()
-    time.sleep(2.5)
+    leader = _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
     fid = op.upload_data(leader.url, b"pre-failover", filename="a.bin")
 
@@ -385,7 +410,7 @@ def test_ha_file_keys_monotonic_across_failover(ha_cluster):
     masters, vs = ha_cluster
     leader = _wait_http_leader(masters)
     vs.start()
-    time.sleep(2.5)
+    leader = _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
     from seaweedfs_tpu.storage.types import parse_file_id
 
@@ -418,7 +443,7 @@ def test_ha_watch_survives_failover(ha_cluster):
     masters, vs = ha_cluster
     leader = _wait_http_leader(masters)
     vs.start()
-    time.sleep(2.5)
+    leader = _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
     from seaweedfs_tpu.client.vid_map import VidMap
     fid = op.upload_data(leader.url, b"watched-ha", filename="w.bin")
