@@ -211,6 +211,107 @@ class TestParity:
         c.close()
 
 
+class TestCoherenceUnderChurn:
+    def test_no_wrong_bytes_under_writes_deletes_compaction(self, cluster):
+        """The index mirror must never serve another needle's bytes or
+        stale post-compaction offsets. Payloads embed their own fid, so
+        any 200 is self-validating; 404/redirect-404 is legal for
+        deleted fids and windows, wrong bytes never are."""
+        import random
+        import threading
+        master, vs = cluster
+        known = []          # fids whose payload is b"fid:<fid>|" * 40
+        lock = threading.Lock()
+        stop = threading.Event()
+        errors = []
+        writes = [0]
+
+        def payload(fid):
+            return (f"fid:{fid}|".encode()) * 40
+
+        def writer():
+            while not stop.is_set():
+                try:
+                    a = post_json(f"http://{master.url}/dir/assign", {},
+                                  timeout=5)
+                    post_multipart(f"http://{a['url']}/{a['fid']}",
+                                   "c.bin", payload(a["fid"]),
+                                   "application/octet-stream",
+                                   timeout=5)
+                    with lock:
+                        known.append(a["fid"])
+                        writes[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"write: {e}")
+
+        def deleter():
+            while not stop.is_set():
+                time.sleep(0.05)
+                with lock:
+                    if len(known) < 10:
+                        continue
+                    fid = known.pop(random.randrange(len(known) // 2))
+                try:
+                    http_call("DELETE", f"http://{vs.url}/{fid}",
+                              timeout=5)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"delete: {e}")
+
+        def vacuumer():
+            while not stop.is_set():
+                time.sleep(0.7)
+                try:
+                    with lock:
+                        vids = {int(f.split(",")[0]) for f in known}
+                    for vid in vids:
+                        post_json(f"http://{vs.url}/admin/vacuum/"
+                                  f"compact?volume={vid}", {}, timeout=5)
+                        post_json(f"http://{vs.url}/admin/vacuum/"
+                                  f"commit?volume={vid}", {}, timeout=5)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"vacuum: {e}")
+
+        def reader():
+            while not stop.is_set():
+                with lock:
+                    fid = known[random.randrange(len(known))] \
+                        if known else None
+                if fid is None:
+                    time.sleep(0.01)  # don't GIL-starve the writers
+                    continue
+                try:
+                    data, _ = http_get_with_headers(
+                        f"http://{vs.fast_url}/{fid}", timeout=5)
+                    if data != payload(fid):
+                        errors.append(
+                            f"WRONG BYTES for {fid}: got "
+                            f"{data[:40]!r}")
+                        stop.set()
+                except HttpError as e:
+                    if e.status != 404:  # deleted-behind-us is legal
+                        errors.append(f"read {fid}: {e.status}")
+
+        threads = ([threading.Thread(target=writer) for _ in range(2)] +
+                   [threading.Thread(target=deleter),
+                    threading.Thread(target=vacuumer)] +
+                   [threading.Thread(target=reader) for _ in range(3)])
+        for t in threads:
+            t.start()
+        time.sleep(6)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        # a leaked thread would keep mutating errors/known below and
+        # hammer the fixture's stopped cluster during teardown
+        assert all(not t.is_alive() for t in threads), "thread leaked"
+        wrong = [e for e in errors if e.startswith("WRONG")]
+        assert not wrong, wrong
+        # incidental churn errors are tolerated, but not a flood
+        assert len(errors) < 20, errors[:10]
+        assert writes[0] > 50, f"only {writes[0]} writes landed"
+        assert vs.fast_plane.served > 100
+
+
 class TestClusterIntegration:
     def test_lookup_carries_fast_url_and_reads_use_it(self, cluster):
         master, vs = cluster

@@ -316,7 +316,10 @@ def _wait_vs_registered(masters, vs, timeout=20.0, alive=None):
     poll: elections churn under 2-core full-suite load."""
     deadline = time.time() + timeout
     while time.time() < deadline:
-        leader = _wait_http_leader(masters, alive=alive)
+        try:
+            leader = _wait_http_leader(masters, timeout=2.0, alive=alive)
+        except AssertionError:
+            continue   # election still churning; our deadline governs
         if leader.topology.find_node(vs.url) is not None:
             return leader
         time.sleep(0.2)
@@ -363,7 +366,7 @@ def test_ha_multipart_submit_via_follower(ha_cluster):
     """Forwarding must preserve Content-Type or the leader stores the
     raw multipart envelope as file content."""
     masters, vs = ha_cluster
-    leader = _wait_http_leader(masters)
+    _wait_http_leader(masters)
     vs.start()
     leader = _wait_vs_registered(masters, vs)
     follower = next(m for m in masters if m is not leader)
@@ -377,7 +380,7 @@ def test_ha_multipart_submit_via_follower(ha_cluster):
 
 def test_ha_leader_failover(ha_cluster):
     masters, vs = ha_cluster
-    leader = _wait_http_leader(masters)
+    _wait_http_leader(masters)
     vs.start()
     leader = _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
@@ -408,7 +411,7 @@ def test_ha_file_keys_monotonic_across_failover(ha_cluster):
     needle keys across a leader change — a reissued key would collide
     two different files in one volume."""
     masters, vs = ha_cluster
-    leader = _wait_http_leader(masters)
+    _wait_http_leader(masters)
     vs.start()
     leader = _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
@@ -441,7 +444,7 @@ def test_ha_watch_survives_failover(ha_cluster):
     recover routes after the leader dies: the new leader's fresh hub
     forces an epoch reset and the rebuilt registration flows back."""
     masters, vs = ha_cluster
-    leader = _wait_http_leader(masters)
+    _wait_http_leader(masters)
     vs.start()
     leader = _wait_vs_registered(masters, vs)
     from seaweedfs_tpu.client import operation as op
