@@ -109,8 +109,11 @@ class NativeReadPlane:
         server, so the add-then-fill window is safe (windowed misses
         are served by the fallback, never 404'd). The needle map is
         snapshotted under the volume lock — it mutates under writes."""
+        h = self._h
+        if not h:
+            return False
         rc = self._lib.swhp_add_volume(
-            self._h, volume.id, volume.dat_path.encode(), volume.version)
+            h, volume.id, volume.dat_path.encode(), volume.version)
         if rc != 0:
             return False
         import numpy as np
@@ -133,23 +136,33 @@ class NativeReadPlane:
         return True
 
     def unregister_volume(self, vid: int):
-        self._lib.swhp_remove_volume(self._h, vid)
+        h = self._h
+        if h:
+            self._lib.swhp_remove_volume(h, vid)
 
     # -- per-needle mirror -------------------------------------------------
     def put(self, vid: int, key: int, offset: int, size: int):
-        self._lib.swhp_put(self._h, vid, key, offset, size)
+        h = self._h
+        if h:
+            self._lib.swhp_put(h, vid, key, offset, size)
 
     def delete(self, vid: int, key: int):
-        self._lib.swhp_delete(self._h, vid, key)
+        h = self._h
+        if h:
+            self._lib.swhp_delete(h, vid, key)
 
     # -- stats / lifecycle -------------------------------------------------
     @property
     def served(self) -> int:
-        return int(self._lib.swhp_served(self._h))
+        # a scrape/status racing stop() must see 0, not hand the C side
+        # a NULL handle
+        h = self._h
+        return int(self._lib.swhp_served(h)) if h else 0
 
     @property
     def redirected(self) -> int:
-        return int(self._lib.swhp_redirected(self._h))
+        h = self._h
+        return int(self._lib.swhp_redirected(h)) if h else 0
 
     def stop(self):
         if self._h:

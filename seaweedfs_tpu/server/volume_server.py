@@ -373,7 +373,8 @@ class VolumeServer:
         """Prometheus text exposition; volume/disk gauges refresh from
         the store on scrape (the reference sets them during heartbeat
         collection, store.go:232)."""
-        from ..stats.metrics import (VOLUME_COUNT_GAUGE,
+        from ..stats.metrics import (FAST_PLANE_COUNTER,
+                                     VOLUME_COUNT_GAUGE,
                                      VOLUME_DISK_GAUGE,
                                      VOLUME_SERVER_GATHER)
         # aggregate across ALL locations before setting, and zero out
@@ -406,6 +407,10 @@ class VolumeServer:
             VOLUME_DISK_GAUGE.set(0, *stale)
         self._count_series = seen_count
         self._disk_series = seen_disk
+        if self.fast_plane is not None:
+            FAST_PLANE_COUNTER.set_total(self.fast_plane.served, "served")
+            FAST_PLANE_COUNTER.set_total(self.fast_plane.redirected,
+                                         "redirected")
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 

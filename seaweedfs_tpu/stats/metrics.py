@@ -53,6 +53,13 @@ class Counter(_Metric):
             self._values[label_values] = \
                 self._values.get(label_values, 0.0) + amount
 
+    def set_total(self, value: float, *label_values):
+        """Snapshot-mirror a monotonic count maintained elsewhere (the
+        native read plane keeps its own atomics); semantically still a
+        counter — the source only ever increases within a process."""
+        with self._lock:
+            self._values[label_values] = value
+
     def value(self, *label_values) -> float:
         with self._lock:
             return self._values.get(label_values, 0.0)
@@ -188,6 +195,10 @@ VOLUME_DISK_GAUGE = VOLUME_SERVER_GATHER.gauge(
     "SeaweedFS_volumeServer_total_disk_size",
     "Actual disk size used by volumes.",
     labels=("collection", "type"))
+FAST_PLANE_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_fast_plane_request_total",
+    "Requests handled by the native C++ read plane.",
+    labels=("outcome",))
 
 FILER_REQUEST_COUNTER = FILER_GATHER.counter(
     "SeaweedFS_filer_request_total",

@@ -211,6 +211,49 @@ class TestParity:
         c.close()
 
 
+class TestDirectVolume:
+    """Plane driven directly on a Volume (no servers): covers branches
+    a live cluster can't easily reach."""
+
+    def test_ttl_expired_needle_404(self, tmp_path):
+        from seaweedfs_tpu.server.native_plane import NativeReadPlane
+        from seaweedfs_tpu.storage.types import TTL
+        from seaweedfs_tpu.storage.volume import Volume
+        from seaweedfs_tpu.storage.needle import Needle
+        v = Volume(str(tmp_path), "", 9, create=True)
+        live = Needle(cookie=7, id=1, data=b"fresh")
+        live.set_ttl(TTL.parse("1h"))
+        live.set_last_modified()
+        v.write_needle(live)
+        dead = Needle(cookie=7, id=2, data=b"stale")
+        dead.set_ttl(TTL.parse("1m"))
+        dead.set_last_modified(int(time.time()) - 3600)  # an hour old
+        v.write_needle(dead)
+        plane = NativeReadPlane("127.0.0.1", 0, "127.0.0.1:1")
+        try:
+            assert plane.register_volume(v)
+            hp = f"127.0.0.1:{plane.port}"
+            st, _, body = raw_get(hp, "/9,0100000007")
+            assert st == 200 and body == b"fresh"
+            st, _, _ = raw_get(hp, "/9,0200000007")
+            assert st == 404  # expired is authoritative: stored TTL says so
+        finally:
+            plane.stop()
+            v.close()
+
+    def test_metrics_expose_plane_counters(self, cluster):
+        import re
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"counted")
+        before = vs.fast_plane.served
+        raw_get(vs.fast_url, f"/{fid}")
+        body = raw_get(vs.url, "/metrics")[2].decode()
+        m = re.search(r'fast_plane_request_total\{outcome="served"\} '
+                      r'(\d+)', body)
+        assert m, body[-500:]
+        assert int(m.group(1)) >= before + 1
+
+
 class TestCoherenceUnderChurn:
     def test_no_wrong_bytes_under_writes_deletes_compaction(self, cluster):
         """The index mirror must never serve another needle's bytes or
