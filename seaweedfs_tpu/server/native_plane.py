@@ -24,7 +24,11 @@ import threading
 from typing import Optional
 
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
-_LIB_PATH = os.path.join(_LIB_DIR, "libseaweed_http.so")
+# SW_HTTP_PLANE_LIB overrides the library (e.g. an ASAN-instrumented
+# build for the sanitizer test pass)
+_LIB_PATH = os.environ.get(
+    "SW_HTTP_PLANE_LIB",
+    os.path.join(_LIB_DIR, "libseaweed_http.so"))
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -35,6 +39,13 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib or None
+        if "SW_HTTP_PLANE_LIB" in os.environ and \
+                not os.path.exists(_LIB_PATH):
+            # an explicit override must never silently degrade into a
+            # freshly compiled plain build (it usually points at an
+            # instrumented variant)
+            raise FileNotFoundError(
+                f"SW_HTTP_PLANE_LIB={_LIB_PATH} does not exist")
         try:
             if not os.path.exists(_LIB_PATH):
                 # compile only the library (build.sh also builds the

@@ -134,6 +134,9 @@ class VolumeServer:
                     for v in loc.volumes.values():
                         self.fast_plane.register_volume(v)
             except Exception as e:  # noqa: BLE001 - plane is optional
+                import os as _os
+                if "SW_HTTP_PLANE_LIB" in _os.environ:
+                    raise   # explicit lib override must fail loudly
                 from ..util import glog
                 glog.V(0).infof("native read plane unavailable: %s", e)
                 self.fast_plane = None

@@ -254,6 +254,57 @@ class TestDirectVolume:
         assert int(m.group(1)) >= before + 1
 
 
+class TestHostileInput:
+    def test_malformed_requests_never_kill_the_plane(self, cluster):
+        """Garbage, truncation, header floods and pipelining abuse must
+        leave the plane serving; the process must never die."""
+        import random
+        import socket
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"survivor")
+        host, port = vs.fast_url.split(":")
+        rng = random.Random(7)
+
+        probes = [
+            b"",                                   # connect-and-close
+            b"\r\n\r\n",
+            b"GET\r\n\r\n",
+            b"GET / HTTP/1.1\r\n\r\n",
+            b"FROB /x HTTP/1.1\r\n\r\n",
+            b"GET " + b"/" * 8000 + b" HTTP/1.1\r\n\r\n",
+            b"GET /1,0 HTTP/1.1\r\n" + b"X: y\r\n" * 3000 + b"\r\n",
+            b"GET /999999999999999999,00"
+            b"deadbeefcafebabe12345678 HTTP/1.1\r\n\r\n",
+            b"GET /%zz%00%ff,0 HTTP/1.1\r\n\r\n",
+            b"POST /a HTTP/1.1\r\nContent-Length: 99999999\r\n\r\nhi",
+            b"POST /a HTTP/1.1\r\nContent-Length: -5\r\n\r\n",
+            b"GET /1,01234567890 HTTP/1.1\r\nRange: bytes=\xff\xfe\r\n"
+            b"\r\n",
+            bytes(rng.randrange(256) for _ in range(512)),
+            b"GET /" + fid.encode() + b" HTTP/1.0\r\n\r\n",
+            # pipelining: two requests in one segment, then garbage
+            b"GET /" + fid.encode() + b" HTTP/1.1\r\n\r\n"
+            b"GET /" + fid.encode() + b" HTTP/1.1\r\n\r\nxx\x01yy",
+        ]
+        for probe in probes:
+            s = socket.create_connection((host, int(port)), timeout=5)
+            try:
+                s.sendall(probe)
+                s.settimeout(2)
+                try:
+                    while s.recv(4096):
+                        pass
+                except socket.timeout:
+                    pass
+            except OSError:
+                pass   # reset by the server is acceptable
+            finally:
+                s.close()
+        # after all abuse, the plane still serves correct bytes
+        st, _, body = raw_get(vs.fast_url, f"/{fid}")
+        assert st == 200 and body == b"survivor"
+
+
 class TestCoherenceUnderChurn:
     def test_no_wrong_bytes_under_writes_deletes_compaction(self, cluster):
         """The index mirror must never serve another needle's bytes or
