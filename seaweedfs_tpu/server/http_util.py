@@ -32,6 +32,7 @@ class Request:
         self.handler = handler
         parsed = urllib.parse.urlparse(handler.path)
         self.path = parsed.path
+        self.raw_query = parsed.query
         self.query: Dict[str, str] = {
             k: v[0] for k, v in
             urllib.parse.parse_qs(parsed.query, keep_blank_values=True).items()}
@@ -132,6 +133,17 @@ class Request:
 
 
 Route = Tuple[str, str, bool, Callable]
+
+
+def process_memory_stats() -> dict:
+    """Peak RSS of this process (reference statsMemoryHandler).
+    ru_maxrss is kilobytes on Linux but BYTES on macOS/BSD."""
+    import resource
+    import sys
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    kb = ru.ru_maxrss // 1024 if sys.platform == "darwin" \
+        else ru.ru_maxrss
+    return {"maxrss_kb": kb}
 
 
 class Router:

@@ -16,8 +16,8 @@ import time
 from ..storage.types import TTL, ReplicaPlacement
 from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
-from .http_util import (HttpError, HttpServer, Request, Router,
-                        post_json, post_multipart)
+from .http_util import (HttpError, HttpServer, Request, Response,
+                        Router, post_json, post_multipart)
 
 
 class MasterServer:
@@ -45,7 +45,10 @@ class MasterServer:
         router.add("*", "/dir/lookup", self.dir_lookup)
         router.add("*", "/dir/status", self.dir_status)
         router.add("*", "/vol/grow", self.vol_grow)
+        router.add("*", "/vol/status", self.vol_status)
         router.add("*", "/vol/vacuum", self.vol_vacuum)
+        router.add("GET", "/stats/health", self.stats_health)
+        router.add("GET", "/stats/memory", self.stats_memory)
         router.add("*", "/col/delete", self.col_delete)
         router.add("POST", "/submit", self.submit)
         router.add("POST", "/cluster/heartbeat", self.cluster_heartbeat)
@@ -58,6 +61,9 @@ class MasterServer:
         router.add("GET", "/metrics", self.metrics_handler)
         router.add("GET", "/", self.ui_handler)
         router.add("GET", "/ui", self.ui_handler)
+        # GET /<fid> on the master redirects to a holder (reference
+        # master_server.go:125 redirectHandler)
+        router.set_fallback(self.redirect_handler)
         # volume-location push channel (reference KeepConnected,
         # master_grpc_server.go:180-234): heartbeat deltas and node
         # deaths publish here; clients long-poll /cluster/watch
@@ -636,6 +642,68 @@ class MasterServer:
         return {"topology": self.topology.to_dict(),
                 "volumeSizeLimit": self.topology.volume_size_limit,
                 "version": "seaweedfs_tpu 0.1"}
+
+    def vol_status(self, req: Request):
+        """Cluster-wide volume map (reference volumeStatusHandler +
+        Topology.ToVolumeMap, topology_map.go:30)."""
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
+        with self.topology.lock:
+            dcs = {}
+            total_max = 0
+            for dc in self.topology.data_centers.values():
+                racks = {}
+                for rack in dc.racks.values():
+                    racks[rack.id] = {
+                        f"{n.ip}:{n.port}":
+                            [vi.to_dict() for vi in n.volumes.values()]
+                        for n in rack.nodes.values()}
+                    total_max += sum(n.max_volume_count
+                                     for n in rack.nodes.values())
+                dcs[dc.id] = racks
+            used = sum(len(n.volumes)
+                       for n in self.topology.all_nodes())
+        return {"Version": "seaweedfs_tpu 0.1",
+                "Volumes": {"Max": total_max,
+                            "Free": total_max - used,
+                            "DataCenters": dcs}}
+
+    def stats_health(self, req: Request):
+        return {"ok": True, "leader": self.is_leader()}
+
+    def stats_memory(self, req: Request):
+        """Process memory stats (reference statsMemoryHandler)."""
+        from .http_util import process_memory_stats
+        return process_memory_stats()
+
+    def redirect_handler(self, req: Request):
+        """GET /<fid> → 301 to a random holder, query preserved
+        (reference redirectHandler, master_server_handlers_admin.go:101).
+        Only fid-shaped paths redirect; anything else is a 404."""
+        import random as _random
+        from ..storage.types import parse_file_id
+        try:
+            vid, _, _ = parse_file_id(req.path.lstrip("/"))
+        except ValueError:
+            raise HttpError(404, f"no such path {req.path}") from None
+        # followers hold no topology: bounce the client to the leader
+        # with the SAME path (a JSON-proxying _leader_forward would eat
+        # the 301)
+        if not self.is_leader():
+            leader = self.leader_url()
+            if not leader:
+                raise HttpError(503, "no leader")
+            q = ("?" + req.raw_query) if req.raw_query else ""
+            return Response(b"", 301, headers={
+                "Location": f"http://{leader}{req.path}{q}"})
+        locs = self.topology.lookup(req.query.get("collection", ""), vid)
+        if not locs:
+            raise HttpError(404, f"volume {vid} not found")
+        node = _random.choice(locs)
+        q = ("?" + req.raw_query) if getattr(req, "raw_query", "") else ""
+        return Response(b"", 301, headers={
+            "Location": f"http://{node.public_url}{req.path}{q}"})
 
     def cluster_status(self, req: Request):
         fwd = self._leader_forward(req)

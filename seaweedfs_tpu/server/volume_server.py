@@ -71,6 +71,8 @@ class VolumeServer:
         router.add("POST", "/admin/volume/tail_receive",
                    self.admin_volume_tail_receive)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/stats/disk", self.stats_disk)
+        router.add("GET", "/stats/memory", self.stats_memory)
         router.add("GET", "/ui", self.ui_handler)
         router.add("POST", "/query", self.query_handler)
         router.set_fallback(self.data_handler)
@@ -287,6 +289,24 @@ class VolumeServer:
                     self.volume_size_limit = resp["volume_size_limit"]
 
     # -- admin -------------------------------------------------------------
+    def stats_disk(self, req: Request):
+        """Per-directory disk usage (reference statsDiskHandler,
+        volume_server.go:83)."""
+        import shutil
+        out = []
+        for loc in self.store.locations:
+            try:
+                u = shutil.disk_usage(loc.directory)
+                out.append({"dir": loc.directory, "all": u.total,
+                            "used": u.used, "free": u.free})
+            except OSError as e:
+                out.append({"dir": loc.directory, "error": str(e)})
+        return {"DiskStatuses": out}
+
+    def stats_memory(self, req: Request):
+        from .http_util import process_memory_stats
+        return process_memory_stats()
+
     def status(self, req: Request):
         out = self.store.status()
         if self.fast_plane is not None:

@@ -234,3 +234,48 @@ def test_filename_quoting_and_download_sanitization(cluster, tmp_path):
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stderr[-300:]
     assert sorted(p.name for p in outdir.iterdir()) == ["esc.sh"]
+
+
+def test_master_vol_status_stats_and_fid_redirect(tmp_path):
+    """Reference parity: /vol/status volume map, /stats/* probes, and
+    the master's GET /<fid> 301 redirect to a holder
+    (master_server.go:117,121-125)."""
+    from seaweedfs_tpu.server.http_util import (get_json, post_json,
+                                                post_multipart)
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[7], ec_backend="numpy").start()
+    try:
+        a = post_json(f"http://{master.url}/dir/assign", {})
+        post_multipart(f"http://{a['url']}/{a['fid']}", "r.bin",
+                       b"redirect-me", "application/octet-stream")
+        out = get_json(f"http://{master.url}/vol/status")
+        vols = out["Volumes"]
+        assert vols["Max"] == 7
+        nodes = [n for racks in vols["DataCenters"].values()
+                 for dns in racks.values() for n in dns]
+        assert vs.url in nodes
+        assert get_json(f"http://{master.url}/stats/health")["ok"]
+        assert get_json(f"http://{master.url}/stats/memory")[
+            "maxrss_kb"] > 0
+        disk = get_json(f"http://{vs.url}/stats/disk")["DiskStatuses"]
+        assert disk and disk[0]["all"] > 0
+        # fid GET on the master redirects; the pooled client follows it
+        import http.client
+        c = http.client.HTTPConnection(master.url, timeout=10)
+        c.request("GET", f"/{a['fid']}")
+        r = c.getresponse()
+        r.read()
+        assert r.status == 301
+        assert r.getheader("Location").endswith(f"/{a['fid']}")
+        c.close()
+        from seaweedfs_tpu.server.http_util import http_call
+        assert http_call("GET",
+                         f"http://{master.url}/{a['fid']}") == \
+            b"redirect-me"
+    finally:
+        vs.stop()
+        master.stop()

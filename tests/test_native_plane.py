@@ -241,6 +241,52 @@ class TestDirectVolume:
             plane.stop()
             v.close()
 
+    def test_connection_cap_503(self, tmp_path):
+        import http.client
+        from seaweedfs_tpu.server.native_plane import NativeReadPlane
+        from seaweedfs_tpu.storage.volume import Volume
+        from seaweedfs_tpu.storage.needle import Needle
+        v = Volume(str(tmp_path), "", 3, create=True)
+        v.write_needle(Needle(cookie=1, id=1, data=b"capped"))
+        plane = NativeReadPlane("127.0.0.1", 0, "127.0.0.1:1",
+                                max_conns=2)
+        try:
+            plane.register_volume(v)
+            hp = f"127.0.0.1:{plane.port}"
+            held = []
+            for _ in range(2):   # occupy both slots with keep-alives
+                c = http.client.HTTPConnection(hp, timeout=5)
+                c.request("GET", "/3,0100000001")
+                r = c.getresponse()
+                assert r.status == 200 and r.read() == b"capped"
+                held.append(c)
+            deadline = time.time() + 5
+            while True:          # the third connection is turned away
+                c3 = http.client.HTTPConnection(hp, timeout=5)
+                c3.request("GET", "/3,0100000001")
+                st = c3.getresponse().status
+                c3.close()
+                if st == 503 or time.time() > deadline:
+                    break
+                time.sleep(0.1)  # accept-loop may lag the live count
+            assert st == 503
+            for c in held:       # freeing a slot restores service
+                c.close()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                c4 = http.client.HTTPConnection(hp, timeout=5)
+                c4.request("GET", "/3,0100000001")
+                r = c4.getresponse()
+                ok = r.status == 200
+                c4.close()
+                if ok:
+                    break
+                time.sleep(0.1)
+            assert ok
+        finally:
+            plane.stop()
+            v.close()
+
     def test_metrics_expose_plane_counters(self, cluster):
         import re
         master, vs = cluster

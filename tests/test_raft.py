@@ -360,6 +360,12 @@ def test_ha_assign_via_any_master(ha_cluster):
                     raise
                 time.sleep(0.5)
         assert op.read_file(m.url, fid) == b"ha-data-" + m.url.encode()
+        # the master fid-redirect works via ANY master: a follower
+        # bounces to the leader, the leader to a holder (reference
+        # master_server.go:125 + proxyToLeader semantics)
+        from seaweedfs_tpu.server.http_util import http_call
+        assert http_call("GET", f"http://{m.url}/{fid}") == \
+            b"ha-data-" + m.url.encode()
 
 
 def test_ha_multipart_submit_via_follower(ha_cluster):
