@@ -687,6 +687,7 @@ class MasterServer:
             vid, _, _ = parse_file_id(req.path.lstrip("/"))
         except ValueError:
             raise HttpError(404, f"no such path {req.path}") from None
+        q = ("?" + req.raw_query) if req.raw_query else ""
         # followers hold no topology: bounce the client to the leader
         # with the SAME path (a JSON-proxying _leader_forward would eat
         # the 301)
@@ -694,14 +695,12 @@ class MasterServer:
             leader = self.leader_url()
             if not leader:
                 raise HttpError(503, "no leader")
-            q = ("?" + req.raw_query) if req.raw_query else ""
             return Response(b"", 301, headers={
                 "Location": f"http://{leader}{req.path}{q}"})
         locs = self.topology.lookup(req.query.get("collection", ""), vid)
         if not locs:
             raise HttpError(404, f"volume {vid} not found")
         node = _random.choice(locs)
-        q = ("?" + req.raw_query) if getattr(req, "raw_query", "") else ""
         return Response(b"", 301, headers={
             "Location": f"http://{node.public_url}{req.path}{q}"})
 

@@ -262,7 +262,7 @@ struct Request {
   std::string method, target;
   bool keepalive = true;
   bool http10 = false;
-  std::string if_none_match, range;
+  std::string if_none_match, range, if_modified_since;
   int64_t content_length = 0;
   bool chunked = false;
 };
@@ -308,6 +308,8 @@ int read_request(int fd, std::string* acc, Request* out) {
               out->keepalive = true;
           } else if (k == "if-none-match") {
             out->if_none_match = v;
+          } else if (k == "if-modified-since") {
+            out->if_modified_since = v;
           } else if (k == "range") {
             out->range = v;
           } else if (k == "content-length") {
@@ -529,6 +531,31 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
   snprintf(etag, sizeof etag, "%02x%02x%02x%02x", n.checksum >> 24 & 0xFF,
            n.checksum >> 16 & 0xFF, n.checksum >> 8 & 0xFF,
            n.checksum & 0xFF);
+  // Last-Modified + If-Modified-Since, checked before the etag
+  // (reference volume_server_handlers_read.go:99-109)
+  std::string lm_header;
+  if ((n.flags & kFlagHasLastModified) && n.last_modified > 0) {
+    char buf[64];
+    time_t t = static_cast<time_t>(n.last_modified);
+    struct tm tmv;
+    gmtime_r(&t, &tmv);
+    strftime(buf, sizeof buf, "%a, %d %b %Y %H:%M:%S GMT", &tmv);
+    lm_header = buf;
+    if (!req.if_modified_since.empty()) {
+      struct tm ims{};
+      if (strptime(req.if_modified_since.c_str(),
+                   "%a, %d %b %Y %H:%M:%S GMT", &ims) != nullptr) {
+        if (timegm(&ims) >= n.last_modified) {
+          std::string hdr = "Last-Modified: " + lm_header +
+                            "\r\nEtag: \"" + etag + "\"\r\n";
+          respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
+                         "application/octet-stream");
+          s->served++;
+          return;
+        }
+      }
+    }
+  }
   // conditional GET (RFC7232 comma list, weak validators, "*")
   if (!req.if_none_match.empty()) {
     std::string quoted = std::string("\"") + etag + "\"";
@@ -595,6 +622,8 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
   head += "\r\nEtag: \"";
   head += etag;
   head += "\"\r\nAccept-Ranges: bytes\r\n";
+  if (!lm_header.empty())
+    head += "Last-Modified: " + lm_header + "\r\n";
   if (n.flags & kFlagHasName) {
     std::string esc;
     quote_escape(n.name, &esc);

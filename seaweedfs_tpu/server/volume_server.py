@@ -922,7 +922,15 @@ class VolumeServer:
             ctype = guessed or ctype
         if ctype and ctype != "application/octet-stream":
             n.set_mime(ctype.encode())
-        n.set_last_modified()
+        # explicit modified-time override (reference
+        # needle_parse_upload.go:48 FormValue("ts")); the on-disk field
+        # is 5 bytes, so only 0 < ts < 2^40 is honored — anything else
+        # falls back to now, like the reference's ParseUint-error path
+        ts_raw = req.query.get("ts", "")
+        ts_val = int(ts_raw) if ts_raw.isdigit() else 0
+        if not 0 < ts_val < 1 << 40:
+            ts_val = 0
+        n.set_last_modified(ts_val)
         if req.query.get("cm") == "true":
             # payload is a chunk-manifest JSON (reference
             # needle_parse_upload.go: FormValue("cm") sets the flag)
@@ -970,6 +978,8 @@ class VolumeServer:
                 extra_q += "&cm=true"
             if req.query.get("ttl"):
                 extra_q += f"&ttl={req.query['ttl']}"
+            if ts_val:   # forward only the validated integer form
+                extra_q += f"&ts={ts_val}"
             pair_headers = {k: v for k, v in req.headers.items()
                             if k.lower().startswith("seaweed-")} or None
 
@@ -1044,6 +1054,30 @@ class VolumeServer:
             return self._chunk_manifest_response(got, req)
         ctype = got.mime.decode() if got.has_mime() \
             else "application/octet-stream"
+        # Last-Modified + If-Modified-Since (reference
+        # volume_server_handlers_read.go:99-109): checked before the
+        # etag, like the reference
+        lm_header = None
+        if got.has_last_modified() and got.last_modified:
+            from email.utils import formatdate, parsedate_to_datetime
+            lm_header = formatdate(got.last_modified, usegmt=True)
+            ims = req.headers.get("If-Modified-Since") \
+                if req is not None else None
+            if ims:
+                try:
+                    dt = parsedate_to_datetime(ims)
+                    if dt.tzinfo is None:
+                        # '-0000' parses naive; it means UTC (RFC5322),
+                        # not server-local time
+                        from datetime import timezone as _tz
+                        dt = dt.replace(tzinfo=_tz.utc)
+                    t = dt.timestamp()
+                except (TypeError, ValueError):
+                    t = None
+                if t is not None and t >= got.last_modified:
+                    return Response(b"", 304,
+                                    headers={"Last-Modified": lm_header,
+                                             "Etag": f'"{got.etag}"'})
         # conditional GET (reference volume_server_handlers_read.go
         # If-None-Match vs Etag -> 304): immutable needles make etags
         # exact, so a revalidating client pays zero body bytes.
@@ -1059,6 +1093,8 @@ class VolumeServer:
                                     headers={"Etag": f'"{got.etag}"'})
         headers = {"Etag": f'"{got.etag}"',
                    "Accept-Ranges": "bytes"}
+        if lm_header:
+            headers["Last-Modified"] = lm_header
         if got.has_pairs() and got.pairs:
             # stored Seaweed-* pairs come back as response headers
             # (reference volume_server_handlers_read.go SetEtag + pairs)

@@ -279,3 +279,38 @@ def test_master_vol_status_stats_and_fid_redirect(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_upload_ts_override_sets_last_modified(tmp_path):
+    """?ts= on upload overrides the needle's modified time (reference
+    needle_parse_upload.go:48); reads expose it as Last-Modified and
+    honor If-Modified-Since (volume_server_handlers_read.go:99-109)."""
+    import http.client
+    from email.utils import formatdate
+    from seaweedfs_tpu.server.http_util import post_json, post_multipart
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[7], ec_backend="numpy").start()
+    try:
+        ts = 1234567890
+        a = post_json(f"http://{master.url}/dir/assign", {})
+        post_multipart(f"http://{a['url']}/{a['fid']}?ts={ts}", "t.bin",
+                       b"stamped", "application/octet-stream")
+        c = http.client.HTTPConnection(vs.url, timeout=10)
+        c.request("GET", f"/{a['fid']}")
+        r = c.getresponse()
+        assert r.read() == b"stamped"
+        assert r.getheader("Last-Modified") == formatdate(ts, usegmt=True)
+        c.request("GET", f"/{a['fid']}",
+                  headers={"If-Modified-Since":
+                           formatdate(ts, usegmt=True)})
+        r = c.getresponse()
+        r.read()
+        assert r.status == 304
+        c.close()
+    finally:
+        vs.stop()
+        master.stop()

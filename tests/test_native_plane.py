@@ -66,7 +66,7 @@ class TestParity:
         if ps < 400:  # payloads must be identical; error TEXT may differ
             assert pb == fb
             for h in ("content-type", "etag", "content-disposition",
-                      "content-range", "accept-ranges"):
+                      "content-range", "accept-ranges", "last-modified"):
                 assert ph.get(h) == fh.get(h), \
                     f"{h}: {ph.get(h)!r} != {fh.get(h)!r}"
         return fs, fh, fb
@@ -139,6 +139,21 @@ class TestParity:
         assert st == 304 and body == b""
         st, _, _ = self.compare(vs, fid, headers={"If-None-Match": "*"})
         assert st == 304
+
+    def test_if_modified_since_304(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"dated")
+        _, h, _ = raw_get(vs.fast_url, f"/{fid}")
+        lm = h["last-modified"]
+        st, fh, body = self.compare(
+            vs, fid, headers={"If-Modified-Since": lm})
+        assert st == 304 and body == b""
+        # an older stamp does not suppress the body
+        st, _, body = self.compare(
+            vs, fid,
+            headers={"If-Modified-Since":
+                     "Mon, 01 Jan 2001 00:00:00 GMT"})
+        assert st == 200 and body == b"dated"
 
     def test_head(self, cluster):
         master, vs = cluster
