@@ -140,31 +140,36 @@ class Filer:
             dir_path, start_file, inclusive, limit)
 
     def delete_entry(self, full_path: str, recursive: bool = False,
-                     ignore_recursive_error: bool = False) -> None:
-        """Reference filer_delete_entry.go:15-83."""
+                     ignore_recursive_error: bool = False,
+                     delete_chunks: bool = True) -> None:
+        """Reference filer_delete_entry.go:15-83. ``delete_chunks=False``
+        removes metadata only (reference ?skipChunkDeletion — used when
+        the chunks are shared or reclaimed elsewhere)."""
         entry = self.find_entry(full_path)
         if entry.is_directory:
-            self._delete_dir(entry, recursive, ignore_recursive_error)
-        else:
+            self._delete_dir(entry, recursive, ignore_recursive_error,
+                             delete_chunks)
+        elif delete_chunks:
             self.queue_chunk_deletion(entry.chunks)
         self.store.delete_entry(entry.full_path)
         self._uncache_dir(entry.full_path)
-        self._notify(entry, None, delete_chunks=True)
+        self._notify(entry, None, delete_chunks=delete_chunks)
 
     def _delete_dir(self, entry: Entry, recursive: bool,
-                    ignore_error: bool):
+                    ignore_error: bool, delete_chunks: bool = True):
         children = self.list_entries(entry.full_path, limit=1 << 30)
         if children and not recursive:
             raise FilerError(f"{entry.full_path}: folder not empty")
         for child in children:
             try:
                 if child.is_directory:
-                    self._delete_dir(child, recursive, ignore_error)
-                else:
+                    self._delete_dir(child, recursive, ignore_error,
+                                     delete_chunks)
+                elif delete_chunks:
                     self.queue_chunk_deletion(child.chunks)
                 self.store.delete_entry(child.full_path)
                 self._uncache_dir(child.full_path)
-                self._notify(child, None, delete_chunks=True)
+                self._notify(child, None, delete_chunks=delete_chunks)
             except FilerError:
                 if not ignore_error:
                     raise

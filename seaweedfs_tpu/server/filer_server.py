@@ -313,7 +313,16 @@ class FilerServer:
             content_type=ctype or "application/octet-stream",
             cipher=self.cipher, compress=self.compress)
         now = time.time()
-        attr = Attr(mtime=now, crtime=now, mime=ctype,
+        # reference ?mode= (octal file mode, default 0660 —
+        # filer_server_handlers_write.go:156)
+        try:
+            mode = int(req.query.get("mode", "") or "660", 8)
+            # negatives parse in Python (unlike the reference's
+            # ParseUint): treat them as invalid too
+            mode = mode & 0o7777 if mode >= 0 else 0o660
+        except ValueError:
+            mode = 0o660
+        attr = Attr(mtime=now, crtime=now, mime=ctype, mode=mode,
                     collection=collection, replication=replication,
                     ttl_sec=_ttl_seconds(ttl), md5=md5_hex)
         entry = Entry(full_path=path, attr=attr, chunks=chunks)
@@ -407,9 +416,12 @@ class FilerServer:
     def delete_handler(self, req: Request, path: str):
         recursive = req.query.get("recursive", "") == "true"
         ignore_err = req.query.get("ignoreRecursiveError", "") == "true"
+        # reference ?skipChunkDeletion=true: drop metadata only
+        keep_chunks = req.query.get("skipChunkDeletion", "") == "true"
         try:
             self.filer.delete_entry(path, recursive=recursive,
-                                    ignore_recursive_error=ignore_err)
+                                    ignore_recursive_error=ignore_err,
+                                    delete_chunks=not keep_chunks)
         except NotFoundError:
             raise HttpError(404, f"{path} not found") from None
         except FilerError as e:

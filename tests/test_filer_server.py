@@ -388,3 +388,23 @@ def test_ec_read_never_serves_wrong_needle(cluster, tmp_path):
         http_call("GET", f"http://{holder.url}/{vid},100000001")
     assert ei.value.status == 500 and "assembled needle" in str(ei.value)
     ev.locate_needle = real_locate
+
+
+def test_mode_param_and_skip_chunk_deletion(cluster):
+    """Reference parity: ?mode= octal on writes
+    (filer_server_handlers_write.go:156) and ?skipChunkDeletion=true
+    on deletes (metadata-only removal, chunks left alive)."""
+    import time
+    master, vs, fs = cluster
+    http_call("PUT", f"http://{fs.url}/moded.bin?mode=755",
+              body=b"moded-content")
+    entry = fs.filer.find_entry("/moded.bin")
+    assert entry.attr.mode == 0o755
+    fid = entry.chunks[0].fid
+    # delete metadata only; the chunk must still be readable
+    http_call("DELETE", f"http://{fs.url}/moded.bin?skipChunkDeletion=true")
+    with pytest.raises(HttpError):
+        http_call("GET", f"http://{fs.url}/moded.bin")
+    # give the deletion queue a beat: nothing should reap the chunk
+    time.sleep(1.5)
+    assert op.read_file(master.url, fid) == b"moded-content"
