@@ -518,3 +518,37 @@ class TestClusterIntegration:
                 return
             time.sleep(0.2)
         raise AssertionError("volume never appeared in watch snapshot")
+
+
+def test_plane_gated_off_under_read_auth(tmp_path):
+    """The plane speaks open HTTP: an IP whitelist or TLS must disable
+    it (and stop advertising a fastUrl)."""
+    from seaweedfs_tpu.server.http_util import configure_tls, reset_tls
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "w")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[3], ec_backend="numpy",
+                      whitelist=["10.0.0.1"]).start()
+    try:
+        assert vs.fast_plane is None
+        assert vs.fast_url == ""
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_plane_disabled_by_flag(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "x")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[3], ec_backend="numpy",
+                      fast_port=-1).start()
+    try:
+        assert vs.fast_plane is None
+    finally:
+        vs.stop()
+        master.stop()
