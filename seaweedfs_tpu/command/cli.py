@@ -93,6 +93,9 @@ def cmd_volume(args):
                       jwt_signing_key=args.jwtKey,
                       index_kind=args.index,
                       fast_port=args.fastPort,
+                      public_url=args.publicUrl,
+                      read_redirect=args.readRedirect == "true",
+                      file_size_limit_mb=args.fileSizeLimitMB,
                       compaction_mbps=args.compactionMBps,
                       whitelist=[w for w in args.whiteList.split(",")
                                  if w]).start()
@@ -707,6 +710,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="native C++ read plane port (0 = auto-pick, "
                         "-1 = disabled); plain needle GETs are served "
                         "there without the Python GIL")
+    v.add_argument("-publicUrl", default="",
+                   help="publicly accessible address advertised to "
+                        "clients (reference -publicUrl)")
+    v.add_argument("-read.redirect", dest="readRedirect",
+                   default="true", choices=["true", "false"],
+                   help="redirect reads for non-local volumes to a "
+                        "replica (reference -read.redirect)")
+    v.add_argument("-fileSizeLimitMB", type=int, default=256,
+                   help="reject uploads above this size, 0 = no limit "
+                        "(reference -fileSizeLimitMB)")
     v.add_argument("-compactionMBps", type=int, default=0,
                    help="throttle vacuum/compaction writes (MB/s, "
                         "0 = unthrottled; reference compactionMBps)")

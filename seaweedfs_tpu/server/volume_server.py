@@ -35,7 +35,8 @@ class VolumeServer:
                  public_url: str = "", read_redirect: bool = True,
                  ec_backend: str = "auto", jwt_signing_key: str = "",
                  whitelist=(), index_kind: str = "memory",
-                 compaction_mbps: int = 0, fast_port: int = 0):
+                 compaction_mbps: int = 0, fast_port: int = 0,
+                 file_size_limit_mb: int = 256):
         router = Router()
         router.add("*", "/status", self.status)
         router.add("POST", "/admin/assign_volume", self.admin_assign_volume)
@@ -107,6 +108,9 @@ class VolumeServer:
             data_center=data_center, rack=rack, codec=codec,
             index_kind=index_kind)
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        # upload size cap (reference -fileSizeLimitMB: "limit file size
+        # to avoid out of memory"); 0 (or negative) disables
+        self.file_size_limit = max(0, int(file_size_limit_mb)) << 20
         # compaction write throttle (reference -compactionMBps)
         self.compaction_bps = int(compaction_mbps) << 20
         self.jwt_signing_key = jwt_signing_key
@@ -908,7 +912,19 @@ class VolumeServer:
             raise HttpError(401, f"jwt rejected: {e}") from None
 
     def write_needle(self, req: Request, vid, key, cookie):
+        # reject oversized uploads BEFORE buffering the body (reference
+        # -fileSizeLimitMB); the multipart envelope adds a little, so
+        # this is a coarse pre-filter and the post-parse check is exact
+        if self.file_size_limit:
+            try:
+                clen = int(req.headers.get("Content-Length") or 0)
+            except ValueError:
+                clen = 0
+            if clen > self.file_size_limit + 65536:
+                raise HttpError(413, "file over the size limit")
         filename, ctype, data = req.upload_payload()
+        if self.file_size_limit and len(data) > self.file_size_limit:
+            raise HttpError(413, "file over the size limit")
         n = Needle(cookie=cookie, id=key, data=data)
         if filename:
             n.set_name(filename.encode())

@@ -314,3 +314,37 @@ def test_upload_ts_override_sets_last_modified(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_file_size_limit_413(tmp_path):
+    """Uploads over -fileSizeLimitMB are rejected with 413 (reference
+    -fileSizeLimitMB, command/volume.go:74) — both via the coarse
+    Content-Length pre-filter and the exact post-parse check."""
+    from seaweedfs_tpu.server.http_util import (HttpError, post_json,
+                                                post_multipart)
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[7], ec_backend="numpy",
+                      file_size_limit_mb=1).start()
+    try:
+        a = post_json(f"http://{master.url}/dir/assign", {})
+        with pytest.raises(HttpError) as ei:  # Content-Length pre-filter
+            post_multipart(f"http://{a['url']}/{a['fid']}", "big.bin",
+                           b"x" * (2 << 20), "application/octet-stream")
+        assert ei.value.status == 413
+        # between the limit and the pre-filter's +64KB envelope slack:
+        # only the exact post-parse check can reject this one
+        with pytest.raises(HttpError) as ei:
+            post_multipart(f"http://{a['url']}/{a['fid']}", "mid.bin",
+                           b"x" * ((1 << 20) + 1024),
+                           "application/octet-stream")
+        assert ei.value.status == 413
+        # under the limit still lands
+        post_multipart(f"http://{a['url']}/{a['fid']}", "ok.bin",
+                       b"y" * 1024, "application/octet-stream")
+    finally:
+        vs.stop()
+        master.stop()
