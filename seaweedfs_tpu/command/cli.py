@@ -63,7 +63,11 @@ def cmd_master(args):
                      maintenance_scripts=args.maintenanceScripts,
                      maintenance_interval=args.maintenanceIntervalSeconds,
                      vacuum_interval=args.vacuumIntervalSeconds,
-                     garbage_threshold=args.garbageThreshold).start()
+                     garbage_threshold=args.garbageThreshold,
+                     whitelist=[w for w in args.whiteList.split(",")
+                                if w],
+                     metrics_address=args.metricsAddress,
+                     metrics_interval=args.metricsInterval).start()
     print(f"master listening on {m.url}")
     _wait(m)
 
@@ -688,6 +692,16 @@ def build_parser() -> argparse.ArgumentParser:
                         'e.g. "volume.vacuum; ec.rebuild"')
     m.add_argument("-maintenanceIntervalSeconds", type=float,
                    default=17 * 60)
+    m.add_argument("-whiteList", default="",
+                   help="comma-separated IPs/CIDRs allowed on the "
+                        "user-facing API (reference -whiteList). "
+                        "Include your volume servers/filers/gateways: "
+                        "only heartbeat/goodbye/raft stay open")
+    m.add_argument("-metrics.address", dest="metricsAddress", default="",
+                   help="Prometheus push-gateway address broadcast to "
+                        "volume servers (reference -metrics.address)")
+    m.add_argument("-metrics.intervalSeconds", dest="metricsInterval",
+                   type=int, default=15)
     m.add_argument("-vacuumIntervalSeconds", type=float, default=15 * 60,
                    help="automatic vacuum + TTL-expiry sweep on the "
                         "leader (0 disables; reference "

@@ -30,7 +30,9 @@ class MasterServer:
                  peers: str = "", raft_dir: str = "",
                  maintenance_scripts: str = "",
                  maintenance_interval: float = 17 * 60,
-                 vacuum_interval: float = 15 * 60):
+                 vacuum_interval: float = 15 * 60,
+                 whitelist=(), metrics_address: str = "",
+                 metrics_interval: int = 15):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -64,6 +66,17 @@ class MasterServer:
         # GET /<fid> on the master redirects to a holder (reference
         # master_server.go:125 redirectHandler)
         router.set_fallback(self.redirect_handler)
+        # ip whitelist on the user-facing surface (reference
+        # guard.WhiteList wrapping of master_server.go:112-123); the
+        # cluster-internal channels stay open — volume servers and raft
+        # peers are not client traffic
+        from ..security.guard import Guard
+        self.guard = Guard(whitelist)
+        router.before = self._guard_check
+        # metrics push config broadcast to volume servers via heartbeat
+        # responses (reference master_grpc_server.go:75-77)
+        self.metrics_address = metrics_address
+        self.metrics_interval = int(metrics_interval)
         # volume-location push channel (reference KeepConnected,
         # master_grpc_server.go:180-234): heartbeat deltas and node
         # deaths publish here; clients long-poll /cluster/watch
@@ -417,8 +430,14 @@ class MasterServer:
                 ec_collections=ec_collections,
                 max_file_key=int(hb.get("max_file_key", 0)),
             )
-        return {"volume_size_limit": self.topology.volume_size_limit,
-                "leader": self.leader_url() or self.url}
+        out = {"volume_size_limit": self.topology.volume_size_limit,
+               "leader": self.leader_url() or self.url}
+        if self.metrics_address:
+            # reference master_grpc_server.go:75-77: the master decides
+            # where and how often servers push metrics
+            out["metrics_address"] = self.metrics_address
+            out["metrics_interval_seconds"] = self.metrics_interval
+        return out
 
     def cluster_goodbye(self, req: Request):
         """Clean volume-server shutdown: unregister immediately and push
@@ -642,6 +661,20 @@ class MasterServer:
         return {"topology": self.topology.to_dict(),
                 "volumeSizeLimit": self.topology.volume_size_limit,
                 "version": "seaweedfs_tpu 0.1"}
+
+    def _guard_check(self, req: Request):
+        if not self.guard.enabled:
+            return
+        p = req.path
+        # only genuinely server-to-server channels are exempt; watch/
+        # volumes/status/ec_lookup serve the same data as the guarded
+        # lookups, so cluster nodes (volume servers, filers, gateways)
+        # must be included in -whiteList like any other HTTP client
+        if p in ("/cluster/heartbeat", "/cluster/goodbye", "/metrics") \
+                or p.startswith("/raft/"):
+            return
+        if not self.guard.allows(req.handler.client_address[0]):
+            raise HttpError(403, "ip not in whitelist")
 
     def vol_status(self, req: Request):
         """Cluster-wide volume map (reference volumeStatusHandler +

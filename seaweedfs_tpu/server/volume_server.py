@@ -177,6 +177,9 @@ class VolumeServer:
             pass
         if self.fast_plane is not None:
             self.fast_plane.stop()
+        push = getattr(self, "_metrics_push", None)
+        if push is not None:
+            push.stop_event.set()
         self.server.stop()
         self.store.close()
 
@@ -282,6 +285,7 @@ class VolumeServer:
             raise last
         if resp.get("volume_size_limit"):
             self.volume_size_limit = resp["volume_size_limit"]
+        self._maybe_start_metrics_push(resp)
         # follow the leader hint: a follower master does not register
         # us, so re-send the heartbeat there right away
         leader = resp.get("leader")
@@ -291,6 +295,23 @@ class VolumeServer:
                 resp = self._post_heartbeat(hb, self.master_url)
                 if resp.get("volume_size_limit"):
                     self.volume_size_limit = resp["volume_size_limit"]
+
+    def _maybe_start_metrics_push(self, resp: dict):
+        """The master broadcasts the push-gateway address and interval
+        in heartbeat responses (reference LoopPushingMetric,
+        metrics.go:109-137 + master_grpc_server.go:75-77); start one
+        push loop when it first appears."""
+        addr = resp.get("metrics_address")
+        if not addr or getattr(self, "_metrics_push", None) is not None:
+            return
+        from ..stats.metrics import VOLUME_SERVER_GATHER, start_push_loop
+        if "://" not in addr:   # the master broadcasts a bare host:port
+            addr = "http://" + addr
+        self._metrics_push = start_push_loop(
+            VOLUME_SERVER_GATHER, addr,
+            job=f"volume_{self.host}_{self.port}",
+            interval_s=max(1.0, float(
+                resp.get("metrics_interval_seconds", 15) or 15)))
 
     # -- admin -------------------------------------------------------------
     def stats_disk(self, req: Request):

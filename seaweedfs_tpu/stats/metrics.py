@@ -233,7 +233,9 @@ def start_push_loop(registry: Registry, gateway_url: str,
                     f"{gateway_url.rstrip('/')}/metrics/job/{job}",
                     registry.render().encode(),
                     {"Content-Type": "text/plain"}, external=True)
-            except HttpError:
+            except Exception:  # noqa: BLE001 - a flaky gateway (bad
+                # status line, reset, DNS) must never kill the loop:
+                # nothing would ever restart it
                 pass
 
     t = threading.Thread(target=loop, daemon=True)

@@ -269,3 +269,51 @@ def test_server_stop_severs_keepalive_without_fd_close_race():
     while _time.time() < deadline and srv.httpd._client_socks:
         _time.sleep(0.05)
     assert not srv.httpd._client_socks
+
+
+def test_master_whitelist_and_metrics_broadcast(tmp_path):
+    """Master -whiteList guards the user-facing API but not cluster
+    channels (reference guard.WhiteList on master_server.go:112-123);
+    -metrics.address rides heartbeat responses and starts the volume
+    server's push loop (reference master_grpc_server.go:75-77 +
+    LoopPushingMetric)."""
+    import threading
+    import pytest
+    from seaweedfs_tpu.server.http_util import (HttpError, HttpServer,
+                                                Router, get_json)
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+
+    # a tiny in-process push-gateway
+    pushes = []
+    got_push = threading.Event()
+    router = Router()
+
+    def catch(req):
+        pushes.append(req.path)
+        got_push.set()
+        return {}
+    router.set_fallback(catch)
+    gw = HttpServer(0, router, "127.0.0.1").start()
+
+    master = MasterServer(port=0, pulse_seconds=1,
+                          whitelist=["10.9.9.9"],   # excludes 127.0.0.1
+                          metrics_address=f"127.0.0.1:{gw.port}",
+                          metrics_interval=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[3], ec_backend="numpy").start()
+    try:
+        # user-facing API is refused for non-whitelisted clients...
+        with pytest.raises(HttpError) as ei:
+            get_json(f"http://{master.url}/dir/assign")
+        assert ei.value.status == 403
+        # ...but the heartbeat channel stayed open (the vs registered)
+        assert master.topology.find_node(vs.url) is not None
+        # and the metrics push loop fired against the gateway
+        assert got_push.wait(10), "no metrics push arrived"
+        assert any("volume_" in p for p in pushes)
+    finally:
+        vs.stop()
+        master.stop()
+        gw.stop()
