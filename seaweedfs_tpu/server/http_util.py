@@ -600,8 +600,13 @@ def _pooled_call(method: str, url: str, body, headers: dict,
             conn.close()
         else:
             _pool_put(scheme, netloc, conn)
+        # 307/308 preserve method+body by definition — the native write
+        # plane answers off-fast-path POSTs this way (redirect to the
+        # owning Python server); other 3xx follow only for GET/HEAD
+        follow = method in ("GET", "HEAD") or \
+            (resp.status in (307, 308) and replayable)
         if 300 <= resp.status < 400 and resp.getheader("Location") \
-                and method in ("GET", "HEAD") and max_redirects > 0:
+                and follow and max_redirects > 0:
             loc = urllib.parse.urljoin(url, resp.getheader("Location"))
             # redirect targets are emitted as plain http (volume read
             # redirects) — re-apply the cluster TLS scheme rewrite

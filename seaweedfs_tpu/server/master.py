@@ -502,6 +502,11 @@ class MasterServer:
         fid, cnt, node, _ = picked
         out = {"fid": fid, "url": node.url,
                "publicUrl": node.public_url, "count": cnt}
+        if node.fast_url:
+            # the holder's native data plane: plain uploads land there
+            # without the Python server in the loop (off-fast-path
+            # shapes bounce back via 307, which clients follow)
+            out["fastUrl"] = node.fast_url
         if self.jwt_signing_key:
             # hand out a write token bound to this fid (reference
             # master_server_handlers.go + security/jwt.go GenJwt)

@@ -1,7 +1,8 @@
 #!/bin/sh
-# Build the native volume-server read plane (thread-per-connection HTTP
-# server serving needle reads without the Python GIL in the loop) and
-# the keep-alive load generator used to measure it.
+# Build the native volume-server data plane (thread-per-connection HTTP
+# server serving needle reads AND plain needle writes without the
+# Python GIL in the loop) and the keep-alive load generator used to
+# measure it (GET mode + multipart POST mode).
 set -e
 cd "$(dirname "$0")"
 g++ -O2 -std=c++17 -fPIC -shared -pthread -o libseaweed_http.so http_plane.cc

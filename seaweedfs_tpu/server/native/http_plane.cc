@@ -1,21 +1,36 @@
-// Native volume-server read plane.
+// Native volume-server data plane (reads + plain writes).
 //
 // The reference's data plane is Go: goroutine-per-connection HTTP serving
 // needle reads straight off the volume files (reference
-// weed/server/volume_server_handlers_read.go). The Python server keeps
-// full semantics but is GIL-bound (~2.7k reads/s/process); this library
-// is the native equivalent of the reference's hot read loop: a
-// thread-per-connection keep-alive HTTP/1.1 server that parses
-// `GET /<vid>,<fid>`, looks the needle up in an in-process index mirror
-// (synced from Python over ctypes), preads the needle blob, validates
-// cookie/CRC/TTL, and answers — no Python in the loop.
+// weed/server/volume_server_handlers_read.go) and plain needle writes
+// appended under a per-volume lock (volume_server_handlers_write.go:18,
+// topology/store_replicate.go:20-83). The Python server keeps full
+// semantics but is GIL-bound (~2.7k reads/s, ~0.9k writes/s per
+// process); this library is the native equivalent of the reference's hot
+// loops: a thread-per-connection keep-alive HTTP/1.1 server that parses
+// `GET|POST /<vid>,<fid>`, serves reads from an in-process index mirror
+// (synced from Python over ctypes), and — for volumes Python has handed
+// the write lease to — parses multipart uploads, builds the needle
+// record, appends .dat + .idx under a per-volume mutex, and updates the
+// mirror, all without Python in the loop.
+//
+// WRITE OWNERSHIP. While a volume's writer is enabled, this library is
+// the SINGLE writer of that volume's .dat and .idx tails: Python's own
+// write/delete paths delegate their appends through swhp_append (the
+// same mutex), and structural operations (compaction commit, copy,
+// tail-receive) first disable the writer — a mutex-barrier handback —
+// then reload their needle map from the .idx this library kept
+// authoritative. The index mirror is therefore exact (not best-effort)
+// in writer mode, and Python consults it as the source of truth.
 //
 // Scope is the FAST PATH only. Anything with semantics beyond a plain
 // stored needle — gzip-stored payloads, chunk manifests, Seaweed-* pair
-// headers, image resize queries, EC volumes, remote volumes — is answered
-// with a 307 redirect to the Python server (`fallback`), which remains
-// the source of truth. Correctness parity for the served cases is pinned
-// by tests/test_native_plane.py against the Python responses.
+// headers, image resize queries, EC volumes, remote volumes, query
+// params (?ttl, ?cm, ?ts, replication hops), JWT-guarded or replicated
+// writes — is answered with a 307 redirect to the Python server
+// (`fallback`), which remains the source of truth. Correctness parity
+// for the served cases is pinned by tests/test_native_plane.py and
+// tests/test_native_write_plane.py against the Python responses.
 //
 // Needle layout parsed here == storage/needle.py (byte-compatible with
 // reference weed/storage/needle/needle_read_write.go):
@@ -34,6 +49,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -196,13 +212,46 @@ int parse_needle(const uint8_t* blob, size_t len, int version,
 }
 
 // ---------------------------------------------------------------- server
+// Write lease for one volume: fds + append offset + counter deltas.
+// While enabled, every .dat/.idx append (fast-path POSTs AND Python's
+// delegated writes via swhp_append) serializes on `mu`; disabling takes
+// `mu`, so after swhp_disable_writer returns no append is in flight.
+struct Writer {
+  int fd = -1;      // O_RDWR on the .dat (appends via pwrite at tail)
+  int idx_fd = -1;  // O_APPEND on the .idx
+  std::mutex mu;
+  std::atomic<bool> accept_posts{false};  // fast-path POSTs allowed
+  // tail is written under mu; atomic so counter reads stay lock-free
+  std::atomic<int64_t> tail{0};
+  int64_t idx_tail = 0;     // .idx size (for torn-entry truncation)
+  int offset_width = 4;     // 4 (32GB) or 5 (8TB) — .idx record width
+  int64_t max_size = 0;     // addressing ceiling for this offset width
+  int64_t file_size_limit = 0;  // per-upload data cap (0 = unlimited)
+  // counter deltas since enable, mirroring NeedleMap._apply
+  // (storage/needle_map.py:85): Python adds these to its (frozen)
+  // needle-map counters for heartbeats while the lease is out
+  std::atomic<uint64_t> puts{0}, put_bytes{0};
+  std::atomic<uint64_t> deletes{0}, deleted_bytes{0};
+  std::atomic<uint64_t> max_key{0};
+  ~Writer() {
+    if (fd >= 0) close(fd);
+    if (idx_fd >= 0) close(idx_fd);
+  }
+};
+
 struct VolumeRec {
   int fd = -1;
   int version = 3;
+  std::string dat_path;
   std::unordered_map<uint64_t, std::pair<uint64_t, uint32_t>> index;
+  std::shared_ptr<Writer> writer;  // guarded by mu (shared: read lock)
   mutable std::shared_mutex mu;
   ~VolumeRec() {
     if (fd >= 0) close(fd);
+  }
+  std::shared_ptr<Writer> get_writer() const {
+    std::shared_lock<std::shared_mutex> l(mu);
+    return writer;
   }
 };
 
@@ -212,6 +261,7 @@ struct Server {
   std::string fallback;  // host:port of the Python server
   std::atomic<bool> stop{false};
   std::atomic<uint64_t> served{0}, redirected{0}, errors{0};
+  std::atomic<uint64_t> written{0};  // fast-path POSTs appended here
   std::atomic<int> live{0};
   int max_conns = 1024;
   int64_t max_fastpath_bytes = 64ll << 20;
@@ -263,8 +313,10 @@ struct Request {
   bool keepalive = true;
   bool http10 = false;
   std::string if_none_match, range, if_modified_since;
+  std::string content_type;
   int64_t content_length = 0;
   bool chunked = false;
+  bool has_pair_headers = false;  // any Seaweed-* header present
 };
 
 // Reads one request off the socket (blocking). Returns 1 ok, 0 clean EOF,
@@ -299,10 +351,12 @@ int read_request(int fd, std::string* acc, Request* out) {
           size_t vs = colon + 1;
           while (vs < le && head[vs] == ' ') vs++;
           std::string v = head.substr(vs, le - vs);
-          for (auto& c : k) c = static_cast<char>(tolower(c));
+          for (auto& c : k)
+            c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
           if (k == "connection") {
             std::string lv = v;
-            for (auto& c : lv) c = static_cast<char>(tolower(c));
+            for (auto& c : lv)
+              c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
             if (lv.find("close") != std::string::npos) out->keepalive = false;
             if (out->http10 && lv.find("keep-alive") != std::string::npos)
               out->keepalive = true;
@@ -312,13 +366,25 @@ int read_request(int fd, std::string* acc, Request* out) {
             out->if_modified_since = v;
           } else if (k == "range") {
             out->range = v;
+          } else if (k == "content-type") {
+            out->content_type = v;
           } else if (k == "content-length") {
+            // trim trailing whitespace, then demand a clean parse: a
+            // value like "+10" or "12 x" makes framing unknowable, so
+            // treat the body as unreadable and sever after responding
+            while (!v.empty() && (v.back() == ' ' || v.back() == '\t'))
+              v.pop_back();
             char* end = nullptr;
             out->content_length = strtoll(v.c_str(), &end, 10);
-            if (out->content_length < 0 || (end && *end != '\0'))
+            if (v.empty() || out->content_length < 0 ||
+                (end && *end != '\0')) {
               out->content_length = 0;
+              out->keepalive = false;
+            }
           } else if (k == "transfer-encoding") {
             out->chunked = true;  // no body framing here: close after
+          } else if (k.compare(0, 8, "seaweed-") == 0) {
+            out->has_pair_headers = true;
           }
         }
         ls = le + 2;
@@ -363,8 +429,9 @@ std::string unescape(const std::string& in) {
   std::string out;
   out.reserve(in.size());
   for (size_t i = 0; i < in.size(); i++) {
-    if (in[i] == '%' && i + 2 < in.size() && isxdigit(in[i + 1]) &&
-        isxdigit(in[i + 2])) {
+    if (in[i] == '%' && i + 2 < in.size() &&
+        isxdigit(static_cast<unsigned char>(in[i + 1])) &&
+        isxdigit(static_cast<unsigned char>(in[i + 2]))) {
       out.push_back(static_cast<char>(
           strtol(in.substr(i + 1, 2).c_str(), nullptr, 16)));
       i += 2;
@@ -375,8 +442,18 @@ std::string unescape(const std::string& in) {
   return out;
 }
 
-// Parse "/<vid>,<keyhex><cookie8>" (also '/' separator). Returns false if
-// the target is not a plain fid path (query string, extension, etc).
+bool all_digits(const std::string& s) {
+  if (s.empty()) return false;
+  for (char c : s)
+    if (!isdigit(static_cast<unsigned char>(c))) return false;
+  return true;
+}
+
+// Parse "/<vid>,<keyhex><cookie8>[_<n>]" (also '/' separator). The _n
+// suffix is the batch-assign convention (reference common.go parses
+// "fid_i" as key+i for ?count= assigns; storage/types.py mirrors it).
+// Returns false if the target is not a plain fid path (query string,
+// extension, etc).
 bool parse_fid_path(const std::string& target, uint32_t* vid, uint64_t* key,
                     uint32_t* cookie) {
   if (target.empty() || target[0] != '/') return false;
@@ -387,16 +464,24 @@ bool parse_fid_path(const std::string& target, uint32_t* vid, uint64_t* key,
   if (sep == std::string::npos || sep == 0) return false;
   uint64_t v = 0;
   for (size_t i = 0; i < sep; i++) {
-    if (!isdigit(p[i])) return false;
+    if (!isdigit(static_cast<unsigned char>(p[i]))) return false;
     v = v * 10 + static_cast<uint64_t>(p[i] - '0');
     if (v > 0xFFFFFFFFull) return false;
   }
   std::string kh = p.substr(sep + 1);
+  uint64_t delta = 0;
+  size_t us = kh.find('_');
+  if (us != std::string::npos) {
+    std::string d = kh.substr(us + 1);
+    if (!all_digits(d) || d.size() > 18) return false;
+    delta = strtoull(d.c_str(), nullptr, 10);
+    kh = kh.substr(0, us);
+  }
   // mirror storage/types.py parse_key_hash: 8 < len <= 24, last 8 hex
   // chars are the cookie
   if (kh.size() <= 8 || kh.size() > 24) return false;
   for (char c : kh)
-    if (!isxdigit(c)) return false;
+    if (!isxdigit(static_cast<unsigned char>(c))) return false;
   if (kh.size() % 2) kh = "0" + kh;
   uint64_t k = 0;
   for (size_t i = 0; i + 8 < kh.size(); i++)
@@ -405,20 +490,13 @@ bool parse_fid_path(const std::string& target, uint32_t* vid, uint64_t* key,
   uint32_t ck = static_cast<uint32_t>(
       strtoul(kh.substr(kh.size() - 8).c_str(), nullptr, 16));
   *vid = static_cast<uint32_t>(v);
-  *key = k;
+  *key = k + delta;
   *cookie = ck;
   return true;
 }
 
 // Single-range parse: "bytes=a-b" / "bytes=a-" / "bytes=-n" (mirrors
 // server/http_util.parse_range; multi-range -> not handled -> full body)
-bool all_digits(const std::string& s) {
-  if (s.empty()) return false;
-  for (char c : s)
-    if (!isdigit(static_cast<unsigned char>(c))) return false;
-  return true;
-}
-
 bool parse_range_header(const std::string& r, int64_t total, int64_t* start,
                         int64_t* length) {
   if (r.compare(0, 6, "bytes=") != 0) return false;
@@ -643,6 +721,404 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
   s->served++;
 }
 
+// ----------------------------------------------------------------- write
+bool pwrite_all(int fd, const uint8_t* buf, size_t n, int64_t off) {
+  while (n > 0) {
+    ssize_t w = pwrite(fd, buf, n, static_cast<off_t>(off));
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    buf += w;
+    off += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool write_all_fd(int fd, const uint8_t* buf, size_t n) {
+  while (n > 0) {
+    ssize_t w = write(fd, buf, n);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    buf += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+void be32_store(uint8_t* p, uint32_t v) {
+  p[0] = static_cast<uint8_t>(v >> 24);
+  p[1] = static_cast<uint8_t>(v >> 16);
+  p[2] = static_cast<uint8_t>(v >> 8);
+  p[3] = static_cast<uint8_t>(v);
+}
+
+void be64_store(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++)
+    p[i] = static_cast<uint8_t>(v >> (8 * (7 - i)));
+}
+
+// The one append primitive: .dat record + .idx entry + mirror + counter
+// deltas, atomically under the writer mutex. size_field==kTombstoneSize
+// marks a delete (blob is the tombstone record; the .idx entry gets
+// offset 0 + tombstone size, mirroring NeedleMap.delete).
+// check_cookie: re-verify the overwrite/delete cookie against the
+// STORED needle under the mutex — the caller's pre-check raced with
+// other appends (Python's write_needle holds volume.lock across
+// check+append; the mutex is this plane's equivalent).
+// Returns the append offset, or -1 writer gone, -2 addressing ceiling,
+// -3 I/O error (tails truncated back; an untruncatable torn .idx
+// fail-stops the writer rather than misalign every later record),
+// -4 cookie mismatch.
+int64_t do_append(VolumeRec* vol, Writer* w, const uint8_t* blob,
+                  int64_t len, uint64_t key, uint32_t size_field,
+                  bool check_cookie, uint32_t cookie) {
+  std::lock_guard<std::mutex> g(w->mu);
+  if (w->fd < 0) return -1;
+  int64_t tail = w->tail.load(std::memory_order_relaxed);
+  if (tail + len > w->max_size) return -2;
+  if (check_cookie) {
+    uint64_t old_off = 0;
+    bool have_old = false;
+    {
+      std::shared_lock<std::shared_mutex> l(vol->mu);
+      auto it = vol->index.find(key);
+      if (it != vol->index.end() && it->second.first != 0 &&
+          it->second.second != kTombstoneSize) {
+        old_off = it->second.first;
+        have_old = true;
+      }
+    }
+    if (have_old) {
+      uint8_t hdr[4];
+      if (pread(vol->fd, hdr, 4, static_cast<off_t>(old_off)) == 4 &&
+          be32(hdr) != cookie)
+        return -4;
+    }
+  }
+  if (!pwrite_all(w->fd, blob, static_cast<size_t>(len), tail)) {
+    int e1 = ftruncate(w->fd, static_cast<off_t>(tail));
+    (void)e1;
+    return -3;
+  }
+  uint8_t e[17];
+  int ew = 8 + w->offset_width + 4;
+  be64_store(e, key);
+  uint64_t stored = size_field == kTombstoneSize
+                        ? 0
+                        : static_cast<uint64_t>(tail) / 8;
+  for (int i = 0; i < w->offset_width; i++)
+    e[8 + i] = static_cast<uint8_t>(stored >> (8 * (w->offset_width - 1 - i)));
+  be32_store(e + 8 + w->offset_width, size_field);
+  if (!write_all_fd(w->idx_fd, e, static_cast<size_t>(ew))) {
+    // a PARTIAL idx entry would misalign every later record: truncate
+    // it back; if even that fails, fail-stop this writer (Python's
+    // next lease cycle resumes from the consistent prefix)
+    int e2 = ftruncate(w->fd, static_cast<off_t>(tail));
+    (void)e2;
+    if (ftruncate(w->idx_fd, static_cast<off_t>(w->idx_tail)) != 0) {
+      w->accept_posts.store(false, std::memory_order_release);
+      close(w->fd);
+      close(w->idx_fd);
+      w->fd = w->idx_fd = -1;
+    }
+    return -3;
+  }
+  w->idx_tail += ew;
+  int64_t off = tail;
+  w->tail.store(tail + len, std::memory_order_relaxed);
+  {
+    std::unique_lock<std::shared_mutex> l(vol->mu);
+    auto it = vol->index.find(key);
+    bool had = it != vol->index.end();
+    uint32_t old_size = had ? it->second.second : 0;
+    if (size_field == kTombstoneSize) {
+      if (had) {
+        vol->index.erase(it);
+        w->deletes++;
+        w->deleted_bytes += old_size;
+      }
+    } else {
+      vol->index[key] = {static_cast<uint64_t>(off), size_field};
+      w->puts++;
+      w->put_bytes += size_field;
+      if (had) {  // overwrite: old record becomes garbage
+        w->deletes++;
+        w->deleted_bytes += old_size;
+      }
+    }
+    uint64_t mk = w->max_key.load(std::memory_order_relaxed);
+    while (key > mk &&
+           !w->max_key.compare_exchange_weak(mk, key)) {
+    }
+  }
+  return off;
+}
+
+// First file part of a multipart/form-data body, mirroring
+// http_util.Request.multipart_file: boundary split, one CRLF stripped
+// per side, filename= part wins. Returns false when no file part.
+bool parse_multipart(const std::string& ctype, const std::string& body,
+                     std::string* filename, std::string* part_ctype,
+                     const char** data, size_t* data_len) {
+  if (ctype.compare(0, 19, "multipart/form-data") != 0) return false;
+  size_t bpos = ctype.find("boundary=");
+  if (bpos == std::string::npos) return false;
+  std::string boundary = ctype.substr(bpos + 9);
+  size_t send = boundary.find(';');
+  if (send != std::string::npos) boundary = boundary.substr(0, send);
+  if (!boundary.empty() && boundary.front() == '"') {
+    size_t endq = boundary.find('"', 1);
+    if (endq == std::string::npos) return false;
+    boundary = boundary.substr(1, endq - 1);
+  }
+  if (boundary.empty()) return false;
+  std::string delim = "--" + boundary;
+  size_t pos = 0;
+  while (pos != std::string::npos && pos < body.size()) {
+    size_t start = body.find(delim, pos);
+    if (start == std::string::npos) break;
+    start += delim.size();
+    size_t stop = body.find(delim, start);
+    size_t part_end = stop == std::string::npos ? body.size() : stop;
+    pos = stop;
+    // part is body[start, part_end); strip exactly one CRLF per side
+    size_t b = start, e = part_end;
+    if (e - b >= 2 && body.compare(b, 2, "\r\n") == 0) b += 2;
+    if (e - b >= 2 && body.compare(e - 2, 2, "\r\n") == 0) e -= 2;
+    if (e <= b) continue;
+    size_t hdr_end = body.find("\r\n\r\n", b);
+    if (hdr_end == std::string::npos || hdr_end + 4 > e) continue;
+    std::string head = body.substr(b, hdr_end - b);
+    std::string lower = head;
+    for (auto& c : lower)
+      c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
+    size_t fpos = lower.find("filename=\"");
+    if (fpos == std::string::npos) continue;
+    // filename value with \" and \\ unescaped (Python regex
+    // filename="((?:[^"\\]|\\.)*)")
+    std::string fn;
+    size_t i = fpos + 10;
+    bool closed = false;
+    while (i < head.size()) {
+      char c = head[i];
+      if (c == '\\' && i + 1 < head.size()) {
+        fn.push_back(head[i + 1]);
+        i += 2;
+        continue;
+      }
+      if (c == '"') {
+        closed = true;
+        break;
+      }
+      fn.push_back(c);
+      i++;
+    }
+    if (!closed) continue;
+    std::string pct;
+    size_t cpos = lower.find("content-type:");
+    if (cpos != std::string::npos) {
+      size_t vs = cpos + 13;
+      while (vs < head.size() && head[vs] == ' ') vs++;
+      size_t ve = head.find("\r\n", vs);
+      if (ve == std::string::npos || ve > hdr_end) ve = hdr_end;
+      pct = head.substr(vs, ve - vs);
+      while (!pct.empty() && (pct.back() == ' ' || pct.back() == '\r'))
+        pct.pop_back();
+    }
+    *filename = fn;
+    *part_ctype = pct;
+    *data = body.data() + hdr_end + 4;
+    *data_len = e - (hdr_end + 4);
+    return true;
+  }
+  return false;
+}
+
+// Build a v2/v3 needle record the way storage/needle.py to_bytes does
+// for the plain-upload shape: data + optional name/mime +
+// last-modified(now). Returns the full padded record; *size_out gets
+// the header Size field, *crc_out the masked checksum.
+std::vector<uint8_t> build_needle(uint32_t cookie, uint64_t key,
+                                  const uint8_t* data, size_t data_len,
+                                  const std::string& name,
+                                  const std::string& mime, int version,
+                                  uint32_t* size_out, uint32_t* crc_out) {
+  uint8_t flags = kFlagHasLastModified;  // Python always stamps mtime
+  std::string nm = name.substr(0, 255);
+  std::string mm = mime.substr(0, 255);
+  if (!nm.empty()) flags |= kFlagHasName;
+  if (!mm.empty()) flags |= kFlagHasMime;
+  size_t body = 4 + data_len + 1;
+  if (flags & kFlagHasName) body += 1 + nm.size();
+  if (flags & kFlagHasMime) body += 1 + mm.size();
+  body += 5;  // last-modified
+  size_t base = kHeaderSize + body + kChecksumSize +
+                (version == 3 ? kTimestampSize : 0);
+  size_t pad = kPaddingSize - base % kPaddingSize;  // never 0
+  std::vector<uint8_t> out(base + pad, 0);
+  uint8_t* p = out.data();
+  be32_store(p, cookie);
+  be64_store(p + 4, key);
+  be32_store(p + 12, static_cast<uint32_t>(body));
+  p += kHeaderSize;
+  be32_store(p, static_cast<uint32_t>(data_len));
+  p += 4;
+  memcpy(p, data, data_len);
+  p += data_len;
+  *p++ = flags;
+  if (flags & kFlagHasName) {
+    *p++ = static_cast<uint8_t>(nm.size());
+    memcpy(p, nm.data(), nm.size());
+    p += nm.size();
+  }
+  if (flags & kFlagHasMime) {
+    *p++ = static_cast<uint8_t>(mm.size());
+    memcpy(p, mm.data(), mm.size());
+    p += mm.size();
+  }
+  int64_t now_s = time(nullptr);
+  for (int i = 0; i < 5; i++)
+    *p++ = static_cast<uint8_t>(now_s >> (8 * (4 - i)));
+  uint32_t crc = masked_crc(crc32c(data, data_len));
+  be32_store(p, crc);
+  p += 4;
+  if (version == 3) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    be64_store(p, static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+                      static_cast<uint64_t>(ts.tv_nsec));
+  }
+  *size_out = static_cast<uint32_t>(body);
+  *crc_out = crc;
+  return out;
+}
+
+// JSON string escape for the upload response's "name" (quotes,
+// backslashes, control chars; non-ASCII redirects before we get here).
+void json_escape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    unsigned char u = static_cast<unsigned char>(c);
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (u < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", u);
+      *out += buf;
+    } else {
+      out->push_back(c);
+    }
+  }
+}
+
+// Plain needle POST on the fast path. The body has already been read.
+// Anything off the fast path redirects to Python (which delegates its
+// append back through swhp_append — same mutex, same tail).
+void serve_write(Server* s, int fd, const Request& req,
+                 const std::string& body, uint32_t vid, uint64_t key,
+                 uint32_t cookie) {
+  auto vol = s->find(vid);
+  if (!vol) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  auto w = vol->get_writer();
+  if (!w || !w->accept_posts.load(std::memory_order_acquire) ||
+      vol->version == 1 || req.has_pair_headers) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  std::string filename, part_ctype;
+  const char* data = nullptr;
+  size_t data_len = 0;
+  if (!parse_multipart(req.content_type, body, &filename, &part_ctype,
+                       &data, &data_len)) {
+    // raw-body uploads and exotic envelopes keep one source of truth
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  // Python guesses a mime from the filename extension (mimetypes reads
+  // /etc/mime.types) and escapes non-ASCII names into \uXXXX JSON —
+  // both are Python-owned behaviors, so those shapes redirect.
+  for (char c : filename) {
+    unsigned char u = static_cast<unsigned char>(c);
+    if (u < 0x20 || u > 0x7E) {
+      redirect_to_fallback(s, fd, req);
+      return;
+    }
+  }
+  std::string mime = part_ctype;
+  if (mime.empty() && filename.find('.') != std::string::npos) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  if (mime == "application/octet-stream") mime.clear();  // not stored
+  if (data_len == 0) {
+    // zero-size records are tombstones on disk; Python rejects these
+    // loudly (storage/volume.py _reject_empty) — match its 500
+    respond_simple(fd, 500, "Internal Server Error",
+                   "{\"error\": \"needle " + std::to_string(key) +
+                       ": empty data \\u2014 zero-size records are "
+                       "tombstones; store empty objects at the filer "
+                       "layer (an entry with no chunks)\"}",
+                   req.keepalive, "", "application/json");
+    return;
+  }
+  if (w->file_size_limit > 0 &&
+      static_cast<int64_t>(data_len) > w->file_size_limit) {
+    respond_simple(fd, 413, "Payload Too Large",
+                   "{\"error\": \"file over the size limit\"}",
+                   req.keepalive, "", "application/json");
+    return;
+  }
+  uint32_t size_field = 0, crc = 0;
+  std::vector<uint8_t> blob = build_needle(
+      cookie, key, reinterpret_cast<const uint8_t*>(data), data_len,
+      filename, mime, vol->version, &size_field, &crc);
+  // overwrite-cookie verification happens INSIDE do_append, under the
+  // writer mutex (storage/volume.py holds volume.lock across
+  // check+append; reference volume_read_write.go reads the stored
+  // header's cookie)
+  int64_t off = do_append(vol.get(), w.get(), blob.data(),
+                          static_cast<int64_t>(blob.size()), key,
+                          size_field, /*check_cookie=*/true, cookie);
+  if (off == -4) {
+    respond_simple(fd, 500, "Internal Server Error",
+                   "{\"error\": \"needle " + std::to_string(key) +
+                       ": mismatching cookie on overwrite\"}",
+                   req.keepalive, "", "application/json");
+    return;
+  }
+  if (off == -2 || off == -1) {
+    // addressing ceiling, or the lease was revoked between the
+    // accept_posts check and the append (vacuum/readonly toggle):
+    // Python is the authority either way
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  if (off < 0) {
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error",
+                   "{\"error\": \"write failed\"}", req.keepalive, "",
+                   "application/json");
+    return;
+  }
+  char etag[16];
+  snprintf(etag, sizeof etag, "%02x%02x%02x%02x", crc >> 24 & 0xFF,
+           crc >> 16 & 0xFF, crc >> 8 & 0xFF, crc & 0xFF);
+  std::string resp = "{\"name\": \"";
+  json_escape(filename, &resp);
+  resp += "\", \"size\": " + std::to_string(data_len) +
+          ", \"eTag\": \"" + etag + "\"}";
+  respond_simple(fd, 200, "OK", resp, req.keepalive, "",
+                 "application/json");
+  s->written++;
+}
+
 void handle_conn(Server* s, int fd) {
   struct timeval tv = {30, 0};
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
@@ -655,6 +1131,48 @@ void handle_conn(Server* s, int fd) {
     int r = read_request(fd, &acc, &req);
     if (r <= 0) break;
     if (req.chunked) req.keepalive = false;  // body framing not parsed
+    uint32_t vid = 0, cookie = 0;
+    uint64_t key = 0;
+    bool fid_ok = parse_fid_path(req.target, &vid, &key, &cookie);
+    bool is_write = (req.method == "POST" || req.method == "PUT") &&
+                    fid_ok && !req.chunked && req.content_length > 0 &&
+                    req.content_length <= s->max_fastpath_bytes;
+    if (is_write) {
+      // cheap pre-check BEFORE buffering the body: a cluster whose
+      // volumes hold no lease (JWT/replicated/TTL'd) must not pay
+      // 64MB of buffering per redirect — those drain + 307 below
+      auto vol = s->find(vid);
+      auto w = vol ? vol->get_writer() : nullptr;
+      if (!w || !w->accept_posts.load(std::memory_order_acquire))
+        is_write = false;
+    }
+    if (is_write) {
+      // buffer the full multipart body (bounded by max_fastpath_bytes;
+      // anything bigger goes to Python via the else-branch drain)
+      std::string body;
+      body.reserve(static_cast<size_t>(req.content_length));
+      int64_t from_acc = std::min<int64_t>(
+          req.content_length, static_cast<int64_t>(acc.size()));
+      body.append(acc, 0, static_cast<size_t>(from_acc));
+      acc.erase(0, static_cast<size_t>(from_acc));
+      bool short_read = false;
+      char buf[16384];
+      while (static_cast<int64_t>(body.size()) < req.content_length) {
+        int64_t want = std::min<int64_t>(
+            req.content_length - static_cast<int64_t>(body.size()),
+            static_cast<int64_t>(sizeof buf));
+        ssize_t got = recv(fd, buf, static_cast<size_t>(want), 0);
+        if (got <= 0) {
+          short_read = true;
+          break;
+        }
+        body.append(buf, static_cast<size_t>(got));
+      }
+      if (short_read) break;  // torn upload: nothing was appended
+      serve_write(s, fd, req, body, vid, key, cookie);
+      if (!req.keepalive) break;
+      continue;
+    }
     // drain any request body so leftover bytes can't desync the next
     // keep-alive request (redirected POST/PUT carry Content-Length)
     if (req.content_length > 0) {
@@ -678,9 +1196,7 @@ void handle_conn(Server* s, int fd) {
       }
     }
     if (req.method == "GET" || req.method == "HEAD") {
-      uint32_t vid, cookie;
-      uint64_t key;
-      if (parse_fid_path(req.target, &vid, &key, &cookie)) {
+      if (fid_ok) {
         serve_needle(s, fd, req, vid, key, cookie);
       } else {
         redirect_to_fallback(s, fd, req);
@@ -707,6 +1223,10 @@ void accept_loop(Server* s) {
       return;
     }
     if (s->live.load() >= s->max_conns) {
+      // bounded send: a client that opens excess connections and never
+      // reads must not wedge the single acceptor thread
+      struct timeval tv = {2, 0};
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
       respond_simple(fd, 503, "Service Unavailable", "too many connections",
                      false);
       close(fd);
@@ -765,13 +1285,132 @@ int swhp_add_volume(void* h, uint32_t vid, const char* dat_path,
   auto rec = std::make_shared<VolumeRec>();
   rec->fd = fd;
   rec->version = version;
+  rec->dat_path = dat_path;
   std::unique_lock<std::shared_mutex> l(s->vols_mu);
   s->vols[vid] = std::move(rec);
   return 0;
 }
 
+// Hands this library the volume's write lease: O_RDWR on the .dat
+// (appends at `tail`), O_APPEND on the .idx. While enabled, Python
+// routes every append through swhp_append and treats the mirror index
+// as authoritative. accept_posts additionally opens the fast-path POST
+// handler (off for replicated/TTL'd/JWT-guarded volumes — those write
+// shapes stay with Python, which still delegates the final append).
+int swhp_enable_writer(void* h, uint32_t vid, const char* idx_path,
+                       int offset_width, int64_t tail, int64_t max_size,
+                       int64_t file_size_limit, int accept_posts) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol || tail % 8 != 0) return -1;
+  auto w = std::make_shared<Writer>();
+  w->fd = open(vol->dat_path.c_str(), O_RDWR);
+  if (w->fd < 0) return -1;
+  w->idx_fd = open(idx_path, O_WRONLY | O_APPEND);
+  if (w->idx_fd < 0) return -1;
+  w->offset_width = offset_width;
+  w->tail.store(tail);
+  w->idx_tail = lseek(w->idx_fd, 0, SEEK_END);
+  w->max_size = max_size;
+  w->file_size_limit = file_size_limit;
+  w->accept_posts.store(accept_posts != 0, std::memory_order_release);
+  std::unique_lock<std::shared_mutex> l(vol->mu);
+  vol->writer = std::move(w);
+  return 0;
+}
+
+// Takes the lease back. Acquiring the writer mutex before closing the
+// fds is the barrier: once this returns, no append is in flight and
+// none can start, so Python may reload its needle map from the .idx
+// and resume its own appends. Returns the final tail (-1: no writer).
+int64_t swhp_disable_writer(void* h, uint32_t vid) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  std::shared_ptr<Writer> w;
+  {
+    std::unique_lock<std::shared_mutex> l(vol->mu);
+    w = std::move(vol->writer);
+    vol->writer.reset();
+  }
+  if (!w) return -1;
+  w->accept_posts.store(false, std::memory_order_release);
+  std::lock_guard<std::mutex> g(w->mu);
+  int64_t tail = w->tail.load();
+  if (w->fd >= 0) close(w->fd);
+  if (w->idx_fd >= 0) close(w->idx_fd);
+  w->fd = w->idx_fd = -1;
+  return tail;
+}
+
+int swhp_set_accept_posts(void* h, uint32_t vid, int on) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  auto w = vol->get_writer();
+  if (!w) return -1;
+  w->accept_posts.store(on != 0, std::memory_order_release);
+  return 0;
+}
+
+// Python's delegated append (write_needle / delete_needle build the
+// record — TTLs, pairs, manifests and all — and hand the bytes here so
+// the volume keeps exactly one tail writer). size_field is the header
+// Size (kTombstoneSize for deletes). check_cookie re-verifies the
+// overwrite/delete cookie against the stored needle under the append
+// mutex (Python's own pre-check races with fast-path POSTs).
+// Returns the offset or the do_append error code.
+int64_t swhp_append(void* h, uint32_t vid, const uint8_t* blob,
+                    int64_t len, uint64_t key, uint32_t size_field,
+                    int check_cookie, uint32_t cookie) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  auto w = vol->get_writer();
+  if (!w) return -1;
+  return do_append(vol.get(), w.get(), blob, len, key, size_field,
+                   check_cookie != 0, cookie);
+}
+
+// Mirror-index probe (1 found, 0 absent). In writer mode the mirror is
+// exact, so Python's read/delete/overwrite paths use this instead of
+// their (frozen) needle map.
+int swhp_lookup(void* h, uint32_t vid, uint64_t key, uint64_t* offset,
+                uint32_t* size) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return 0;
+  std::shared_lock<std::shared_mutex> l(vol->mu);
+  auto it = vol->index.find(key);
+  if (it == vol->index.end()) return 0;
+  *offset = it->second.first;
+  *size = it->second.second;
+  return 1;
+}
+
+// Counter deltas since enable: puts, put_bytes, deletes, deleted_bytes,
+// max_key, tail (in that order). Python adds them to its needle-map
+// counters for heartbeats/vacuum decisions while the lease is out.
+int swhp_writer_counters(void* h, uint32_t vid, uint64_t out[6]) {
+  Server* s = static_cast<Server*>(h);
+  auto vol = s->find(vid);
+  if (!vol) return -1;
+  auto w = vol->get_writer();
+  if (!w) return -1;
+  out[0] = w->puts.load();
+  out[1] = w->put_bytes.load();
+  out[2] = w->deletes.load();
+  out[3] = w->deleted_bytes.load();
+  out[4] = w->max_key.load();
+  // lock-free: heartbeats read counters five times per volume and must
+  // not contend with in-flight appends
+  out[5] = static_cast<uint64_t>(w->tail.load());
+  return 0;
+}
+
 int swhp_remove_volume(void* h, uint32_t vid) {
   Server* s = static_cast<Server*>(h);
+  swhp_disable_writer(h, vid);  // mutex barrier before the rec can die
   std::unique_lock<std::shared_mutex> l(s->vols_mu);
   return s->vols.erase(vid) ? 0 : -1;
 }
@@ -786,7 +1425,10 @@ int swhp_put(void* h, uint32_t vid, uint64_t key, uint64_t offset,
   return 0;
 }
 
-// Bulk load: parallel arrays (numpy-friendly).
+// Bulk load: parallel arrays (numpy-friendly). Insert-only: a key that
+// raced in via swhp_put between Python's needle-map snapshot and this
+// load is FRESHER than the snapshot — overwriting it would serve the
+// pre-overwrite offset until that key's next write.
 int swhp_put_bulk(void* h, uint32_t vid, const uint64_t* keys,
                   const uint64_t* offsets, const uint32_t* sizes,
                   int64_t count) {
@@ -796,7 +1438,7 @@ int swhp_put_bulk(void* h, uint32_t vid, const uint64_t* keys,
   std::unique_lock<std::shared_mutex> l(vol->mu);
   vol->index.reserve(vol->index.size() + static_cast<size_t>(count));
   for (int64_t i = 0; i < count; i++)
-    vol->index[keys[i]] = {offsets[i], sizes[i]};
+    vol->index.emplace(keys[i], std::make_pair(offsets[i], sizes[i]));
   return 0;
 }
 
@@ -813,6 +1455,7 @@ uint64_t swhp_served(void* h) { return static_cast<Server*>(h)->served; }
 uint64_t swhp_redirected(void* h) {
   return static_cast<Server*>(h)->redirected;
 }
+uint64_t swhp_written(void* h) { return static_cast<Server*>(h)->written; }
 
 void swhp_stop(void* h) {
   Server* s = static_cast<Server*>(h);

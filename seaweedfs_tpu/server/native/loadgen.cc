@@ -1,15 +1,18 @@
-// Minimal keep-alive HTTP GET load generator (benchmark client).
+// Minimal keep-alive HTTP load generator (benchmark client).
 //
 // The Python benchmark client tops out around ~350 req/s/process on
 // this kernel (syscall + interpreter overhead), which cannot exercise
-// the native read plane. This tool is the measuring instrument: N
-// threads, each with one keep-alive connection, issuing GETs for a
+// the native data plane. This tool is the measuring instrument: N
+// threads, each with one keep-alive connection, issuing requests for a
 // fixed duration and validating status codes.
 //
-//   ./loadgen <host> <port> <seconds> <threads> <path-file>
+//   ./loadgen <host> <port> <seconds> <threads> <path-file> [post <size>]
 //
 // path-file: newline-separated request paths (e.g. /3,01637037d6);
 // each thread cycles through them starting at a random offset.
+// With `post <size>`, each request is a multipart upload of <size>
+// random-ish bytes to the path (the write-plane drill; use a batch
+// assign's fid_0..fid_N paths so every write is a fresh needle).
 // Prints one line: total requests, elapsed seconds, req/s, errors.
 
 #include <arpa/inet.h>
@@ -83,11 +86,29 @@ int read_response(int fd, std::string* buf) {
   return status;
 }
 
+// 0 = GET mode; >0 = multipart POST mode with this payload size.
+int g_post_size = 0;
+
+std::string make_post_body(int size, unsigned seed) {
+  const char* b = "ldgenboundary7f3a";
+  std::string payload(static_cast<size_t>(size), 'x');
+  for (size_t j = 0; j < payload.size(); j++)
+    payload[j] = static_cast<char>('a' + ((seed + j * 2654435761u) % 26));
+  return std::string("--") + b +
+         "\r\nContent-Disposition: form-data; name=\"file\"; "
+         "filename=\"ldgen\"\r\n"
+         "Content-Type: application/octet-stream\r\n\r\n" +
+         payload + "\r\n--" + b + "--\r\n";
+}
+
 void run(const char* host, int port, const std::vector<std::string>* paths,
          size_t start) {
   int fd = dial(host, port);
   std::string buf;
   size_t i = start;
+  std::string body;
+  if (g_post_size > 0)
+    body = make_post_body(g_post_size, static_cast<unsigned>(start));
   while (!g_stop.load(std::memory_order_relaxed)) {
     if (fd < 0) {
       fd = dial(host, port);
@@ -99,7 +120,15 @@ void run(const char* host, int port, const std::vector<std::string>* paths,
       buf.clear();
     }
     const std::string& p = (*paths)[i++ % paths->size()];
-    std::string req = "GET " + p + " HTTP/1.1\r\nHost: x\r\n\r\n";
+    std::string req;
+    if (g_post_size > 0) {
+      req = "POST " + p +
+            " HTTP/1.1\r\nHost: x\r\nContent-Type: multipart/form-data; "
+            "boundary=ldgenboundary7f3a\r\nContent-Length: " +
+            std::to_string(body.size()) + "\r\n\r\n" + body;
+    } else {
+      req = "GET " + p + " HTTP/1.1\r\nHost: x\r\n\r\n";
+    }
     if (send(fd, req.data(), req.size(), MSG_NOSIGNAL) !=
         static_cast<ssize_t>(req.size())) {
       close(fd);
@@ -123,12 +152,14 @@ void run(const char* host, int port, const std::vector<std::string>* paths,
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc != 6) {
+  if (argc != 6 && !(argc == 8 && strcmp(argv[6], "post") == 0)) {
     fprintf(stderr,
-            "usage: %s <host> <port> <seconds> <threads> <path-file>\n",
+            "usage: %s <host> <port> <seconds> <threads> <path-file> "
+            "[post <size>]\n",
             argv[0]);
     return 2;
   }
+  if (argc == 8) g_post_size = atoi(argv[7]);
   const char* host = argv[1];
   int port = atoi(argv[2]);
   double seconds = atof(argv[3]);

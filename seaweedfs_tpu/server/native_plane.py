@@ -85,6 +85,34 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.swhp_served.restype = ctypes.c_uint64
         lib.swhp_redirected.argtypes = [ctypes.c_void_p]
         lib.swhp_redirected.restype = ctypes.c_uint64
+        lib.swhp_written.argtypes = [ctypes.c_void_p]
+        lib.swhp_written.restype = ctypes.c_uint64
+        lib.swhp_enable_writer.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int]
+        lib.swhp_enable_writer.restype = ctypes.c_int
+        lib.swhp_disable_writer.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_uint32]
+        lib.swhp_disable_writer.restype = ctypes.c_int64
+        lib.swhp_set_accept_posts.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint32,
+                                              ctypes.c_int]
+        lib.swhp_set_accept_posts.restype = ctypes.c_int
+        lib.swhp_append.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_uint64, ctypes.c_uint32,
+                                    ctypes.c_int, ctypes.c_uint32]
+        lib.swhp_append.restype = ctypes.c_int64
+        lib.swhp_lookup.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    ctypes.c_uint64,
+                                    ctypes.POINTER(ctypes.c_uint64),
+                                    ctypes.POINTER(ctypes.c_uint32)]
+        lib.swhp_lookup.restype = ctypes.c_int
+        lib.swhp_writer_counters.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.swhp_writer_counters.restype = ctypes.c_int
         lib.swhp_stop.argtypes = [ctypes.c_void_p]
         lib.swhp_stop.restype = None
         _lib = lib
@@ -162,6 +190,34 @@ class NativeReadPlane:
         if h:
             self._lib.swhp_delete(h, vid, key)
 
+    # -- write lease -------------------------------------------------------
+    def enable_writer(self, volume, file_size_limit: int = 0,
+                      accept_posts: bool = False):
+        """Hand the volume's write lease to the plane (caller holds
+        volume.lock). The mirror must already be registered and exact —
+        register_volume under the same lock hold. Returns a
+        NativeWriter (volume.fast_writer), or None on failure."""
+        h = self._h
+        if not h:
+            return None
+        from ..storage.types import max_volume_size
+        tail = volume.size()
+        rc = self._lib.swhp_enable_writer(
+            h, volume.id, volume.idx_path.encode(), volume.offset_width,
+            tail, max_volume_size(volume.offset_width),
+            int(file_size_limit), 1 if accept_posts else 0)
+        if rc != 0:
+            return None
+        return NativeWriter(self, volume.id)
+
+    def disable_writer(self, vid: int) -> int:
+        """Take the lease back (mutex barrier in C++). Returns the
+        final tail offset, or -1 when no writer was active."""
+        h = self._h
+        if not h:
+            return -1
+        return int(self._lib.swhp_disable_writer(h, vid))
+
     # -- stats / lifecycle -------------------------------------------------
     @property
     def served(self) -> int:
@@ -175,7 +231,81 @@ class NativeReadPlane:
         h = self._h
         return int(self._lib.swhp_redirected(h)) if h else 0
 
+    @property
+    def written(self) -> int:
+        h = self._h
+        return int(self._lib.swhp_written(h)) if h else 0
+
     def stop(self):
         if self._h:
             self._lib.swhp_stop(self._h)
             self._h = None
+
+
+class NativeWriter:
+    """The write-lease handle a Volume holds while the native plane owns
+    its .dat/.idx tails (volume.fast_writer). Implements the delegate
+    surface storage/volume.py calls in writer mode: append (the one
+    tail writer), lookup (the authoritative index), and the counter
+    deltas the volume folds into its frozen needle-map counters."""
+
+    __slots__ = ("_plane", "vid")
+
+    def __init__(self, plane: "NativeReadPlane", vid: int):
+        self._plane = plane
+        self.vid = vid
+
+    def append(self, blob: bytes, key: int, size_field: int,
+               cookie: int = 0, check_cookie: bool = True) -> int:
+        """Append one record; returns its .dat offset. size_field is
+        the needle header Size (0xFFFFFFFF for tombstones). The
+        overwrite/delete cookie is re-verified against the stored
+        needle UNDER the append mutex — the Python-side pre-check
+        races with concurrent fast-path POSTs."""
+        from ..storage.volume import VolumeError
+        h = self._plane._h
+        if not h:
+            raise OSError("native plane stopped")
+        off = self._plane._lib.swhp_append(
+            h, self.vid, blob, len(blob), key, size_field,
+            1 if check_cookie else 0, cookie)
+        if off == -2:
+            raise VolumeError(
+                f"volume {self.vid}: write exceeds the offset-width "
+                f"addressing ceiling")
+        if off == -4:
+            raise VolumeError(
+                f"needle {key}: mismatching cookie on overwrite")
+        if off < 0:
+            raise OSError(
+                f"native append failed on volume {self.vid} ({off})")
+        return off
+
+    def lookup(self, key: int):
+        """(offset, size) from the plane's exact mirror, or None."""
+        h = self._plane._h
+        if not h:
+            return None
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint32()
+        if self._plane._lib.swhp_lookup(h, self.vid, key,
+                                        ctypes.byref(off),
+                                        ctypes.byref(size)):
+            return off.value, size.value
+        return None
+
+    def counters(self):
+        """(puts, put_bytes, deletes, deleted_bytes, max_key, tail)."""
+        h = self._plane._h
+        if not h:
+            return (0, 0, 0, 0, 0, 0)
+        buf = (ctypes.c_uint64 * 6)()
+        if self._plane._lib.swhp_writer_counters(h, self.vid, buf) != 0:
+            return (0, 0, 0, 0, 0, 0)
+        return tuple(int(x) for x in buf)
+
+    def set_accept_posts(self, on: bool):
+        h = self._plane._h
+        if h:
+            self._plane._lib.swhp_set_accept_posts(
+                h, self.vid, 1 if on else 0)

@@ -138,7 +138,9 @@ class VolumeServer:
                     public_url or f"{host}:{self.port}")
                 for loc in self.store.locations:
                     for v in loc.volumes.values():
-                        self.fast_plane.register_volume(v)
+                        with v.lock:
+                            self.fast_plane.register_volume(v)
+                            self._writer_acquire(v)
             except Exception as e:  # noqa: BLE001 - plane is optional
                 import os as _os
                 if "SW_HTTP_PLANE_LIB" in _os.environ:
@@ -192,36 +194,80 @@ class VolumeServer:
         return f"{self.host}:{self.fast_plane.port}" \
             if self.fast_plane else ""
 
-    # -- native-plane index mirror ----------------------------------------
+    # -- native-plane index mirror + write lease ---------------------------
+    def _writer_acquire(self, v):
+        """Hand the volume's write lease to the native plane (caller
+        holds v.lock; the mirror must have just been registered from
+        the CURRENT needle map). Only volumes whose plain-POST shape
+        the plane can serve exactly get a lease: unreplicated,
+        un-TTL'd, v2/v3, no JWT — everything else keeps the round-3
+        Python write path with best-effort mirror updates."""
+        if self.fast_plane is None or v.fast_writer is not None:
+            return
+        if v.readonly or v.version < 2 or self.jwt_signing_key or \
+                v.super_block.ttl.to_uint32() or \
+                v.super_block.replica_placement.copy_count != 1:
+            return
+        v.fast_writer = self.fast_plane.enable_writer(
+            v, self.file_size_limit, accept_posts=True)
+
+    def _writer_release(self, v, reload: bool = True):
+        """Take the write lease back. The C++ disable is a mutex
+        barrier — after it returns no native append is in flight — so
+        the needle map can be reloaded from the .idx the plane kept
+        authoritative and Python-owned appends can resume."""
+        if self.fast_plane is None:
+            return
+        with v.lock:
+            if v.fast_writer is None:
+                return
+            v.fast_writer = None
+            self.fast_plane.disable_writer(v.id)
+            if reload:
+                v.reload_nm()
+
     def _fast_put(self, vid: int, nid: int):
         if self.fast_plane is None:
             return
         v = self.store.find_volume(vid)
-        if v is None:
+        if v is None or v.fast_writer is not None:
+            # in writer mode the append already updated the mirror
             return
         nv = v.nm.get(nid)
         if nv is not None:
             self.fast_plane.put(vid, nid, nv.offset, nv.size)
 
     def _fast_delete(self, vid: int, nid: int):
-        if self.fast_plane is not None:
-            self.fast_plane.delete(vid, nid)
+        if self.fast_plane is None:
+            return
+        v = self.store.find_volume(vid)
+        if v is not None and v.fast_writer is not None:
+            return
+        self.fast_plane.delete(vid, nid)
 
     def _fast_sync(self, vid: int):
         """Re-register a volume after a structural change (create,
-        mount, compaction commit, copy, tail-receive, EC decode) or
-        unregister it when it's gone."""
+        mount, compaction commit, copy, tail-receive, EC decode,
+        readonly/replication toggle) or unregister it when it's gone.
+        Re-establishes the write lease when the volume qualifies."""
         if self.fast_plane is None:
             return
         v = self.store.find_volume(vid)
         if v is None:
             self.fast_plane.unregister_volume(vid)
-        else:
+            return
+        with v.lock:
+            self._writer_release(v)  # reloads nm if a lease was out
             self.fast_plane.register_volume(v)
+            self._writer_acquire(v)
 
     def _fast_unregister(self, vid: int):
-        if self.fast_plane is not None:
-            self.fast_plane.unregister_volume(vid)
+        if self.fast_plane is None:
+            return
+        v = self.store.find_volume(vid)
+        if v is not None:
+            self._writer_release(v)
+        self.fast_plane.unregister_volume(vid)
 
     def _heartbeat_loop(self):
         from ..util import glog
@@ -459,6 +505,8 @@ class VolumeServer:
             FAST_PLANE_COUNTER.set_total(self.fast_plane.served, "served")
             FAST_PLANE_COUNTER.set_total(self.fast_plane.redirected,
                                          "redirected")
+            FAST_PLANE_COUNTER.set_total(self.fast_plane.written,
+                                         "written")
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -473,9 +521,12 @@ class VolumeServer:
 
     def admin_delete_volume(self, req: Request):
         vid = int(req.query["volume"])
-        if not self.store.delete_volume(vid):
-            raise HttpError(404, f"volume {vid} not found")
+        # plane offline BEFORE the unlink: a fast-path POST landing in
+        # the gap would append to a deleted inode and ack a lost write
         self._fast_unregister(vid)
+        if not self.store.delete_volume(vid):
+            self._fast_sync(vid)   # nothing deleted; resume serving
+            raise HttpError(404, f"volume {vid} not found")
         self._lookup_cache.pop(vid, None)
         self.heartbeat_once()
         return {"deleted": vid}
@@ -489,6 +540,11 @@ class VolumeServer:
         # was_readonly lets orchestrators (volume.copy/move/tier.upload
         # freeze) restore exactly the prior state instead of trusting
         # the master's heartbeat-delayed view
+        if was != readonly:
+            # the write lease follows writability: frozen volumes hand
+            # it back (EC encode reads the .idx next), thawed ones may
+            # re-qualify
+            self._fast_sync(vid)
         return {"volume": vid, "readonly": readonly,
                 "was_readonly": was}
 
@@ -509,6 +565,8 @@ class VolumeServer:
             v.configure_replication(rp)
         except (VolumeError, BackendError) as e:
             raise HttpError(409, str(e)) from None
+        # the lease's no-replica qualification may have flipped
+        self._fast_sync(vid)
         return {"volume": vid, "replication": str(rp)}
 
     def admin_volume_mount(self, req: Request):
@@ -528,11 +586,15 @@ class VolumeServer:
         """Stop serving a volume without deleting its files (reference
         VolumeUnmount)."""
         vid = int(req.query["volume"])
+        if self.store.find_volume(vid) is not None:
+            # plane offline BEFORE the unload: the fast path must not
+            # keep acking writes to an officially unmounted volume
+            self._fast_unregister(vid)
         for loc in self.store.locations:
             if loc.unload_volume(vid):
-                self._fast_unregister(vid)
                 self.heartbeat_once()
                 return {"volume": vid, "unmounted": True}
+        self._fast_sync(vid)   # nothing unloaded; resume serving
         raise HttpError(404, f"volume {vid} not mounted")
 
     def admin_vacuum_check(self, req: Request):
@@ -550,6 +612,12 @@ class VolumeServer:
         # per-request override, else the server's configured rate
         bps = int(req.query.get("bytesPerSecond",
                                 self.compaction_bps) or 0)
+        # hand the write lease back first: compact() snapshots the
+        # needle map, which is frozen while the native plane owns the
+        # tail — the release reloads it from the authoritative .idx.
+        # Writes during the copy go through the (slower) Python path
+        # and are replayed by commit's makeup diff.
+        self._writer_release(v)
         v.compact(bytes_per_second=bps)
         return {"volume": vid, "compacted": True}
 
@@ -863,13 +931,17 @@ class VolumeServer:
         if v is None:
             raise HttpError(404, f"volume {vid} not found")
         since = req.query.get("since_ns")
+        # raw records land via the volume's own file handles: take the
+        # write lease back so the native plane isn't appending the same
+        # tail concurrently
+        self._writer_release(v)
         try:
             applied, cursor = volume_backup.append_raw_records(
                 v, req.body, int(since) if since is not None else None)
         except VolumeError as e:
-            raise HttpError(400, str(e))
-        if applied:
             self._fast_sync(vid)
+            raise HttpError(400, str(e))
+        self._fast_sync(vid)
         return {"applied": applied, "cursor_ns": cursor}
 
     def admin_file(self, req: Request):

@@ -98,13 +98,23 @@ def parse_key_hash(key_hash: str) -> tuple:
 
 
 def parse_file_id(fid: str) -> tuple:
-    """'3,01637037d6' -> (volume_id, key, cookie)."""
+    """'3,01637037d6' -> (volume_id, key, cookie). A '_<n>' suffix is
+    the batch-assign convention (reference needle.ParsePath /
+    common.go: ?count=N assigns hand out one fid and clients append
+    _1.._N-1, meaning key+n with the same cookie)."""
     sep = "," if "," in fid else "/"
     if sep not in fid:
         raise ValueError(f"invalid fid {fid!r}")
     vid_s, key_hash = fid.split(sep, 1)
-    key, cookie = parse_key_hash(key_hash.strip())
-    return int(vid_s), key, cookie
+    key_hash = key_hash.strip()
+    delta = 0
+    if "_" in key_hash:
+        key_hash, delta_s = key_hash.split("_", 1)
+        if not delta_s.isdigit():
+            raise ValueError(f"invalid fid delta in {fid!r}")
+        delta = int(delta_s)
+    key, cookie = parse_key_hash(key_hash)
+    return int(vid_s), key + delta, cookie
 
 
 def format_file_id(vid: int, key: int, cookie: int) -> str:

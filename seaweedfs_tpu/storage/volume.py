@@ -51,6 +51,13 @@ class Volume:
         self.readonly = False
         self.lock = threading.RLock()
         self.last_modified = 0
+        # write-lease delegate (server/native_plane.NativeWriter).
+        # While set, the native plane owns the .dat/.idx tails: appends
+        # go through it, its mirror index is authoritative, and the
+        # needle map here is FROZEN (reloaded from .idx when the lease
+        # comes back — reload_nm). Set/cleared under self.lock by the
+        # owning VolumeServer.
+        self.fast_writer = None
 
         prefix = volume_file_prefix(dirname, self.collection, vid)
         self.dat_path = prefix + ".dat"
@@ -128,20 +135,46 @@ class Volume:
     def file_name(self) -> str:
         return volume_file_prefix(self.dir, self.collection, self.id)
 
+    def _writer_deltas(self):
+        """(puts, put_bytes, deletes, deleted_bytes, max_key) appended
+        by the native writer since the needle map was last fresh."""
+        w = self.fast_writer
+        return w.counters()[:5] if w is not None else (0, 0, 0, 0, 0)
+
     def content_size(self) -> int:
-        return self.nm.content_size
+        return self.nm.content_size + self._writer_deltas()[1]
 
     def deleted_size(self) -> int:
-        return self.nm.deleted_size
+        return self.nm.deleted_size + self._writer_deltas()[3]
 
     def file_count(self) -> int:
-        return self.nm.file_counter
+        return self.nm.file_counter + self._writer_deltas()[0]
 
     def deleted_count(self) -> int:
-        return self.nm.deletion_counter
+        return self.nm.deletion_counter + self._writer_deltas()[2]
 
     def max_file_key(self) -> int:
-        return self.nm.maximum_file_key
+        return max(self.nm.maximum_file_key, self._writer_deltas()[4])
+
+    def _nv_get(self, nid: int):
+        """Live (offset, size) for a needle id: the native writer's
+        exact mirror while the lease is out, else the needle map."""
+        w = self.fast_writer
+        if w is not None:
+            hit = w.lookup(nid)
+            if hit is None:
+                return None
+            from .needle_map import NeedleValue
+            return NeedleValue(hit[0], hit[1])
+        return self.nm.get(nid)
+
+    def reload_nm(self):
+        """Refresh the needle map from the .idx (call under self.lock,
+        after the native writer's lease has been taken back — the .idx
+        it kept is authoritative)."""
+        self.nm.close()
+        self.nm = load_needle_map(self.idx_path, self.index_kind,
+                                  self.offset_width)
 
     def size(self) -> int:
         with self.lock:
@@ -170,7 +203,7 @@ class Volume:
         sz = self.size()
         if sz <= SUPER_BLOCK_SIZE:
             return 0.0
-        return self.nm.deleted_size / sz
+        return self.deleted_size() / sz
 
     def expired(self, volume_size_limit: int) -> bool:
         """Reference semantics (volume.go expired()): a 0 size limit means
@@ -225,7 +258,7 @@ class Volume:
             # reject overwrites that don't present the original cookie
             # (cookies exist to stop id-guessing; reference
             # volume_read_write.go checks the stored header's cookie)
-            existing = self.nm.get(n.id)
+            existing = self._nv_get(n.id)
             if existing is not None and existing.offset != 0 and \
                     existing.size != TOMBSTONE_FILE_SIZE:
                 self.dat.seek(existing.offset)
@@ -240,13 +273,23 @@ class Volume:
                 n.set_ttl(vol_ttl)
                 if not n.has_last_modified():
                     n.set_last_modified()
+            if not n.append_at_ns:
+                n.append_at_ns = time.time_ns()
+            if self.fast_writer is not None:
+                # the native plane owns the tail: one append updates
+                # .dat, .idx, and the serving mirror atomically (the
+                # ceiling check and the authoritative cookie re-check
+                # live there too)
+                blob = n.to_bytes(self.version)
+                self.fast_writer.append(blob, n.id, n.size,
+                                        cookie=n.cookie)
+                self.last_modified = int(time.time())
+                return n.size
             self.dat.seek(0, os.SEEK_END)
             offset = self.dat.tell()
             if offset % NEEDLE_PADDING_SIZE:
                 offset += NEEDLE_PADDING_SIZE - offset % NEEDLE_PADDING_SIZE
                 self.dat.truncate(offset)
-            if not n.append_at_ns:
-                n.append_at_ns = time.time_ns()
             blob = n.to_bytes(self.version)
             # hard addressing ceiling for this volume's offset width
             # (32GB / 8TB); checked BEFORE the append so a too-far write
@@ -286,7 +329,7 @@ class Volume:
         with self.lock:
             if self.readonly:
                 raise VolumeError(f"volume {self.id} is read only")
-            nv = self.nm.get(n.id)
+            nv = self._nv_get(n.id)
             if nv is None or nv.size == TOMBSTONE_FILE_SIZE:
                 return 0
             # deletes must present the original cookie too (same id-guessing
@@ -298,9 +341,15 @@ class Volume:
                 raise VolumeError(
                     f"needle {n.id}: mismatching cookie on delete")
             freed = nv.size
-            self.nm.delete(n.id)
             tomb = Needle(cookie=n.cookie, id=n.id, data=b"",
                           append_at_ns=time.time_ns())
+            if self.fast_writer is not None:
+                self.fast_writer.append(tomb.to_bytes(self.version),
+                                        n.id, TOMBSTONE_FILE_SIZE,
+                                        cookie=n.cookie)
+                self.last_modified = int(time.time())
+                return freed
+            self.nm.delete(n.id)
             self.dat.seek(0, os.SEEK_END)
             offset = self.dat.tell()
             self.dat.seek(offset)
@@ -313,7 +362,7 @@ class Volume:
     def read_needle(self, n: Needle) -> Needle:
         """Read by id; validates cookie and TTL. n carries id+cookie."""
         with self.lock:
-            nv = self.nm.get(n.id)
+            nv = self._nv_get(n.id)
             if nv is None or nv.offset == 0 or nv.size == TOMBSTONE_FILE_SIZE:
                 raise NotFound(f"needle {n.id} not found in volume {self.id}")
             blob = self._read_blob(nv.offset, nv.size)
@@ -333,7 +382,7 @@ class Volume:
         v1 needles carry no flags byte -> 0. NotFound if absent."""
         import struct
         with self.lock:
-            nv = self.nm.get(n.id)
+            nv = self._nv_get(n.id)
             if nv is None or nv.offset == 0 or \
                     nv.size == TOMBSTONE_FILE_SIZE:
                 raise NotFound(
@@ -543,6 +592,9 @@ class Volume:
     # -- lifecycle ---------------------------------------------------------
     def close(self):
         with self.lock:
+            # a still-held write lease is the owner's to revoke; clear
+            # the delegate so no append lands after the files close
+            self.fast_writer = None
             self.nm.close()
             self.dat.close()
 

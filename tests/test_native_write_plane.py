@@ -1,0 +1,526 @@
+"""Native C++ write plane: parity + lease-ownership correctness.
+
+The plane (server/native/http_plane.cc) handles plain multipart POSTs
+on the fast port while it holds a volume's write lease: it appends the
+.dat record, the .idx entry, and its serving mirror atomically under a
+per-volume mutex (reference volume_server_handlers_write.go:18). Python
+delegates its own appends through the same mutex (swhp_append), so a
+volume has exactly one tail writer; structural operations take the
+lease back first. Everything here pins:
+  * response/stored-bytes parity with the Python write path,
+  * off-fast-path shapes 307ing to Python and still landing,
+  * .idx durability across cold restart (the plane wrote it),
+  * lease handback around compaction / readonly / replication,
+  * counter parity between the lease deltas and a reloaded needle map.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.server.http_util import (HttpError, http_call,
+                                            http_get_with_headers,
+                                            post_json, post_multipart)
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.native_plane import available
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.types import parse_file_id
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="libseaweed_http.so unavailable")
+
+
+def start_vs(tmp_path, master, name="v0", **kw):
+    return VolumeServer(port=0, directories=[str(tmp_path / name)],
+                        master_url=master.url, pulse_seconds=1,
+                        max_volume_counts=[10], ec_backend="numpy",
+                        **kw).start()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = start_vs(tmp_path, master)
+    assert vs.fast_plane is not None
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def raw_request(hostport, method, path, body=None, headers=None):
+    """Single-socket roundtrip WITHOUT redirect following, so the
+    plane's own status codes are observable."""
+    c = http.client.HTTPConnection(hostport, timeout=10)
+    c.request(method, path, body=body, headers=headers or {})
+    r = c.getresponse()
+    data = r.read()
+    out = (r.status, dict((k.lower(), v) for k, v in r.getheaders()),
+           data)
+    c.close()
+    return out
+
+
+def multipart_body(filename, data, ctype="application/octet-stream"):
+    boundary = "testboundary123"
+    body = (f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="file"; '
+            f'filename="{filename}"\r\n'
+            f"Content-Type: {ctype}\r\n\r\n").encode() + data + \
+        f"\r\n--{boundary}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def assign(master, **q):
+    qs = "&".join(f"{k}={v}" for k, v in q.items())
+    return post_json(f"http://{master.url}/dir/assign?{qs}", {})
+
+
+class TestFastPathWrites:
+    def test_roundtrip_and_response_parity(self, cluster):
+        """Same upload via fast port and Python port: response JSON
+        fields and served bytes/headers must match."""
+        master, vs = cluster
+        payload = b"write-plane-payload" * 50
+
+        a1 = assign(master)
+        body, ctype = multipart_body("a.bin", payload)
+        st, _, raw = raw_request(vs.fast_url, "POST", f"/{a1['fid']}",
+                                 body, {"Content-Type": ctype})
+        assert st == 200
+        fast_resp = json.loads(raw)
+
+        a2 = assign(master)
+        py_resp = post_multipart(f"http://{a2['url']}/{a2['fid']}",
+                                 "a.bin", payload)
+        assert fast_resp["size"] == py_resp["size"] == len(payload)
+        assert fast_resp["eTag"] == py_resp["eTag"]
+        assert fast_resp["name"] == py_resp["name"] == "a.bin"
+
+        # stored semantics identical through BOTH read planes
+        for fid in (a1["fid"], a2["fid"]):
+            for port in (vs.url, vs.fast_url):
+                stat, hdrs, data = raw_request(port, "GET", f"/{fid}")
+                assert stat == 200 and data == payload
+                assert hdrs["content-disposition"] == \
+                    'inline; filename="a.bin"'
+        assert vs.fast_plane.written >= 1
+
+    def test_explicit_mime_stored(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        body, ctype = multipart_body("x.bin", b"imagey", "image/png")
+        st, _, _ = raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                               body, {"Content-Type": ctype})
+        assert st == 200
+        _, hdrs, _ = raw_request(vs.url, "GET", f"/{a['fid']}")
+        assert hdrs["content-type"] == "image/png"
+
+    def test_filename_extension_redirects_for_mime_guess(self, cluster):
+        """No part content-type + an extension: Python's mimetypes owns
+        the guess, so the plane must hand the request over — and the
+        stored mime must equal what a direct Python upload stores."""
+        master, vs = cluster
+        a = assign(master)
+        boundary = "bnd1"
+        body = (f"--{boundary}\r\n"
+                'Content-Disposition: form-data; name="file"; '
+                'filename="doc.txt"\r\n\r\n').encode() + b"texty" + \
+            f"\r\n--{boundary}--\r\n".encode()
+        st, hdrs, _ = raw_request(
+            vs.fast_url, "POST", f"/{a['fid']}", body,
+            {"Content-Type": f"multipart/form-data; boundary={boundary}"})
+        assert st == 307
+        # follow by hand to Python, then compare to a pure-Python write
+        st2, _, raw = raw_request(
+            vs.url, "POST", f"/{a['fid']}", body,
+            {"Content-Type": f"multipart/form-data; boundary={boundary}"})
+        assert st2 == 200
+        _, h1, _ = raw_request(vs.url, "GET", f"/{a['fid']}")
+        assert h1["content-type"] == "text/plain"
+
+    def test_batch_assign_fid_suffix(self, cluster):
+        """?count=N assigns one fid; _1.._N-1 suffixes mean key+i with
+        the same cookie (reference needle.ParsePath) — on the fast
+        path too."""
+        master, vs = cluster
+        a = assign(master, count=4)
+        assert a["count"] == 4
+        for i in range(4):
+            fid = a["fid"] if i == 0 else f"{a['fid']}_{i}"
+            body, ctype = multipart_body("b", f"part-{i}".encode())
+            st, _, _ = raw_request(vs.fast_url, "POST", f"/{fid}",
+                                   body, {"Content-Type": ctype})
+            assert st == 200, fid
+        vid, key, cookie = parse_file_id(a["fid"])
+        for i in range(4):
+            fid = a["fid"] if i == 0 else f"{a['fid']}_{i}"
+            assert http_call(
+                "GET", f"http://{vs.url}/{fid}") == f"part-{i}".encode()
+        # distinct keys, shared cookie
+        assert parse_file_id(f"{a['fid']}_3") == (vid, key + 3, cookie)
+
+    def test_overwrite_wrong_cookie_500(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        body, ctype = multipart_body("v1", b"first")
+        assert raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                           {"Content-Type": ctype})[0] == 200
+        vid, key, cookie = parse_file_id(a["fid"])
+        bad_cookie = (cookie + 1) & 0xFFFFFFFF
+        bad_fid = f"{vid},{key:x}{bad_cookie:08x}"
+        body2, ctype2 = multipart_body("v2", b"second")
+        st, _, raw = raw_request(vs.fast_url, "POST", f"/{bad_fid}",
+                                 body2, {"Content-Type": ctype2})
+        assert st == 500
+        assert "mismatching cookie" in json.loads(raw)["error"]
+        # original intact
+        assert http_call("GET", f"http://{vs.fast_url}/{a['fid']}") \
+            == b"first"
+
+    def test_overwrite_right_cookie_wins(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        for payload in (b"gen-1", b"gen-2-longer"):
+            body, ctype = multipart_body("f", payload)
+            assert raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                               body,
+                               {"Content-Type": ctype})[0] == 200
+        assert http_call("GET", f"http://{vs.url}/{a['fid']}") \
+            == b"gen-2-longer"
+
+    def test_empty_upload_500(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        body, ctype = multipart_body("e", b"")
+        st, _, raw = raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                                 body, {"Content-Type": ctype})
+        assert st == 500
+        assert "tombstones" in json.loads(raw)["error"]
+
+    def test_over_size_limit_413(self, tmp_path):
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        vs = start_vs(tmp_path, master, file_size_limit_mb=1)
+        try:
+            a = assign(master)
+            body, ctype = multipart_body("big", b"x" * (1 << 20 | 1))
+            st, _, raw = raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                                     body, {"Content-Type": ctype})
+            assert st == 413
+            assert "size limit" in json.loads(raw)["error"]
+        finally:
+            vs.stop()
+            master.stop()
+
+    def test_delete_of_plane_written_needle(self, cluster):
+        """DELETE rides the Python server but the tombstone append is
+        delegated back through the lease — both planes then 404."""
+        master, vs = cluster
+        a = assign(master)
+        body, ctype = multipart_body("d", b"doomed")
+        raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                    {"Content-Type": ctype})
+        http_call("DELETE", f"http://{vs.url}/{a['fid']}")
+        for port in (vs.url, vs.fast_url):
+            with pytest.raises(HttpError) as ei:
+                http_call("GET", f"http://{port}/{a['fid']}")
+            assert ei.value.status == 404
+
+
+class TestOffFastPathShapes:
+    """Every shape the plane must hand to Python — and the handed-over
+    write must still land (http_call follows 307 for POSTs)."""
+
+    def test_query_params_redirect_then_land(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        body, ctype = multipart_body("q", b"ttl-payload")
+        st, hdrs, _ = raw_request(vs.fast_url, "POST",
+                                  f"/{a['fid']}?ttl=5m", body,
+                                  {"Content-Type": ctype})
+        assert st == 307 and vs.url in hdrs["location"]
+        # the pooled client follows 307 with method+body preserved
+        out = post_multipart(
+            f"http://{vs.fast_url}/{a['fid']}?ttl=5m", "q",
+            b"ttl-payload")
+        assert out["size"] == len(b"ttl-payload")
+        assert http_call("GET", f"http://{vs.url}/{a['fid']}") \
+            == b"ttl-payload"
+
+    def test_pair_headers_redirect_then_served(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        out = post_multipart(f"http://{vs.fast_url}/{a['fid']}", "p",
+                             b"pairs", headers={"Seaweed-k1": "v1"})
+        assert out["size"] == 5
+        _, hdrs = http_get_with_headers(f"http://{vs.url}/{a['fid']}")
+        assert hdrs.get("Seaweed-k1") == "v1"
+
+    def test_raw_body_redirects_then_lands(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        st, _, _ = raw_request(
+            vs.fast_url, "POST", f"/{a['fid']}", b"raw-bytes",
+            {"Content-Type": "application/octet-stream"})
+        assert st == 307
+        http_call("POST", f"http://{vs.fast_url}/{a['fid']}",
+                  b"raw-bytes",
+                  {"Content-Type": "application/octet-stream"})
+        assert http_call("GET", f"http://{vs.url}/{a['fid']}") \
+            == b"raw-bytes"
+
+    def test_replicated_volume_gets_no_lease(self, tmp_path):
+        """With 001 placement the plane must redirect POSTs (Python
+        owns the fan-out) — and the write must reach both replicas."""
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        va = start_vs(tmp_path, master, "va")
+        vb = start_vs(tmp_path, master, "vb")
+        try:
+            a = assign(master, replication="001")
+            vid = int(a["fid"].split(",")[0])
+            body, ctype = multipart_body("r", b"replicated")
+            assert "fastUrl" in a
+            st, _, _ = raw_request(a["fastUrl"], "POST", f"/{a['fid']}",
+                                   body, {"Content-Type": ctype})
+            assert st == 307
+            out = post_multipart(
+                f"http://{a['fastUrl']}/{a['fid']}", "r", b"replicated")
+            assert out["size"] == len(b"replicated")
+            for vs in (va, vb):
+                v = vs.store.find_volume(vid)
+                assert v is not None and v.fast_writer is None
+                assert v.file_count() == 1
+        finally:
+            va.stop()
+            vb.stop()
+            master.stop()
+
+    def test_readonly_drops_the_lease(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        vid = int(a["fid"].split(",")[0])
+        body, ctype = multipart_body("w", b"pre-freeze")
+        assert raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                           {"Content-Type": ctype})[0] == 200
+        post_json(f"http://{vs.url}/admin/volume/readonly"
+                  f"?volume={vid}&readonly=true", {})
+        v = vs.store.find_volume(vid)
+        assert v.fast_writer is None
+        st, _, _ = raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                               body, {"Content-Type": ctype})
+        assert st == 307  # plane stopped accepting; Python will 500
+        # reads still served fast
+        assert raw_request(vs.fast_url, "GET", f"/{a['fid']}")[0] == 200
+        post_json(f"http://{vs.url}/admin/volume/readonly"
+                  f"?volume={vid}&readonly=false", {})
+        assert vs.store.find_volume(vid).fast_writer is not None
+
+
+class TestLeaseOwnership:
+    def test_idx_durable_across_cold_restart(self, tmp_path):
+        """The .idx the PLANE wrote must reload into a correct needle
+        map — counters included — after a cold restart."""
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        vs = start_vs(tmp_path, master)
+        fids = []
+        for i in range(30):
+            a = assign(master)
+            body, ctype = multipart_body(f"f{i}", f"data-{i}".encode())
+            assert raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                               body,
+                               {"Content-Type": ctype})[0] == 200
+            fids.append(a["fid"])
+        for fid in fids[:5]:
+            http_call("DELETE", f"http://{vs.url}/{fid}")
+        # counters through the lease == counters after reload
+        live = {}
+        for vs_vid in {int(f.split(",")[0]) for f in fids}:
+            v = vs.store.find_volume(vs_vid)
+            live[vs_vid] = (v.file_count(), v.deleted_count(),
+                            v.content_size(), v.max_file_key())
+        vs.stop()
+        vs2 = start_vs(tmp_path, master)
+        try:
+            for vid, want in live.items():
+                v = vs2.store.find_volume(vid)
+                got = (v.file_count(), v.deleted_count(),
+                       v.content_size(), v.max_file_key())
+                assert got == want, f"volume {vid}: {got} != {want}"
+            for i, fid in enumerate(fids[5:], start=5):
+                assert http_call("GET", f"http://{vs2.url}/{fid}") \
+                    == f"data-{i}".encode()
+            for fid in fids[:5]:
+                with pytest.raises(HttpError):
+                    http_call("GET", f"http://{vs2.url}/{fid}")
+        finally:
+            vs2.stop()
+            master.stop()
+
+    def test_vacuum_cycle_with_writes_between_phases(self, cluster):
+        """compact -> more fast writes -> commit: the makeup diff must
+        replay the .idx entries the plane appended past the
+        watermark."""
+        master, vs = cluster
+        a0 = assign(master)
+        vid = int(a0["fid"].split(",")[0])
+        survivors, doomed = [], []
+        for i in range(20):
+            a = assign(master)
+            while int(a["fid"].split(",")[0]) != vid:
+                a = assign(master)
+            body, ctype = multipart_body("v", f"gen-{i}".encode())
+            raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                        {"Content-Type": ctype})
+            (doomed if i % 2 else survivors).append((a["fid"], i))
+        for fid, _ in doomed:
+            http_call("DELETE", f"http://{vs.url}/{fid}")
+        post_json(f"http://{vs.url}/admin/vacuum/compact?volume={vid}",
+                  {})
+        mid = assign(master)
+        while int(mid["fid"].split(",")[0]) != vid:
+            mid = assign(master)
+        # the lease is released for the compact window, so this fast-port
+        # POST 307s; the pooled client follows it to the Python path,
+        # whose append lands past the watermark for the makeup diff
+        post_multipart(f"http://{vs.fast_url}/{mid['fid']}", "m",
+                       b"between-phases")
+        post_json(f"http://{vs.url}/admin/vacuum/commit?volume={vid}",
+                  {})
+        for fid, i in survivors:
+            assert http_call("GET", f"http://{vs.fast_url}/{fid}") \
+                == f"gen-{i}".encode()
+        assert http_call("GET", f"http://{vs.fast_url}/{mid['fid']}") \
+            == b"between-phases"
+        for fid, _ in doomed:
+            with pytest.raises(HttpError):
+                http_call("GET", f"http://{vs.url}/{fid}")
+        # lease re-established after commit; fast writes still land
+        v = vs.store.find_volume(vid)
+        assert v.fast_writer is not None
+        post = assign(master)
+        while int(post["fid"].split(",")[0]) != vid:
+            post = assign(master)
+        body, ctype = multipart_body("p", b"post-commit")
+        assert raw_request(vs.fast_url, "POST", f"/{post['fid']}",
+                           body, {"Content-Type": ctype})[0] == 200
+
+    def test_mixed_plane_python_churn_consistent(self, cluster):
+        """Interleaved fast-port POSTs, Python-port POSTs (delegated
+        appends), and deletes; after a lease handback the reloaded
+        needle map must agree with the plane's mirror exactly."""
+        master, vs = cluster
+        stop = threading.Event()
+        errors = []
+        written = {}
+        lock = threading.Lock()
+
+        def fast_writer(tid):
+            i = 0
+            while not stop.is_set():
+                try:
+                    a = assign(master)
+                    data = f"fast-{tid}-{i}".encode()
+                    body, ctype = multipart_body("f", data)
+                    st, _, raw = raw_request(
+                        vs.fast_url, "POST", f"/{a['fid']}", body,
+                        {"Content-Type": ctype})
+                    if st != 200:
+                        errors.append(f"fast write {st}")
+                    else:
+                        with lock:
+                            written[a["fid"]] = data
+                    i += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"fast: {e}")
+
+        def py_writer(tid):
+            i = 0
+            while not stop.is_set():
+                try:
+                    a = assign(master)
+                    data = f"py-{tid}-{i}".encode()
+                    post_multipart(f"http://{a['url']}/{a['fid']}",
+                                   "p", data, timeout=5)
+                    with lock:
+                        written[a["fid"]] = data
+                    i += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"py: {e}")
+
+        def deleter():
+            import random
+            while not stop.is_set():
+                time.sleep(0.03)
+                with lock:
+                    if len(written) < 8:
+                        continue
+                    fid = random.choice(list(written))
+                    del written[fid]
+                try:
+                    http_call("DELETE", f"http://{vs.url}/{fid}",
+                              timeout=5)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"del: {e}")
+
+        threads = [threading.Thread(target=fast_writer, args=(t,))
+                   for t in range(2)] + \
+                  [threading.Thread(target=py_writer, args=(t,))
+                   for t in range(2)] + \
+                  [threading.Thread(target=deleter)]
+        for t in threads:
+            t.start()
+        time.sleep(5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        assert all(not t.is_alive() for t in threads)
+        assert not errors, errors[:10]
+        # every surviving write readable with exact bytes
+        with lock:
+            snapshot = dict(written)
+        for fid, data in snapshot.items():
+            assert http_call("GET", f"http://{vs.fast_url}/{fid}",
+                             timeout=5) == data, fid
+        # lease handback: reloaded nm must agree with the mirror
+        for loc in vs.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                mirror = {}
+                with v.lock:
+                    w = v.fast_writer
+                    assert w is not None
+                    before = (v.file_count(), v.deleted_count(),
+                              v.content_size())
+                    vs._writer_release(v)  # reloads nm from .idx
+                    after = (v.file_count(), v.deleted_count(),
+                             v.content_size())
+                assert before == after, f"volume {vid} counter drift"
+                vs._fast_sync(vid)
+        assert vs.fast_plane.written > 20
+
+
+def test_plane_no_lease_under_jwt(tmp_path):
+    """A write-JWT server keeps every write on the Python path (the
+    plane cannot verify tokens) — POSTs to the fast port redirect."""
+    master = MasterServer(port=0, pulse_seconds=1,
+                          jwt_signing_key="sekrit").start()
+    vs = start_vs(tmp_path, master, jwt_signing_key="sekrit")
+    try:
+        a = assign(master)
+        assert a.get("auth")
+        vid = int(a["fid"].split(",")[0])
+        v = vs.store.find_volume(vid)
+        assert v.fast_writer is None
+        body, ctype = multipart_body("j", b"guarded")
+        st, _, _ = raw_request(vs.fast_url, "POST", f"/{a['fid']}",
+                               body, {"Content-Type": ctype})
+        assert st == 307
+        from seaweedfs_tpu.client import operation as op
+        op.upload(a["url"], a["fid"], b"guarded", filename="j",
+                  jwt=a["auth"])
+        assert http_call("GET", f"http://{vs.url}/{a['fid']}") \
+            == b"guarded"
+    finally:
+        vs.stop()
+        master.stop()
