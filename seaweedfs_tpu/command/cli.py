@@ -46,9 +46,11 @@ def _apply_tls_config(args):
         config_get(cfg, "https.key", "") or ""
     ca = getattr(args, "tlsCa", "") or \
         config_get(cfg, "https.ca", "") or ""
+    mutual = getattr(args, "tlsMutual", False) or \
+        str(config_get(cfg, "https.mutual", "")).lower() in ("true", "1")
     if cert or ca:
         from ..server.http_util import configure_tls
-        configure_tls(cert, key, ca)
+        configure_tls(cert, key, ca, mutual=mutual)
 
 
 def cmd_master(args):
@@ -681,6 +683,9 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-tlsCert", default="")
     m.add_argument("-tlsKey", default="")
     m.add_argument("-tlsCa", default="")
+    m.add_argument("-tlsMutual", action="store_true",
+                   help="require CA-verified client certs "
+                        "on cluster-internal routes")
     m.add_argument("-peers", default="",
                    help="comma-separated master peers for raft HA, "
                         "e.g. host1:9333,host2:9333,host3:9333")
@@ -750,6 +755,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-tlsCert", default="")
     v.add_argument("-tlsKey", default="")
     v.add_argument("-tlsCa", default="")
+    v.add_argument("-tlsMutual", action="store_true",
+                   help="require CA-verified client certs "
+                        "on cluster-internal routes")
     v.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs allowed to call")
     v.add_argument("-tierConfig", default="",
@@ -789,6 +797,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-tlsCert", default="")
     s.add_argument("-tlsKey", default="")
     s.add_argument("-tlsCa", default="")
+    s.add_argument("-tlsMutual", action="store_true",
+                   help="require CA-verified client certs "
+                        "on cluster-internal routes")
     s.add_argument("-tierConfig", default="")
     s.set_defaults(fn=cmd_server)
 
@@ -820,6 +831,9 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-tlsCert", default="")
     f.add_argument("-tlsKey", default="")
     f.add_argument("-tlsCa", default="")
+    f.add_argument("-tlsMutual", action="store_true",
+                   help="require CA-verified client certs "
+                        "on cluster-internal routes")
     f.add_argument("-encryptVolumeData", action="store_true",
                    help="AES-256-GCM encrypt chunk data; volume servers "
                         "only see ciphertext (reference filer.toml "

@@ -1,16 +1,20 @@
 """Minimal Kafka wire-protocol producer (no SDK).
 
 The reference ships a Kafka notification backend
-(reference weed/notification/kafka/kafka_queue.go via the sarama client);
-this is a from-scratch produce-only client speaking the classic binary
-protocol over TCP — Metadata v0 (api_key 3) to discover partition
-leaders, Produce v0 (api_key 0) with message-format-v0 sets to publish —
-so filer metadata events can land in any broker that accepts the classic
-protocol (Kafka <= 3.x, Redpanda), with zero dependencies.
+(reference weed/notification/kafka/kafka_queue.go via the sarama
+client, which version-negotiates automatically); this is a from-scratch
+produce-only client speaking the binary protocol over TCP, with zero
+dependencies.
 
-Kept deliberately at protocol v0: the framing is stable, every broker
-generation that predates KIP-896 accepts it, and the publisher's job is
-an at-least-once event firehose, not a transactional producer.
+Version negotiation (KIP-35): on the first use of each broker
+connection the client sends ApiVersions v0 and intersects the broker's
+advertised [min,max] per api with what it speaks — Metadata v0 or v4,
+Produce v0 (message-format-v0 sets) or v3 (record-batch v2 with
+crc32c + varints). Classic brokers (<= 3.x, Redpanda) get the v0
+forms; KIP-896 brokers (Kafka 4.x, which REMOVED Produce v0-v2) get
+v3. No overlap fails loudly and permanently — silently "retrying" an
+unsupported version can never succeed. A broker so old it resets on
+ApiVersions itself is assumed v0-only, like sarama's fallback.
 
 Wire shapes (big-endian):
   frame    = int32 size | payload
@@ -21,6 +25,13 @@ Wire shapes (big-endian):
   BYTES    = int32 len | bytes          (-1 = null)
   message  = int64 offset | int32 size | uint32 crc | int8 magic(0)
            | int8 attrs(0) | BYTES key | BYTES value
+  batch(v2)= int64 baseOffset | int32 batchLen | int32 leaderEpoch(-1)
+           | int8 magic(2) | uint32 crc32c | int16 attrs
+           | int32 lastOffsetDelta | int64 baseTs | int64 maxTs
+           | int64 producerId(-1) | int16 producerEpoch(-1)
+           | int32 baseSeq(-1) | int32 count | records
+  record   = varint len | int8 attrs | varint tsDelta | varint offDelta
+           | varint keyLen | key | varint valLen | val | varint headers
 """
 
 from __future__ import annotations
@@ -34,6 +45,9 @@ from typing import Dict, List, Optional, Tuple
 
 API_PRODUCE = 0
 API_METADATA = 3
+API_VERSIONS = 18
+
+ERR_UNSUPPORTED_VERSION = 35
 
 # error codes that a metadata refresh + retry can fix
 _RETRIABLE = {3, 5, 6, 7}  # unknown topic/partition, leader not
@@ -104,6 +118,76 @@ def encode_message_set(pairs: List[Tuple[Optional[bytes], bytes]]) -> bytes:
     return b"".join(out)
 
 
+# -- record-batch v2 (Produce >= v3) -----------------------------------------
+
+_CRC32C_TABLE = []
+
+
+def _crc32c(data: bytes) -> int:
+    """Castagnoli CRC (record-batch v2 checksums use it, not CRC-32)."""
+    if not _CRC32C_TABLE:
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            _CRC32C_TABLE.append(c)
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    """Zigzag varint (protobuf-style), as records use."""
+    z = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    out = bytearray()
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """(value, new_pos) — exported for the test broker's decoder."""
+    shift = z = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        z |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (z >> 1) ^ -(z & 1), pos
+
+
+def encode_record_batch(pairs: List[Tuple[Optional[bytes], bytes]],
+                        ts_ms: int) -> bytes:
+    """Message-format-v2 batch (the only format Produce v3+ accepts)."""
+    records = []
+    for i, (key, value) in enumerate(pairs):
+        body = bytearray(b"\x00")                    # record attributes
+        body += _varint(0)                           # timestamp delta
+        body += _varint(i)                           # offset delta
+        if key is None:
+            body += _varint(-1)
+        else:
+            body += _varint(len(key)) + key
+        body += _varint(len(value)) + value
+        body += _varint(0)                           # no headers
+        records.append(_varint(len(body)) + bytes(body))
+    recs = b"".join(records)
+    # attributes .. records — the crc32c covers exactly this span
+    tail = (struct.pack(">hiqqqhii", 0, len(pairs) - 1, ts_ms, ts_ms,
+                        -1, -1, -1, len(pairs)) + recs)
+    head = struct.pack(">ib", -1, 2)  # partitionLeaderEpoch, magic
+    inner = head + struct.pack(">I", _crc32c(tail)) + tail
+    return struct.pack(">qi", 0, len(inner)) + inner
+
+
 class KafkaProducer:
     """Produce-only client: leader discovery, per-key partitioning,
     retry with metadata refresh on retriable errors."""
@@ -129,6 +213,9 @@ class KafkaProducer:
         self.retries = max(1, int(retries))
         self._corr = 0
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        # broker -> {api_key: (min, max)} from the ApiVersions probe
+        self._api_ranges: Dict[Tuple[str, int],
+                               Dict[int, Tuple[int, int]]] = {}
         # topic -> {partition: (host, port)} (leaderless partitions absent)
         self._leaders: Dict[str, Dict[int, Tuple[str, int]]] = {}
         # topic -> total partition count (incl. leaderless — the key->
@@ -145,7 +232,65 @@ class KafkaProducer:
         sock = socket.create_connection(addr, timeout=self.timeout)
         sock.settimeout(self.timeout)
         self._conns[addr] = sock
-        return sock
+        if addr not in self._api_ranges:
+            self._probe_versions(addr, sock)
+        # the probe's legacy fallback may have replaced the socket
+        return self._conns[addr]
+
+    def _probe_versions(self, addr: Tuple[str, int],
+                        sock: socket.socket):
+        """ApiVersions v0 handshake (KIP-35): learn the broker's
+        [min,max] per api before speaking anything else. A broker so
+        ancient it drops the probe is assumed v0-only (sarama's
+        fallback for pre-0.10 brokers)."""
+        self._corr += 1
+        corr = self._corr
+        frame = struct.pack(">hhi", API_VERSIONS, 0, corr) + \
+            _str(self.client_id)
+        try:
+            sock.sendall(struct.pack(">i", len(frame)) + frame)
+            (size,) = struct.unpack(">i", self._recv_exact(sock, 4))
+            if size < 4 or size > 1 << 20:
+                raise KafkaError(f"bad ApiVersions size {size}")
+            r = _Reader(self._recv_exact(sock, size))
+            if r.i32() != corr:
+                raise KafkaError("ApiVersions correlation mismatch")
+            err = r.i16()
+            ranges: Dict[int, Tuple[int, int]] = {}
+            for _ in range(r.i32()):
+                api, lo, hi = r.i16(), r.i16(), r.i16()
+                ranges[api] = (lo, hi)
+            if err and err != ERR_UNSUPPORTED_VERSION:
+                raise KafkaError(f"ApiVersions error {err}")
+            # KIP-511: err 35 still carries the supported table
+            if ranges:
+                self._api_ranges[addr] = ranges
+                return
+            raise KafkaError("empty ApiVersions table")
+        except (OSError, KafkaError):
+            # legacy broker: reconnect (it may have severed) and speak
+            # the classic v0 protocol throughout
+            self._drop_conn(addr)
+            self._api_ranges[addr] = {API_PRODUCE: (0, 0),
+                                      API_METADATA: (0, 0)}
+            sock = socket.create_connection(addr, timeout=self.timeout)
+            sock.settimeout(self.timeout)
+            self._conns[addr] = sock
+
+    # versions this client can speak, best first
+    _SUPPORTED = {API_PRODUCE: (3, 0), API_METADATA: (4, 0)}
+
+    def _pick_version(self, addr: Tuple[str, int], api_key: int) -> int:
+        """Best mutually-supported version, or a LOUD permanent error —
+        an unsupported version can never start working on retry."""
+        lo, hi = self._api_ranges.get(addr, {}).get(api_key, (0, 0))
+        for cand in self._SUPPORTED[api_key]:
+            if lo <= cand <= hi:
+                return cand
+        raise KafkaError(
+            f"no overlapping version for api {api_key}: broker "
+            f"{addr[0]}:{addr[1]} supports [{lo},{hi}], client speaks "
+            f"{sorted(self._SUPPORTED[api_key])}", retriable=False)
 
     def _drop_conn(self, addr: Tuple[str, int]):
         sock = self._conns.pop(addr, None)
@@ -154,12 +299,18 @@ class KafkaProducer:
                 sock.close()
             except OSError:
                 pass
+        # version knowledge is per-connection: a fallback cached off a
+        # TRANSIENT failure must not pin a modern broker to v0 forever,
+        # so the next reconnect re-probes (one extra roundtrip)
+        self._api_ranges.pop(addr, None)
 
     def _call(self, addr: Tuple[str, int], api_key: int, body: bytes,
-              expect_response: bool = True) -> Optional[_Reader]:
+              expect_response: bool = True,
+              version: int = 0) -> Optional[_Reader]:
         self._corr += 1
         corr = self._corr
-        header = struct.pack(">hhi", api_key, 0, corr) + _str(self.client_id)
+        header = struct.pack(">hhi", api_key, version, corr) + \
+            _str(self.client_id)
         frame = header + body
         sock = self._conn(addr)
         try:
@@ -196,26 +347,42 @@ class KafkaProducer:
     # -- metadata ---------------------------------------------------------
 
     def _refresh_metadata(self, topic: str):
-        body = struct.pack(">i", 1) + _str(topic)
         last: Exception = KafkaError("no seed brokers")
         for addr in self.seeds:
             try:
-                r = self._call(addr, API_METADATA, body)
+                self._conn(addr)  # ensures the ApiVersions probe ran
+                ver = self._pick_version(addr, API_METADATA)
+                body = struct.pack(">i", 1) + _str(topic)
+                if ver >= 4:
+                    body += struct.pack(">b", 1)  # allow auto-create
+                r = self._call(addr, API_METADATA, body, version=ver)
             except (OSError, KafkaError) as e:
+                if isinstance(e, KafkaError) and not e.retriable:
+                    raise
                 last = e
                 continue
+            if ver >= 3:
+                r.i32()  # throttle_time_ms
             brokers: Dict[int, Tuple[str, int]] = {}
             for _ in range(r.i32()):
                 node = r.i32()
                 host = r.string() or ""
                 port = r.i32()
+                if ver >= 1:
+                    r.string()  # rack
                 brokers[node] = (host, port)
+            if ver >= 2:
+                r.string()  # cluster_id
+            if ver >= 1:
+                r.i32()  # controller_id
             leaders: Dict[int, Tuple[str, int]] = {}
             topic_err = 0
             total = 0
             for _ in range(r.i32()):
                 terr = r.i16()
                 tname = r.string()
+                if ver >= 1:
+                    r._take(1)  # is_internal
                 parts = {}
                 nparts = r.i32()
                 for _ in range(nparts):
@@ -226,6 +393,9 @@ class KafkaProducer:
                         r.i32()
                     for _ in range(r.i32()):  # isr
                         r.i32()
+                    if ver >= 5:
+                        for _ in range(r.i32()):  # offline replicas
+                            r.i32()
                     if perr in (0, 9) and leader in brokers:
                         # 9 = replica-not-available: leader still usable
                         parts[pid] = brokers[leader]
@@ -293,14 +463,22 @@ class KafkaProducer:
     def _send_once(self, topic: str, key: Optional[bytes],
                    value: bytes) -> int:
         pid, addr = self._leader_for(topic, key)
-        mset = encode_message_set([(key, value)])
-        body = (struct.pack(">hi", self.acks, int(self.timeout * 1000))
-                + struct.pack(">i", 1) + _str(topic)
-                + struct.pack(">i", 1)
-                + struct.pack(">i", pid) + struct.pack(">i", len(mset))
-                + mset)
+        self._conn(addr)  # ensures the ApiVersions probe ran
+        ver = self._pick_version(addr, API_PRODUCE)
+        if ver >= 3:
+            recs = encode_record_batch([(key, value)],
+                                       int(time.time() * 1000))
+            body = _str(None)  # transactional_id
+        else:
+            recs = encode_message_set([(key, value)])
+            body = b""
+        body += (struct.pack(">hi", self.acks, int(self.timeout * 1000))
+                 + struct.pack(">i", 1) + _str(topic)
+                 + struct.pack(">i", 1)
+                 + struct.pack(">i", pid) + struct.pack(">i", len(recs))
+                 + recs)
         r = self._call(addr, API_PRODUCE, body,
-                       expect_response=self.acks != 0)
+                       expect_response=self.acks != 0, version=ver)
         if self.acks == 0:
             return -1
         for _ in range(r.i32()):

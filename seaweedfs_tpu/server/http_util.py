@@ -337,39 +337,85 @@ class Response:
 # HttpServer wraps its socket. Single-scheme by design, like the
 # reference's all-or-nothing grpc TLS config.
 _TLS = {"cert": "", "key": "", "ca": "", "client_ctx": None,
-        "server_ctx": None}
+        "server_ctx": None, "mutual": False}
 
 
 def configure_tls(cert_file: str = "", key_file: str = "",
-                  ca_file: str = ""):
+                  ca_file: str = "", mutual: bool = False):
     """Enable TLS: servers present cert/key; clients verify against ca
     (or the cert itself for self-signed deployments). A cert without a
     key (or vice versa) is refused outright — the half-configured
     alternative serves plaintext while rewriting outbound URLs to
-    https, which only surfaces as baffling handshake errors later."""
+    https, which only surfaces as baffling handshake errors later.
+
+    ``mutual=True`` is the reference's cluster-plane posture
+    (weed/security/tls.go:34-40 ``ClientAuth:
+    RequireAndVerifyClientCert``): servers ask every connection for a
+    CA-verified client certificate, and the cluster-internal routes
+    (heartbeat, admin, raft, watch — require_client_cert call sites)
+    reject connections that presented none. Public data routes
+    (reads, S3, filer) stay server-TLS on the same listener, which is
+    why the socket uses CERT_OPTIONAL + per-route enforcement rather
+    than failing every certless handshake outright. Outbound cluster
+    calls present cert/key as their client identity
+    (tls.go:55-66)."""
     import ssl
     clear_conn_pool()  # drop conns from the previous config
     if bool(cert_file) != bool(key_file):
         raise ValueError("TLS needs BOTH cert and key (got only one); "
                          "pass just ca for a client-only configuration")
+    if mutual and not ca_file:
+        raise ValueError("mutual TLS needs a CA to verify client "
+                         "certificates against")
     _TLS["cert"], _TLS["key"], _TLS["ca"] = cert_file, key_file, ca_file
+    _TLS["mutual"] = bool(mutual)
     if cert_file and key_file:
         sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         sctx.load_cert_chain(cert_file, key_file)
+        if mutual:
+            # OPTIONAL at the handshake, REQUIRED per-route: a client
+            # cert that fails CA verification still aborts the
+            # handshake; absence is tolerated here and rejected by
+            # require_client_cert on internal routes
+            sctx.verify_mode = ssl.CERT_OPTIONAL
+            sctx.load_verify_locations(ca_file)
         _TLS["server_ctx"] = sctx
     cctx = ssl.create_default_context(cafile=ca_file or cert_file or None)
     cctx.check_hostname = False  # cluster peers are addressed by ip:port
+    if cert_file and key_file:
+        # cluster peers authenticate outbound calls with the same
+        # keypair they serve with (reference tls.go LoadClientTLS)
+        cctx.load_cert_chain(cert_file, key_file)
     _TLS["client_ctx"] = cctx
 
 
 def reset_tls():
     _TLS.update({"cert": "", "key": "", "ca": "", "client_ctx": None,
-                 "server_ctx": None})
+                 "server_ctx": None, "mutual": False})
     clear_conn_pool()  # pooled conns carry the previous TLS context
 
 
 def tls_enabled() -> bool:
     return _TLS["server_ctx"] is not None
+
+
+def mtls_enabled() -> bool:
+    return tls_enabled() and _TLS["mutual"]
+
+
+def require_client_cert(req: "Request"):
+    """Reject a cluster-internal request whose connection presented no
+    CA-verified client certificate (no-op unless mutual TLS is on).
+    The handshake already aborted any UNverifiable cert, so a
+    non-empty peer cert here means CA-verified."""
+    if not mtls_enabled():
+        return
+    conn = req.handler.connection
+    cert = conn.getpeercert() if hasattr(conn, "getpeercert") else None
+    if not cert:
+        raise HttpError(
+            403, "client certificate required on cluster-internal "
+                 "routes")
 
 
 def _client_url(url: str) -> str:

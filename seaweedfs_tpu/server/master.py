@@ -668,6 +668,13 @@ class MasterServer:
                 "version": "seaweedfs_tpu 0.1"}
 
     def _guard_check(self, req: Request):
+        # cluster-internal planes demand a CA-verified client cert
+        # under mutual TLS (reference tls.go RequireAndVerifyClientCert
+        # on every gRPC service; /dir/* and UI stay public like the
+        # reference's public HTTP port)
+        from .http_util import require_client_cert
+        if req.path.startswith(("/cluster/", "/raft/", "/vol/")):
+            require_client_cert(req)
         if not self.guard.enabled:
             return
         p = req.path

@@ -369,13 +369,17 @@ int read_request(int fd, std::string* acc, Request* out) {
           } else if (k == "content-type") {
             out->content_type = v;
           } else if (k == "content-length") {
-            // trim trailing whitespace, then demand a clean parse: a
-            // value like "+10" or "12 x" makes framing unknowable, so
-            // treat the body as unreadable and sever after responding
+            // trim trailing whitespace, then demand a clean all-DIGIT
+            // parse (RFC 9110): strtoll alone would accept "+10" or
+            // "\t10", whose framing an intermediary may read
+            // differently — treat those as unreadable and sever
             while (!v.empty() && (v.back() == ' ' || v.back() == '\t'))
               v.pop_back();
             char* end = nullptr;
-            out->content_length = strtoll(v.c_str(), &end, 10);
+            out->content_length =
+                (!v.empty() && isdigit(static_cast<unsigned char>(v[0])))
+                    ? strtoll(v.c_str(), &end, 10)
+                    : -1;
             if (v.empty() || out->content_length < 0 ||
                 (end && *end != '\0')) {
               out->content_length = 0;

@@ -965,7 +965,13 @@ class VolumeServer:
 
     def _guard_check(self, req: Request):
         """Whitelist applies to every route, admin included (reference
-        wraps all handlers in guard.WhiteList)."""
+        wraps all handlers in guard.WhiteList). Under mutual TLS the
+        admin plane (the reference's gRPC surface) additionally
+        demands a CA-verified client certificate; public data routes
+        stay server-TLS."""
+        if req.path.startswith("/admin/"):
+            from .http_util import require_client_cert
+            require_client_cert(req)
         if self.guard.enabled and \
                 not self.guard.allows(req.handler.client_address[0]):
             raise HttpError(403, "ip not in whitelist")

@@ -110,11 +110,17 @@ def parse_file_id(fid: str) -> tuple:
     delta = 0
     if "_" in key_hash:
         key_hash, delta_s = key_hash.split("_", 1)
-        if not delta_s.isdigit():
+        # 18-digit cap (matches the C++ parser): an unbounded delta
+        # could push the key past 2^64 and blow up serialization with
+        # a struct.error instead of a clean invalid-fid rejection
+        if not delta_s.isdigit() or len(delta_s) > 18:
             raise ValueError(f"invalid fid delta in {fid!r}")
         delta = int(delta_s)
     key, cookie = parse_key_hash(key_hash)
-    return int(vid_s), key + delta, cookie
+    key += delta
+    if key >> 64:
+        raise ValueError(f"fid key overflows 64 bits in {fid!r}")
+    return int(vid_s), key, cookie
 
 
 def format_file_id(vid: int, key: int, cookie: int) -> str:

@@ -552,3 +552,76 @@ def test_plane_disabled_by_flag(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+class TestPlaneHealthRatio:
+    """The plane is fail-open by design: an index-mirror miss 307s to
+    Python, so a wholesale silent degradation (e.g. a resync bug that
+    permanently unregisters a volume) would quietly turn "12x reads"
+    into 1x with zero errors. The redirect/served ratio is the
+    alarm — this pins it under CI so a regression fails here, not in
+    a re-benchmark months later."""
+
+    LOADGEN = "seaweedfs_tpu/server/native/loadgen"
+
+    def _loadgen(self, vs, paths, tmp_path, seconds="4"):
+        import json as _json
+        import os
+        import subprocess
+        lg = os.path.abspath(self.LOADGEN)
+        if not os.path.exists(lg):
+            build = os.path.join(os.path.dirname(lg), "build.sh")
+            subprocess.run(["sh", build], check=True, timeout=120,
+                          capture_output=True)
+        pf = tmp_path / "paths.txt"
+        pf.write_text("\n".join(paths))
+        host, port = vs.fast_url.split(":")
+        out = subprocess.run(
+            [lg, host, port, seconds, "8", str(pf)],
+            capture_output=True, text=True, timeout=60)
+        return _json.loads(out.stdout)
+
+    def test_sustained_reads_keep_redirects_under_1pct(self, cluster,
+                                                       tmp_path):
+        master, vs = cluster
+        paths = []
+        for i in range(200):
+            fid, _ = assign_and_upload(master, b"soak-%d" % i)
+            paths.append("/" + fid)
+        base_served = vs.fast_plane.served
+        base_redir = vs.fast_plane.redirected
+        stats = self._loadgen(vs, paths, tmp_path)
+        served = vs.fast_plane.served - base_served
+        redirected = vs.fast_plane.redirected - base_redir
+        assert stats["requests"] > 1000, stats
+        assert stats["errors"] == 0, stats
+        total = served + redirected
+        ratio = redirected / max(1, total)
+        assert ratio < 0.01, \
+            (f"index mirror degraded: {redirected}/{total} plain reads "
+             f"redirected to Python — the fast plane is silently "
+             f"handing back its traffic")
+
+    def test_degraded_mirror_trips_the_ratio(self, cluster, tmp_path):
+        """Prove the alarm actually fires: silently unregister the
+        volumes (the failure mode the ratio exists to catch) and the
+        same measurement must exceed the bound."""
+        master, vs = cluster
+        paths = []
+        for i in range(50):
+            fid, _ = assign_and_upload(master, b"degraded-%d" % i)
+            paths.append("/" + fid)
+        for vid in {int(p[1:].split(",")[0]) for p in paths}:
+            vs.fast_plane.unregister_volume(vid)
+        base_served = vs.fast_plane.served
+        base_redir = vs.fast_plane.redirected
+        self._loadgen(vs, paths, tmp_path, seconds="2")
+        served = vs.fast_plane.served - base_served
+        redirected = vs.fast_plane.redirected - base_redir
+        ratio = redirected / max(1, served + redirected)
+        assert ratio > 0.99, (served, redirected)
+        # recovery: re-sync restores fast serving
+        for vid in {int(p[1:].split(",")[0]) for p in paths}:
+            vs._fast_sync(vid)
+        st, _, body = raw_get(vs.fast_url, paths[0])
+        assert st == 200 and body == b"degraded-0"

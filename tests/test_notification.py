@@ -89,18 +89,28 @@ import socket  # noqa: E402
 import struct  # noqa: E402
 
 from seaweedfs_tpu.notification.kafka import (  # noqa: E402
-    API_METADATA, API_PRODUCE, KafkaError, KafkaProducer, _Reader)
+    API_METADATA, API_PRODUCE, API_VERSIONS, KafkaError, KafkaProducer,
+    _Reader, _crc32c, read_varint)
 
 
 class FakeBroker:
-    """Single-broker Kafka speaking Metadata v0 + Produce v0 — records
-    every produced (partition, key, value); can fail the first N produce
-    calls with NOT_LEADER_FOR_PARTITION to exercise the retry path."""
+    """Single-broker Kafka with ApiVersions negotiation (KIP-35):
+    advertises configurable [min,max] ranges, REJECTS requests outside
+    them (recorded in version_violations — a correct client never
+    sends one), and speaks both protocol generations: Metadata v0/v4
+    and Produce v0 (message sets) / v3 (record-batch v2, crc32c
+    verified). Records every produced (partition, key, value); can
+    fail the first N produce calls with NOT_LEADER_FOR_PARTITION to
+    exercise the retry path."""
 
-    def __init__(self, topic="t", partitions=2, fail_first=0):
+    def __init__(self, topic="t", partitions=2, fail_first=0,
+                 produce_range=(0, 9), metadata_range=(0, 9)):
         self.topic = topic
         self.partitions = partitions
         self.fail_first = fail_first
+        self.produce_range = produce_range
+        self.metadata_range = metadata_range
+        self.version_violations = []
         self.produced = []
         self.next_offset = 0
         self.sock = socket.socket()
@@ -139,12 +149,18 @@ class FakeBroker:
                 if payload is None:
                     return
                 r = _Reader(payload)
-                api, _ver, corr = r.i16(), r.i16(), r.i32()
+                api, ver, corr = r.i16(), r.i16(), r.i32()
                 r.string()  # client id
-                if api == API_METADATA:
-                    body = self._metadata()
+                if api == API_VERSIONS:
+                    body = self._api_versions()
+                elif api == API_METADATA:
+                    if not self._in_range(self.metadata_range, api, ver):
+                        return
+                    body = self._metadata(ver)
                 elif api == API_PRODUCE:
-                    body = self._produce(r)
+                    if not self._in_range(self.produce_range, api, ver):
+                        return
+                    body = self._produce(r, ver)
                     if body is None:  # acks=0: no response on the wire
                         continue
                 else:
@@ -172,20 +188,97 @@ class FakeBroker:
         b = s.encode()
         return struct.pack(">h", len(b)) + b
 
-    def _metadata(self):
-        out = [struct.pack(">i", 1),  # one broker
-               struct.pack(">i", 0), self._s("127.0.0.1"),
-               struct.pack(">i", self.port),
-               struct.pack(">i", 1),  # one topic
-               struct.pack(">h", 0), self._s(self.topic),
-               struct.pack(">i", self.partitions)]
+    def _in_range(self, rng, api, ver):
+        if rng[0] <= ver <= rng[1]:
+            return True
+        # a correct client never sends a version we didn't advertise;
+        # real brokers sever/error — record it and sever
+        self.version_violations.append((api, ver))
+        return False
+
+    def _api_versions(self):
+        return (struct.pack(">h", 0) + struct.pack(">i", 2)
+                + struct.pack(">hhh", API_PRODUCE, *self.produce_range)
+                + struct.pack(">hhh", API_METADATA,
+                              *self.metadata_range))
+
+    def _metadata(self, ver=0):
+        out = []
+        if ver >= 3:
+            out.append(struct.pack(">i", 0))  # throttle
+        out += [struct.pack(">i", 1),  # one broker
+                struct.pack(">i", 0), self._s("127.0.0.1"),
+                struct.pack(">i", self.port)]
+        if ver >= 1:
+            out.append(struct.pack(">h", -1))  # rack (null)
+        if ver >= 2:
+            out.append(self._s("fake-cluster"))
+        if ver >= 1:
+            out.append(struct.pack(">i", 0))  # controller id
+        out += [struct.pack(">i", 1),  # one topic
+                struct.pack(">h", 0), self._s(self.topic)]
+        if ver >= 1:
+            out.append(struct.pack(">b", 0))  # is_internal
+        out.append(struct.pack(">i", self.partitions))
         for pid in range(self.partitions):
             out.append(struct.pack(">hii", 0, pid, 0))  # err, pid, leader
             out.append(struct.pack(">ii", 1, 0))        # replicas [0]
             out.append(struct.pack(">ii", 1, 0))        # isr [0]
+            if ver >= 5:
+                out.append(struct.pack(">i", 0))        # offline []
         return b"".join(out)
 
-    def _produce(self, r):
+    def _decode_message_set(self, pid, mset):
+        while mset.pos < len(mset.buf):
+            mset.i64()  # offset
+            m = _Reader(mset._take(mset.i32()))
+            m.i32()  # crc
+            m._take(2)  # magic, attrs
+            klen = m.i32()
+            key = m._take(klen) if klen >= 0 else None
+            vlen = m.i32()
+            val = m._take(vlen) if vlen >= 0 else None
+            self.produced.append((pid, key, val))
+
+    def _decode_record_batch(self, pid, raw):
+        """Record-batch v2 (magic 2): verify the crc32c, then unpack
+        each record's varint-framed key/value."""
+        r = _Reader(raw)
+        r.i64()  # base offset
+        r.i32()  # batch length
+        r.i32()  # partition leader epoch
+        magic = r._take(1)[0]
+        assert magic == 2, f"produce v3 requires magic 2, got {magic}"
+        crc = struct.unpack(">I", r._take(4))[0]
+        rest = raw[r.pos:]
+        assert _crc32c(rest) == crc, "record batch crc32c mismatch"
+        r.i16()  # attributes
+        r.i32()  # last offset delta
+        r.i64()  # base timestamp
+        r.i64()  # max timestamp
+        r.i64()  # producer id
+        r.i16()  # producer epoch
+        r.i32()  # base sequence
+        count = r.i32()
+        buf, pos = raw, r.pos
+        for _ in range(count):
+            _rlen, pos = read_varint(buf, pos)
+            pos += 1  # record attributes
+            _ts, pos = read_varint(buf, pos)
+            _od, pos = read_varint(buf, pos)
+            klen, pos = read_varint(buf, pos)
+            key = None if klen < 0 else buf[pos:pos + klen]
+            pos += max(0, klen)
+            vlen, pos = read_varint(buf, pos)
+            val = None if vlen < 0 else buf[pos:pos + vlen]
+            pos += max(0, vlen)
+            nhdr, pos = read_varint(buf, pos)
+            assert nhdr == 0
+            self.produced.append((pid, key, val))
+
+    def _produce(self, r, ver=0):
+        if ver >= 3:
+            r.string()  # transactional id
         acks = r.i16()
         r.i32()  # timeout
         parts_resp = []
@@ -193,30 +286,28 @@ class FakeBroker:
             name = r.string()
             for _ in range(r.i32()):
                 pid = r.i32()
-                mset = _Reader(r._take(r.i32()))
+                raw = r._take(r.i32())
                 err = 0
                 if self.fail_first > 0:
                     self.fail_first -= 1
                     err = 6  # NOT_LEADER_FOR_PARTITION
+                elif ver >= 3:
+                    self._decode_record_batch(pid, raw)
                 else:
-                    while mset.pos < len(mset.buf):
-                        mset.i64()  # offset
-                        m = _Reader(mset._take(mset.i32()))
-                        m.i32()  # crc
-                        m._take(2)  # magic, attrs
-                        klen = m.i32()
-                        key = m._take(klen) if klen >= 0 else None
-                        vlen = m.i32()
-                        val = m._take(vlen) if vlen >= 0 else None
-                        self.produced.append((pid, key, val))
-                parts_resp.append(struct.pack(">ihq", pid, err,
-                                              self.next_offset))
+                    self._decode_message_set(pid, _Reader(raw))
+                resp = struct.pack(">ihq", pid, err, self.next_offset)
+                if ver >= 2:
+                    resp += struct.pack(">q", -1)  # log append time
+                parts_resp.append(resp)
                 self.next_offset += 1
         if acks == 0:
             return None
-        return (struct.pack(">i", 1) + self._s(name)
-                + struct.pack(">i", len(parts_resp))
-                + b"".join(parts_resp))
+        out = (struct.pack(">i", 1) + self._s(name)
+               + struct.pack(">i", len(parts_resp))
+               + b"".join(parts_resp))
+        if ver >= 1:
+            out += struct.pack(">i", 0)  # throttle
+        return out
 
 
 def test_kafka_produce_roundtrip():
@@ -303,12 +394,15 @@ def test_kafka_permanent_error_does_not_retry():
     """A non-retriable broker verdict (e.g. MESSAGE_TOO_LARGE=10) must
     propagate on the first attempt — re-sending the same payload can
     never fix it."""
-    broker = FakeBroker(topic="events", partitions=1, fail_first=99)
+    # pin the broker to Produce v0 so the partition-response rewrite
+    # below targets a fixed wire shape
+    broker = FakeBroker(topic="events", partitions=1, fail_first=99,
+                        produce_range=(0, 0))
     broker_err = {"code": 10}
     orig = FakeBroker._produce
 
-    def produce_permanent(self, r):
-        body = orig(self, r)
+    def produce_permanent(self, r, ver=0):
+        body = orig(self, r, ver)
         # rewrite the error code in the single partition response
         return body[:-14] + struct.pack(">ihq", 0, broker_err["code"],
                                         0)
@@ -324,6 +418,57 @@ def test_kafka_permanent_error_does_not_retry():
         broker.stop()
     # exactly one attempt hit the broker (fail_first decremented once)
     assert broker.fail_first == 98
+
+
+def test_kafka_v3_only_broker():
+    """Kafka 4.x (KIP-896) removed Produce v0-v2 and Metadata v0-v3:
+    the negotiated client must land on Produce v3 + record-batch v2
+    (crc32c-verified by the fake) against a modern-only broker."""
+    broker = FakeBroker(topic="events", partitions=2,
+                        produce_range=(3, 11), metadata_range=(4, 12))
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5)
+        off1 = prod.send("events", b"/a/b", b'{"x":1}')
+        off2 = prod.send("events", b"/a/b", b'{"x":2}')
+        assert off2 > off1 >= 0
+        prod.close()
+    finally:
+        broker.stop()
+    assert broker.version_violations == []
+    assert broker.produced[0][0] == broker.produced[1][0]
+    assert [v for _, _, v in broker.produced] == [b'{"x":1}', b'{"x":2}']
+
+
+def test_kafka_v0_only_broker_still_served():
+    """Classic brokers (pre-KIP-35 era ranges) keep the v0 forms."""
+    broker = FakeBroker(topic="events", partitions=1,
+                        produce_range=(0, 2), metadata_range=(0, 3))
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5)
+        assert prod.send("events", b"k", b"v") >= 0
+        prod.close()
+    finally:
+        broker.stop()
+    assert broker.version_violations == []
+    assert broker.produced == [(0, b"k", b"v")]
+
+
+def test_kafka_no_version_overlap_fails_loudly():
+    """A broker whose Produce range has no overlap with the client's
+    must produce one immediate, explicit, NON-retried error — not a
+    retry loop against a version that can never work."""
+    broker = FakeBroker(topic="events", partitions=1,
+                        produce_range=(12, 13), metadata_range=(4, 12))
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5,
+                             retries=5)
+        with pytest.raises(KafkaError, match="no overlapping version"):
+            prod.send("events", b"k", b"v")
+        prod.close()
+    finally:
+        broker.stop()
+    assert broker.produced == []
+    assert broker.version_violations == []  # never sent a bad version
 
 
 def test_kafka_bad_bootstrap_rejected():

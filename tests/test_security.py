@@ -121,3 +121,124 @@ def test_upload_data_uses_auth_automatically(secured_cluster):
     master, _ = secured_cluster
     fid = op.upload_data(master.url, b"auto-jwt")
     assert op.read_file(master.url, fid) == b"auto-jwt"
+
+
+# -- mutual TLS --------------------------------------------------------------
+# Reference weed/security/tls.go:34-40: every gRPC (cluster-internal)
+# service runs ClientAuth: RequireAndVerifyClientCert, while public
+# HTTP surfaces stay server-TLS. Here the same listener carries both,
+# so the handshake is CERT_OPTIONAL and the internal routes
+# (/cluster/*, /raft/*, /vol/*, volume /admin/*) enforce the peer cert.
+
+def _mtls_pki(tmp_path):
+    """CA + CA-signed server/peer certs + a rogue self-signed cert."""
+    import subprocess
+
+    def run(*cmd):
+        out = subprocess.run(cmd, capture_output=True)
+        if out.returncode != 0:
+            pytest.skip(f"openssl unavailable: {out.stderr[:120]}")
+
+    ca, cakey = str(tmp_path / "ca.pem"), str(tmp_path / "ca.key")
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", cakey, "-out", ca, "-days", "1", "-subj", "/CN=testca")
+    out = {}
+    for name, cn in (("srv", "127.0.0.1"), ("peer", "peer")):
+        key = str(tmp_path / f"{name}.key")
+        csr = str(tmp_path / f"{name}.csr")
+        crt = str(tmp_path / f"{name}.pem")
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", csr, "-subj", f"/CN={cn}")
+        run("openssl", "x509", "-req", "-in", csr, "-CA", ca,
+            "-CAkey", cakey, "-CAcreateserial", "-out", crt,
+            "-days", "1")
+        out[name] = (crt, key)
+    rcrt, rkey = str(tmp_path / "rogue.pem"), str(tmp_path / "rogue.key")
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", rkey, "-out", rcrt, "-days", "1", "-subj", "/CN=rogue")
+    return ca, out["srv"], out["peer"], (rcrt, rkey)
+
+
+def _https_request(port, method, path, ca=None, client_cert=None):
+    """One raw HTTPS roundtrip with an explicit, caller-owned TLS
+    identity (the process-wide _TLS config must not leak into the
+    simulated foreign clients)."""
+    import http.client
+    import ssl
+    ctx = ssl.create_default_context(cafile=ca)
+    ctx.check_hostname = False
+    if ca is None:
+        ctx.verify_mode = ssl.CERT_NONE
+    if client_cert:
+        ctx.load_cert_chain(*client_cert)
+    c = http.client.HTTPSConnection("127.0.0.1", port, timeout=10,
+                                    context=ctx)
+    c.request(method, path)
+    r = c.getresponse()
+    body = r.read()
+    c.close()
+    return r.status, body
+
+
+def test_mutual_tls_admin_routes(tmp_path):
+    from seaweedfs_tpu.server.http_util import (configure_tls, get_json,
+                                                reset_tls)
+    ca, (scrt, skey), peer, rogue = _mtls_pki(tmp_path)
+    try:
+        configure_tls(scrt, skey, ca, mutual=True)
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        vs = VolumeServer(port=0, directories=[str(tmp_path / "v0")],
+                          master_url=master.url,
+                          pulse_seconds=1).start()
+        # cluster peers (this process's pooled client presents the
+        # server keypair as its client identity): heartbeat landed
+        assert master.topology.find_node(vs.url) is not None
+        # e2e write/read through the TLS'd public plane
+        a = op.assign(master.url)
+        op.upload(a["url"], a["fid"], b"mtls-payload", filename="m")
+        assert op.read_file(master.url, a["fid"]) == b"mtls-payload"
+        vid = int(a["fid"].split(",")[0])
+
+        # a CERT-LESS client (trusts the CA, presents nothing):
+        # public routes fine, internal routes 403
+        st, _ = _https_request(master.port, "GET", "/dir/status", ca=ca)
+        assert st == 200
+        st, _ = _https_request(vs.port, "GET", f"/{a['fid']}", ca=ca)
+        assert st == 200
+        st, body = _https_request(master.port, "GET", "/cluster/status",
+                                  ca=ca)
+        assert st == 403 and b"certificate" in body
+        st, body = _https_request(
+            vs.port, "GET",
+            f"/admin/volume/sync_status?volume={vid}", ca=ca)
+        assert st == 403 and b"certificate" in body
+
+        # a CA-VERIFIED peer cert opens the internal routes
+        st, _ = _https_request(master.port, "GET", "/cluster/status",
+                               ca=ca, client_cert=peer)
+        assert st == 200
+        st, _ = _https_request(
+            vs.port, "GET",
+            f"/admin/volume/sync_status?volume={vid}",
+            ca=ca, client_cert=peer)
+        assert st == 200
+
+        # a cert from OUTSIDE the CA fails the handshake outright
+        import ssl
+        with pytest.raises((ssl.SSLError, ConnectionError, OSError)):
+            _https_request(master.port, "GET", "/cluster/status",
+                           ca=ca, client_cert=rogue)
+        vs.stop()
+        master.stop()
+    finally:
+        reset_tls()
+
+
+def test_mutual_tls_requires_ca(tmp_path):
+    from seaweedfs_tpu.server.http_util import configure_tls, reset_tls
+    cert, key = _mtls_pki(tmp_path)[1]
+    try:
+        with pytest.raises(ValueError):
+            configure_tls(cert, key, "", mutual=True)
+    finally:
+        reset_tls()
