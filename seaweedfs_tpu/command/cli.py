@@ -191,6 +191,11 @@ def cmd_filer(args):
         store_options = {"addr": args.redisAddr,
                          "password": args.redisPassword,
                          "db": args.redisDb}
+    elif args.store == "mysql":
+        store_options = {"addr": args.mysqlAddr,
+                         "user": args.mysqlUser,
+                         "password": args.mysqlPassword,
+                         "database": args.mysqlDatabase}
     else:
         store_options = {}
     f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
@@ -808,7 +813,8 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-ip", default="127.0.0.1")
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
-                   choices=["memory", "sqlite", "sharded", "redis"])
+                   choices=["memory", "sqlite", "sharded", "redis",
+                            "mysql"])
     f.add_argument("-db", default="./filer.db",
                    help="metadata path: a sqlite file, or a directory "
                         "of shard dbs for -store sharded (default "
@@ -820,6 +826,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="redis endpoint for -store redis")
     f.add_argument("-redisPassword", default="")
     f.add_argument("-redisDb", type=int, default=0)
+    f.add_argument("-mysqlAddr", default="127.0.0.1:3306",
+                   help="mysql endpoint for -store mysql")
+    f.add_argument("-mysqlUser", default="root")
+    f.add_argument("-mysqlPassword", default="")
+    f.add_argument("-mysqlDatabase", default="seaweedfs")
     f.add_argument("-collection", default="")
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,

@@ -84,7 +84,21 @@ FILL_DIR_T = ctypes.CFUNCTYPE(
 _GETATTR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
                               ctypes.POINTER(Stat))
 _READLINK_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
-                               ctypes.c_char_p, ctypes.c_size_t)
+                               ctypes.POINTER(ctypes.c_char),
+                               ctypes.c_size_t)
+_SETXATTR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_char),
+                               ctypes.c_size_t, ctypes.c_int)
+_GETXATTR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_char),
+                               ctypes.c_size_t)
+_LISTXATTR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_char),
+                                ctypes.c_size_t)
+_REMOVEXATTR_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                                  ctypes.c_char_p)
 _GETDIR_T = ctypes.c_void_p
 _MKNOD_T = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, c_mode_t,
                             c_dev_t)
@@ -142,10 +156,10 @@ class FuseOperations(ctypes.Structure):
         ("flush", _OPEN_T),
         ("release", _OPEN_T),
         ("fsync", _FSYNC_T),
-        ("setxattr", ctypes.c_void_p),
-        ("getxattr", ctypes.c_void_p),
-        ("listxattr", ctypes.c_void_p),
-        ("removexattr", ctypes.c_void_p),
+        ("setxattr", _SETXATTR_T),
+        ("getxattr", _GETXATTR_T),
+        ("listxattr", _LISTXATTR_T),
+        ("removexattr", _REMOVEXATTR_T),
         ("opendir", _OPEN_T),
         ("readdir", _READDIR_T),
         ("releasedir", _OPEN_T),
@@ -216,6 +230,10 @@ class FuseMount:
             ("flush", _OPEN_T), ("release", _OPEN_T),
             ("readdir", _READDIR_T), ("create", _CREATE_T),
             ("utimens", _UTIMENS_T),
+            ("readlink", _READLINK_T), ("symlink", _PATH2_T),
+            ("setxattr", _SETXATTR_T), ("getxattr", _GETXATTR_T),
+            ("listxattr", _LISTXATTR_T),
+            ("removexattr", _REMOVEXATTR_T),
         ]
         for name, ftype in table:
             fn = getattr(ops, name, None)

@@ -79,6 +79,12 @@ class WeedFS:
         if entry.is_directory:
             s.st_mode = stat_mod.S_IFDIR | (mode or 0o755)
             s.st_nlink = 2
+        elif entry.attr.symlink_target:
+            # a symlink's size is its target length (reference
+            # weed/filesys/dir_link.go:36 os.ModeSymlink)
+            s.st_mode = stat_mod.S_IFLNK | (mode or 0o777)
+            s.st_nlink = 1
+            s.st_size = len(entry.attr.symlink_target.encode())
         else:
             s.st_mode = stat_mod.S_IFREG | (mode or 0o644)
             s.st_nlink = 1
@@ -189,6 +195,87 @@ class WeedFS:
             entry.attr.mtime = times[1].tv_sec
         else:
             entry.attr.mtime = time.time()
+        self.client.update_entry(entry)
+        return 0
+
+    # -- symlinks (reference weed/filesys/dir_link.go:15-45) ---------------
+    def symlink(self, target, linkpath):
+        p = self._path(linkpath)
+        now = time.time()
+        entry = Entry(full_path=p,
+                      attr=Attr(mtime=now, crtime=now, mode=0o777))
+        entry.attr.symlink_target = self._path(target)
+        try:
+            self.client.create_entry(entry)
+        except FilerError:
+            # EEXIST only for a genuine duplicate; a transient filer
+            # failure misreported as "File exists" would send the user
+            # chasing a file that isn't there
+            try:
+                self.client.find_entry(p)
+            except (NotFoundError, HttpError):
+                raise OSError(errno.EIO, p)
+            raise OSError(errno.EEXIST, p)
+        return 0
+
+    def readlink(self, path, buf, size):
+        entry = self._entry(self._path(path))
+        target = entry.attr.symlink_target
+        if not target:
+            raise OSError(errno.EINVAL, "not a symlink")
+        # null-terminated, truncated to the buffer (libfuse2 contract)
+        data = target.encode()[:max(0, size - 1)]
+        ctypes.memmove(buf, data, len(data))
+        buf[len(data)] = b"\x00"
+        return 0
+
+    # -- extended attributes (reference weed/filesys/xattr.go) -------------
+    _XATTR_CREATE, _XATTR_REPLACE = 1, 2
+
+    def setxattr(self, path, name, value, size, flags):
+        entry = self._entry(self._path(path))
+        key = self._path(name)
+        exists = key in (entry.extended or {})
+        if flags & self._XATTR_CREATE and exists:
+            raise OSError(errno.EEXIST, key)
+        if flags & self._XATTR_REPLACE and not exists:
+            raise OSError(errno.ENODATA, key)
+        if entry.extended is None:
+            entry.extended = {}
+        entry.extended[key] = ctypes.string_at(value, size) \
+            if size else b""
+        self.client.update_entry(entry)
+        return 0
+
+    def getxattr(self, path, name, buf, size):
+        entry = self._entry(self._path(path))
+        data = (entry.extended or {}).get(self._path(name))
+        if data is None:
+            raise OSError(errno.ENODATA, self._path(name))
+        if size == 0:            # size probe
+            return len(data)
+        if size < len(data):
+            raise OSError(errno.ERANGE, self._path(name))
+        ctypes.memmove(buf, data, len(data))
+        return len(data)
+
+    def listxattr(self, path, buf, size):
+        entry = self._entry(self._path(path))
+        blob = b"".join(k.encode() + b"\x00"
+                        for k in sorted(entry.extended or {}))
+        if size == 0:
+            return len(blob)
+        if size < len(blob):
+            raise OSError(errno.ERANGE, self._path(path))
+        ctypes.memmove(buf, blob, len(blob))
+        return len(blob)
+
+    def removexattr(self, path, name):
+        entry = self._entry(self._path(path))
+        key = self._path(name)
+        if key not in (entry.extended or {}):
+            raise OSError(errno.ENODATA, key)
+        del entry.extended[key]
         self.client.update_entry(entry)
         return 0
 

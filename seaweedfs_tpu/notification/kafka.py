@@ -250,32 +250,46 @@ class KafkaProducer:
         try:
             sock.sendall(struct.pack(">i", len(frame)) + frame)
             (size,) = struct.unpack(">i", self._recv_exact(sock, 4))
-            if size < 4 or size > 1 << 20:
-                raise KafkaError(f"bad ApiVersions size {size}")
-            r = _Reader(self._recv_exact(sock, size))
-            if r.i32() != corr:
-                raise KafkaError("ApiVersions correlation mismatch")
-            err = r.i16()
-            ranges: Dict[int, Tuple[int, int]] = {}
-            for _ in range(r.i32()):
-                api, lo, hi = r.i16(), r.i16(), r.i16()
-                ranges[api] = (lo, hi)
-            if err and err != ERR_UNSUPPORTED_VERSION:
-                raise KafkaError(f"ApiVersions error {err}")
-            # KIP-511: err 35 still carries the supported table
-            if ranges:
-                self._api_ranges[addr] = ranges
-                return
-            raise KafkaError("empty ApiVersions table")
-        except (OSError, KafkaError):
-            # legacy broker: reconnect (it may have severed) and speak
-            # the classic v0 protocol throughout
+            payload = self._recv_exact(sock, size) if 4 <= size <= 1 << 20 \
+                else None
+        except OSError:
+            # TRANSPORT failure only: a pre-KIP-35 broker severs on the
+            # probe — reconnect and speak the classic v0 protocol. The
+            # cache entry dies with the connection (_drop_conn), so a
+            # transient hiccup against a modern broker re-probes on the
+            # next reconnect instead of pinning it to v0.
             self._drop_conn(addr)
             self._api_ranges[addr] = {API_PRODUCE: (0, 0),
                                       API_METADATA: (0, 0)}
             sock = socket.create_connection(addr, timeout=self.timeout)
             sock.settimeout(self.timeout)
             self._conns[addr] = sock
+            return
+        # a broker that ANSWERED but with garbage or an explicit
+        # non-35 error is not a legacy broker — diagnose loudly,
+        # permanently (guessing v0 would just retry-loop into severed
+        # connections with a misleading error)
+        if payload is None:
+            self._drop_conn(addr)
+            raise KafkaError(f"bad ApiVersions response size {size}",
+                             retriable=False)
+        r = _Reader(payload)
+        if r.i32() != corr:
+            self._drop_conn(addr)
+            raise KafkaError("ApiVersions correlation mismatch",
+                             retriable=False)
+        err = r.i16()
+        ranges: Dict[int, Tuple[int, int]] = {}
+        for _ in range(r.i32()):
+            api, lo, hi = r.i16(), r.i16(), r.i16()
+            ranges[api] = (lo, hi)
+        # KIP-511: err 35 still carries the supported table
+        if (err and err != ERR_UNSUPPORTED_VERSION) or not ranges:
+            self._drop_conn(addr)
+            raise KafkaError(
+                f"ApiVersions error {err}, {len(ranges)} entries",
+                retriable=False)
+        self._api_ranges[addr] = ranges
 
     # versions this client can speak, best first
     _SUPPORTED = {API_PRODUCE: (3, 0), API_METADATA: (4, 0)}
@@ -339,7 +353,10 @@ class KafkaProducer:
         while n:
             c = sock.recv(n)
             if not c:
-                raise KafkaError("connection closed")
+                # a TRANSPORT condition, not a protocol verdict: the
+                # ApiVersions probe's legacy-broker fallback and the
+                # retry loop both key on OSError for torn connections
+                raise ConnectionError("connection closed by broker")
             chunks.append(c)
             n -= len(c)
         return b"".join(chunks)

@@ -546,3 +546,66 @@ def test_sqs_publisher_signs_and_posts():
     q = parse_qs(got["body"].decode())
     assert q["Action"] == ["SendMessage"]
     assert json.loads(q["MessageBody"][0])["key"] == "/k"
+
+
+def test_kafka_pre_kip35_broker_falls_back_to_v0():
+    """A broker that severs on the ApiVersions probe (pre-0.10) gets
+    the classic v0 protocol on a fresh connection."""
+    class AncientBroker(FakeBroker):
+        def _client(self, conn):
+            # peek the first request; if it's ApiVersions, sever like
+            # a pre-KIP-35 broker would
+            raw = self._recv(conn, 4)
+            if raw is None:
+                return
+            import struct as _s
+            payload = self._recv(conn, _s.unpack(">i", raw)[0])
+            r = _Reader(payload)
+            api, ver, corr = r.i16(), r.i16(), r.i32()
+            if api == API_VERSIONS:
+                conn.close()
+                return
+            r2 = _Reader(payload)
+            conn2 = conn
+
+            # replay this first request through the normal path
+            def handle(first_payload):
+                rr = _Reader(first_payload)
+                a, v, c = rr.i16(), rr.i16(), rr.i32()
+                rr.string()
+                if a == API_METADATA:
+                    body = self._metadata(v)
+                elif a == API_PRODUCE:
+                    body = self._produce(rr, v)
+                    if body is None:
+                        return True
+                else:
+                    return False
+                import struct as _ss
+                resp = _ss.pack(">i", c) + body
+                conn2.sendall(_ss.pack(">i", len(resp)) + resp)
+                return True
+            try:
+                if not handle(payload):
+                    return
+                while True:
+                    raw = self._recv(conn, 4)
+                    if raw is None:
+                        return
+                    payload = self._recv(conn, _s.unpack(">i", raw)[0])
+                    if payload is None or not handle(payload):
+                        return
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    broker = AncientBroker(topic="events", partitions=1,
+                           produce_range=(0, 0), metadata_range=(0, 0))
+    try:
+        prod = KafkaProducer(f"127.0.0.1:{broker.port}", timeout=5)
+        assert prod.send("events", b"k", b"legacy") >= 0
+        prod.close()
+    finally:
+        broker.stop()
+    assert broker.produced == [(0, b"k", b"legacy")]
