@@ -40,6 +40,12 @@ _CAP_PROTOCOL_41 = 0x200
 _CAP_SECURE_CONNECTION = 0x8000
 _CAP_PLUGIN_AUTH = 0x80000
 
+# server status flag: sql_mode=NO_BACKSLASH_ESCAPES is active — the
+# server treats backslash as a LITERAL inside string literals, so
+# backslash-escaping would both corrupt stored names and reopen
+# injection through quotes (go-sql-driver tracks the same flag)
+SERVER_STATUS_NO_BACKSLASH_ESCAPES = 0x200
+
 
 class MysqlError(Exception):
     """Server ERR packet — not fixable by reconnecting."""
@@ -68,7 +74,13 @@ def _native_password(password: str, nonce: bytes) -> bytes:
     return bytes(a ^ b for a, b in zip(h1, h3))
 
 
-def escape_string(s: str) -> str:
+def escape_string(s: str, no_backslash_escapes: bool = False) -> str:
+    """String-literal escaping for the server's CURRENT sql_mode.
+    Under NO_BACKSLASH_ESCAPES only quote-doubling is valid (and
+    backslashes must stay literal); otherwise the classic backslash
+    scheme."""
+    if no_backslash_escapes:
+        return s.replace("'", "''")
     out = []
     for ch in s:
         if ch in ("'", '"', "\\"):
@@ -100,7 +112,12 @@ class MysqlClient:
         self._sock: Optional[socket.socket] = None
         self._buf = b""
         self._seq = 0
+        self.status = 0   # server status flags (handshake + OK packets)
         self._lock = threading.Lock()
+
+    def escape(self, s: str) -> str:
+        return escape_string(
+            s, bool(self.status & SERVER_STATUS_NO_BACKSLASH_ESCAPES))
 
     # -- packet framing ---------------------------------------------------
 
@@ -169,7 +186,10 @@ class MysqlClient:
         pos += 2
         plugin = "mysql_native_password"
         if len(greeting) > pos:
-            pos += 1 + 2                            # charset, status
+            pos += 1                                # charset
+            self.status = int.from_bytes(greeting[pos:pos + 2],
+                                         "little")
+            pos += 2
             caps |= int.from_bytes(greeting[pos:pos + 2],
                                    "little") << 16
             pos += 2
@@ -214,6 +234,7 @@ class MysqlClient:
             raise MysqlError(self._err_text(pkt))
         if pkt[:1] != b"\x00":
             raise MysqlError(f"unexpected auth reply {pkt[:1]!r}")
+        self._parse_ok(pkt)
 
     @staticmethod
     def _err_text(pkt: bytes) -> str:
@@ -255,6 +276,15 @@ class MysqlClient:
                 self._connect()
                 return self._query_once(sql)
 
+    def _parse_ok(self, pkt: bytes) -> int:
+        """OK packet: affected rows; tracks the server status flags
+        (sql_mode changes like NO_BACKSLASH_ESCAPES ride here)."""
+        affected, pos = self._lenenc(pkt, 1)
+        _, pos = self._lenenc(pkt, pos)  # last insert id
+        if pos + 2 <= len(pkt):
+            self.status = int.from_bytes(pkt[pos:pos + 2], "little")
+        return affected
+
     def _query_once(self, sql: str):
         self._seq = 0
         self._send_packet(b"\x03" + sql.encode())
@@ -262,8 +292,7 @@ class MysqlClient:
         if pkt[:1] == b"\xff":
             raise MysqlError(self._err_text(pkt))
         if pkt[:1] == b"\x00":
-            affected, _ = self._lenenc(pkt, 1)
-            return affected
+            return self._parse_ok(pkt)
         ncols, _ = self._lenenc(pkt, 0)
         for _ in range(ncols):
             self._recv_packet()  # column definitions (unused)
@@ -313,7 +342,11 @@ class MysqlStore(FilerStore):
 
     CREATE = ("CREATE TABLE IF NOT EXISTS filemeta ("
               "dirhash BIGINT, name VARCHAR(1000), directory TEXT, "
-              "meta LONGBLOB, PRIMARY KEY (dirhash, name))")
+              "meta LONGBLOB, PRIMARY KEY (dirhash, name), "
+              # recursive deletes predicate on directory; without a
+              # prefix index they would full-scan (and row-lock) the
+              # whole table
+              "KEY directory_prefix (directory(255)))")
 
     def initialize(self, addr: str = "127.0.0.1:3306", user: str = "root",
                    password: str = "", database: str = "seaweedfs",
@@ -336,10 +369,11 @@ class MysqlStore(FilerStore):
     def _upsert(self, entry: Entry):
         dirhash, name, d = self._split(entry.full_path)
         meta = entry.encode()
+        esc = self._client.escape
         self._client.query(
             "INSERT INTO filemeta (dirhash,name,directory,meta) VALUES "
-            f"({dirhash},'{escape_string(name)}',"
-            f"'{escape_string(d)}',X'{meta.hex()}') "
+            f"({dirhash},'{esc(name)}',"
+            f"'{esc(d)}',X'{meta.hex()}') "
             "ON DUPLICATE KEY UPDATE directory=VALUES(directory),"
             "meta=VALUES(meta)")
 
@@ -355,24 +389,26 @@ class MysqlStore(FilerStore):
 
     def find_entry(self, full_path: str) -> Optional[Entry]:
         dirhash, name, d = self._split(full_path)
+        esc = self._client.escape
         rows = self._client.query(
             "SELECT meta FROM filemeta WHERE "
-            f"dirhash={dirhash} AND name='{escape_string(name)}' "
-            f"AND directory='{escape_string(d)}'")
+            f"dirhash={dirhash} AND name='{esc(name)}' "
+            f"AND directory='{esc(d)}'")
         if not rows or rows[0][0] is None:
             return None
         return Entry.decode(full_path, rows[0][0])
 
     def delete_entry(self, full_path: str) -> None:
         dirhash, name, d = self._split(full_path)
+        esc = self._client.escape
         self._client.query(
             "DELETE FROM filemeta WHERE "
-            f"dirhash={dirhash} AND name='{escape_string(name)}' "
-            f"AND directory='{escape_string(d)}'")
+            f"dirhash={dirhash} AND name='{esc(name)}' "
+            f"AND directory='{esc(d)}'")
 
     def delete_folder_children(self, full_path: str) -> None:
         base = full_path.rstrip("/") or "/"
-        esc = escape_string(base)
+        esc = self._client.escape(base)
         # LIKE-level escaping FIRST (backslash, %, _ are pattern
         # metacharacters), THEN string-literal escaping — a path
         # containing a backslash would otherwise match (and delete)
@@ -380,7 +416,7 @@ class MysqlStore(FilerStore):
         like_raw = base.rstrip("/")
         like_raw = like_raw.replace("\\", "\\\\") \
             .replace("%", "\\%").replace("_", "\\_")
-        like = escape_string(like_raw)
+        like = self._client.escape(like_raw)
         self._client.query(
             "DELETE FROM filemeta WHERE "
             f"directory='{esc}' OR directory LIKE '{like}/%'")
@@ -391,11 +427,12 @@ class MysqlStore(FilerStore):
         d = dir_path.rstrip("/") or "/"
         dirhash = hash_string_to_long(d)
         op = ">=" if inclusive else ">"
+        esc = self._client.escape
         rows = self._client.query(
             "SELECT name, meta FROM filemeta WHERE "
             f"dirhash={dirhash} AND name{op}"
-            f"'{escape_string(start_file_name)}' "
-            f"AND directory='{escape_string(d)}' "
+            f"'{esc(start_file_name)}' "
+            f"AND directory='{esc(d)}' "
             f"ORDER BY name ASC LIMIT {int(limit)}")
         base = d.rstrip("/")
         return [Entry.decode(f"{base}/{name.decode()}", meta)

@@ -257,13 +257,16 @@ class KafkaProducer:
             # probe — reconnect and speak the classic v0 protocol. The
             # cache entry dies with the connection (_drop_conn), so a
             # transient hiccup against a modern broker re-probes on the
-            # next reconnect instead of pinning it to v0.
+            # next reconnect instead of pinning it to v0. The v0 pin is
+            # written only AFTER the reconnect succeeds — a failed
+            # reconnect must leave no cache for the next attempt to
+            # skip the probe on.
             self._drop_conn(addr)
-            self._api_ranges[addr] = {API_PRODUCE: (0, 0),
-                                      API_METADATA: (0, 0)}
             sock = socket.create_connection(addr, timeout=self.timeout)
             sock.settimeout(self.timeout)
             self._conns[addr] = sock
+            self._api_ranges[addr] = {API_PRODUCE: (0, 0),
+                                      API_METADATA: (0, 0)}
             return
         # a broker that ANSWERED but with garbage or an explicit
         # non-35 error is not a legacy broker — diagnose loudly,

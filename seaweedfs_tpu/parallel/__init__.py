@@ -8,6 +8,7 @@ rebuild), multi-host over DCN via the same mesh axes.
 """
 
 from .mesh import make_mesh  # noqa: F401
+from .multihost import init_distributed, multihost_ec_step  # noqa: F401
 from .sharded_ec import (  # noqa: F401
     sharded_encode_fn, sharded_rebuild_fn, distributed_ec_step,
 )

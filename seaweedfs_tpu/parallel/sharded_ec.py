@@ -37,6 +37,22 @@ def _pad_rows(mat: np.ndarray, mult: int) -> np.ndarray:
         [mat, np.zeros((pad, mat.shape[1]), dtype=mat.dtype)], axis=0)
 
 
+def encode_in_specs(mesh, m: int):
+    """The PartitionSpecs sharded_encode_fn declares for its inputs
+    (bitmat, data). Multi-process callers must BUILD their global
+    arrays with exactly these (jit refuses mismatched committed inputs
+    across processes) — one definition, used by both sides."""
+    from jax.sharding import PartitionSpec as P
+    bm_cols = "shard" if (m * 8) % mesh.shape["shard"] == 0 else None
+    return P(None, bm_cols), P(None, "data")
+
+
+def rebuild_in_specs(mesh):
+    """PartitionSpecs for sharded_rebuild_fn's (bitmat_dec, survivors)."""
+    from jax.sharding import PartitionSpec as P
+    return P("shard", None), P(None, "data")
+
+
 def sharded_encode_fn(mesh, k: int, m: int, n: int):
     """Returns (jitted_fn, bitmat) for distributed encode.
 
@@ -64,11 +80,11 @@ def sharded_encode_fn(mesh, k: int, m: int, n: int):
     # the output replicates across that axis (the matmul itself still
     # partitions over 'data')
     out_rows = "shard" if m % mesh.shape["shard"] == 0 else None
-    bm_cols = "shard" if (m * 8) % mesh.shape["shard"] == 0 else None
+    bm_spec, data_spec = encode_in_specs(mesh, m)
     jfn = jax.jit(
         fn,
-        in_shardings=(NamedSharding(mesh, P(None, bm_cols)),
-                      NamedSharding(mesh, P(None, "data"))),
+        in_shardings=(NamedSharding(mesh, bm_spec),
+                      NamedSharding(mesh, data_spec)),
         out_shardings=NamedSharding(mesh, P(out_rows, "data")))
     return jfn, bitmat
 
@@ -115,10 +131,11 @@ def sharded_rebuild_fn(mesh, k: int, n_out_shards: int, n: int):
         x = jnp.pad(x, ((0, k8p - k * 8), (0, 0)))
         return smap(bitmat_dec, x)
 
+    bm_spec, surv_spec = rebuild_in_specs(mesh)
     return jax.jit(
         fn,
-        in_shardings=(NamedSharding(mesh, P("shard", None)),
-                      NamedSharding(mesh, P(None, "data"))),
+        in_shardings=(NamedSharding(mesh, bm_spec),
+                      NamedSharding(mesh, surv_spec)),
         out_shardings=NamedSharding(mesh, P(None, "data")))
 
 

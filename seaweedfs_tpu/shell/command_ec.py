@@ -167,7 +167,12 @@ def ec_rebuild(env: CommandEnv, args: List[str]):
 
 
 def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
-                  shards: Dict[int, List[str]], missing: List[int]):
+                  shards: Dict[int, List[str]], missing: List[int],
+                  timings: Dict[str, float] = None):
+    """`timings`, when given, records the phase walls (gather = parallel
+    survivor pulls, compute = the GF rebuild on the rebuilder, mount) —
+    the benchmark's overlap accounting for BASELINE config 5."""
+    import time as _time
     # pick the node with most free slots as rebuilder (reference
     # command_ec_rebuild.go: pick by free slot count)
     rebuilder = _free_nodes(env)[0]["url"]
@@ -188,19 +193,31 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
                       f"&copy_ecx={'true' if with_ecx else 'false'}")
 
     jobs = [(item, (not local) and i == 0) for i, item in enumerate(to_copy)]
+    t0 = _time.perf_counter()
     fan_out_must_succeed(pull, jobs,
                          what=f"survivor shard copy for volume {vid}",
                          dedicated=True)
+    t1 = _time.perf_counter()
     # rebuild + mount only the previously-missing shards
     out = env.node_post(rebuilder,
                         f"/admin/ec/rebuild?volume={vid}"
                         f"&collection={collection}")
+    t2 = _time.perf_counter()
+    if timings is not None:
+        timings["gather_s"] = timings.get("gather_s", 0) + (t1 - t0)
+        timings["compute_s"] = timings.get("compute_s", 0) + (t2 - t1)
+        timings["gathered_shards"] = \
+            timings.get("gathered_shards", 0) + len(to_copy)
     rebuilt = out.get("rebuilt", [])
     if rebuilt:
+        t3 = _time.perf_counter()
         env.node_post(rebuilder,
                       f"/admin/ec/mount?volume={vid}"
                       f"&collection={collection}"
                       f"&shards={','.join(map(str, rebuilt))}")
+        if timings is not None:
+            timings["mount_s"] = timings.get("mount_s", 0) + \
+                (_time.perf_counter() - t3)
     # clean up temp survivor copies (not mounted here)
     if copied:
         env.node_post(rebuilder,

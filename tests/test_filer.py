@@ -231,9 +231,13 @@ class FakeMysql:
 
     USER, PASSWORD = "weed", "sekrit"
 
-    def __init__(self):
+    def __init__(self, nbe=False):
         import socket
         import threading
+        # nbe: advertise sql_mode=NO_BACKSLASH_ESCAPES in the status
+        # flags; the executor then expects quote-doubled literals with
+        # LITERAL backslashes (what a real server in that mode parses)
+        self.nbe = nbe
         self.rows = {}  # (dirhash, name) -> (directory, meta bytes)
         self.lock = threading.Lock()
         self.auth_failures = 0
@@ -298,7 +302,15 @@ class FakeMysql:
             return b"\xfd" + n.to_bytes(3, "little")
         return b"\xfe" + n.to_bytes(8, "little")
 
-    _OK = b"\x00\x01\x00\x02\x00\x00\x00"
+    @property
+    def _status(self):
+        return 2 | (0x200 if self.nbe else 0)
+
+    @property
+    def _OK(self):
+        import struct as _s
+        return b"\x00\x01\x00" + _s.pack("<H", self._status) + b"\x00\x00"
+
     _EOF = b"\xfe\x00\x00\x02\x00"
 
     def _client(self, conn):
@@ -311,7 +323,7 @@ class FakeMysql:
             hs = (b"\x0a" + b"5.7.0-fake\x00"
                   + struct.pack("<I", 7) + nonce[:8] + b"\x00"
                   + struct.pack("<H", caps & 0xFFFF) + b"\x21"
-                  + struct.pack("<H", 2)
+                  + struct.pack("<H", self._status)
                   + struct.pack("<H", caps >> 16) + bytes([21])
                   + b"\x00" * 10 + nonce[8:] + b"\x00"
                   + b"mysql_native_password\x00")
@@ -347,8 +359,10 @@ class FakeMysql:
 
     # -- sql executor ------------------------------------------------------
 
-    @staticmethod
-    def _unescape(s):
+    def _unescape(self, s):
+        if self.nbe:
+            # NO_BACKSLASH_ESCAPES: backslash is literal, '' is a quote
+            return s.replace("''", "'")
         out, i = [], 0
         while i < len(s):
             ch = s[i]
@@ -362,7 +376,10 @@ class FakeMysql:
                 i += 1
         return "".join(out)
 
-    _STR = r"'((?:[^'\\]|\\.)*)'"
+    @property
+    def _STR(self):
+        return r"'((?:''|[^'])*)'" if self.nbe \
+            else r"'((?:[^'\\]|\\.)*)'"
 
     def _query(self, conn, sql):
         import re
@@ -807,3 +824,34 @@ class TestMysqlStore:
         assert s.find_entry("/a%b/keep2") is None
         assert s.find_entry("/ab/keep") is not None
         s.close()
+
+    def test_no_backslash_escapes_mode(self):
+        """A server running sql_mode=NO_BACKSLASH_ESCAPES treats
+        backslash as a literal: the client must switch to
+        quote-doubling (tracked via the status flags) or hostile names
+        become injection/breakage (go-sql-driver handles the same
+        flag)."""
+        from seaweedfs_tpu.filer import MysqlStore
+        srv = FakeMysql(nbe=True)
+        try:
+            s = MysqlStore()
+            s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                         password=srv.PASSWORD)
+            nasty = ["it's", "x',0x00),(0,'y", "back\\slash",
+                     'qu"ote', "tri'''ple"]
+            for i, name in enumerate(nasty):
+                e = Entry(full_path=f"/nbe/{name}")
+                e.attr.mime = f"m{i}"
+                s.insert_entry(e)
+            # exactly the inserted rows exist — the crafted name did
+            # NOT inject extra rows
+            assert len(srv.rows) == len(nasty)
+            for i, name in enumerate(nasty):
+                assert s.find_entry(f"/nbe/{name}").attr.mime == f"m{i}"
+            got = s.list_directory_entries("/nbe", "", True, 100)
+            assert sorted(x.name for x in got) == sorted(nasty)
+            s.delete_folder_children("/nbe")
+            assert len(srv.rows) == 0
+            s.close()
+        finally:
+            srv.stop()
