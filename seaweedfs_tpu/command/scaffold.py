@@ -95,7 +95,12 @@ SCAFFOLDS = {
 //              hosts ("h1:9092,h2:9092"), topic, timeout, retries
 //   "aws_sqs"  SendMessage via the SQS query API (SigV4), options:
 //              queue_url, access_key, secret_key, region
-//   google_pub_sub/gocdk_pub_sub remain gated stubs (need OAuth2)
+//   "google_pub_sub"  REST publish with OAuth2 JWT-bearer auth
+//              (no SDK), options: google_application_credentials
+//              (service-account json), project_id, topic,
+//              endpoint/token_uri overrides for emulators
+//   gocdk_pub_sub remains a gated stub (its concrete brokers all
+//   have native publishers above)
 {}
 """,
     "filer": """\

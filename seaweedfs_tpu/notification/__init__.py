@@ -4,13 +4,15 @@ Reference weed/notification/: a MessageQueue interface with
 implementations selected by notification.toml (kafka, aws_sqs,
 google_pub_sub, gocdk_pub_sub, log). Here: `log` (stderr/file),
 `memory` (in-process, for tests and the replicator), `webhook`
-(JSON POST), `kafka` (from-scratch classic-protocol producer,
-notification/kafka.py) and `aws_sqs` (SigV4-signed SendMessage) are
-real; the OAuth2-gated pubsub publishers are registered stubs that
-raise on use so config errors surface the same way the reference's
-missing-broker errors do.
+(JSON POST), `kafka` (version-negotiated wire producer,
+notification/kafka.py), `aws_sqs` (SigV4-signed SendMessage) and
+`google_pub_sub` (from-scratch OAuth2 JWT-bearer + RS256 + REST
+publish, google_pub_sub.py) are real; the gocdk meta-backend stays a
+registered stub that raises on use so config errors surface the same
+way the reference's missing-broker errors do.
 """
 
+from .google_pub_sub import GooglePubSubPublisher  # noqa: F401
 from .queues import (  # noqa: F401
     PUBLISHERS,
     KafkaPublisher,

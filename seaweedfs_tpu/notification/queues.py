@@ -95,8 +95,10 @@ def _post_with_retries(url: str, body: bytes, headers: dict,
             last = e
         if attempt + 1 < retries:
             _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+    # chain the last HttpError so callers can classify by status
+    # (google_pub_sub re-auths on 401)
     raise RuntimeError(f"{label} {url} failed after "
-                       f"{attempt + 1} attempts: {last}")
+                       f"{attempt + 1} attempts: {last}") from last
 
 
 @register
@@ -215,10 +217,11 @@ class SqsPublisher(Publisher):
 
 
 class StubPublisher(Publisher):
-    """Placeholder for cloud brokers whose auth stack is not present in
-    this environment (google_pub_sub/gocdk_pub_sub need OAuth2 service
-    accounts). Configuring one fails at first send with an actionable
-    error, mirroring how the reference fails when the broker endpoint is
+    """Placeholder for meta-backends with nothing concrete to wrap
+    (gocdk_pub_sub points at whichever broker gocdk is configured
+    for — kafka/SQS/pubsub all have native publishers here).
+    Configuring one fails at first send with an actionable error,
+    mirroring how the reference fails when the broker endpoint is
     unreachable."""
 
     def send(self, key: str, event: dict) -> None:
@@ -227,5 +230,9 @@ class StubPublisher(Publisher):
             f"broker that is not available in this environment")
 
 
-for _name in ("google_pub_sub", "gocdk_pub_sub"):
+# google_pub_sub is REAL now (google_pub_sub.py: from-scratch OAuth2
+# JWT-bearer + RS256 + REST publish); only the gocdk meta-backend stays
+# a stub (it exists to wrap whichever broker gocdk points at — every
+# concrete broker here already has a native publisher)
+for _name in ("gocdk_pub_sub",):
     register(type(f"Stub_{_name}", (StubPublisher,), {"name": _name}))

@@ -779,7 +779,8 @@ void be64_store(uint8_t* p, uint64_t v) {
 // -4 cookie mismatch.
 int64_t do_append(VolumeRec* vol, Writer* w, const uint8_t* blob,
                   int64_t len, uint64_t key, uint32_t size_field,
-                  bool check_cookie, uint32_t cookie) {
+                  bool check_cookie, uint32_t cookie,
+                  int64_t* freed_out = nullptr) {
   std::lock_guard<std::mutex> g(w->mu);
   if (w->fd < 0) return -1;
   int64_t tail = w->tail.load(std::memory_order_relaxed);
@@ -844,6 +845,7 @@ int64_t do_append(VolumeRec* vol, Writer* w, const uint8_t* blob,
         vol->index.erase(it);
         w->deletes++;
         w->deleted_bytes += old_size;
+        if (freed_out) *freed_out = old_size;
       }
     } else {
       vol->index[key] = {static_cast<uint64_t>(off), size_field};
@@ -1123,6 +1125,96 @@ void serve_write(Server* s, int fd, const Request& req,
   s->written++;
 }
 
+// Plain needle DELETE on the fast path: tombstone append under the
+// same write lease (storage/volume.py delete_needle; reference
+// volume_server_handlers_write.go DeleteHandler). Chunk-manifest
+// needles redirect — the cascade to chunk needles is Python's.
+void serve_delete(Server* s, int fd, const Request& req, uint32_t vid,
+                  uint64_t key, uint32_t cookie) {
+  auto vol = s->find(vid);
+  if (!vol) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  auto w = vol->get_writer();
+  if (!w || !w->accept_posts.load(std::memory_order_acquire) ||
+      vol->version == 1) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  uint64_t off = 0;
+  uint32_t size = 0;
+  {
+    std::shared_lock<std::shared_mutex> l(vol->mu);
+    auto it = vol->index.find(key);
+    if (it != vol->index.end()) {
+      off = it->second.first;
+      size = it->second.second;
+    }
+  }
+  if (off == 0 || size == kTombstoneSize) {
+    // already gone: Python answers freed=0 (goal state, not an error)
+    respond_simple(fd, 200, "OK", "{\"size\": 0}", req.keepalive, "",
+                   "application/json");
+    return;
+  }
+  if (size > 0) {
+    // manifest probe via two tiny preads (volume.read_needle_flags)
+    uint8_t ds_raw[4];
+    if (pread(vol->fd, ds_raw, 4, static_cast<off_t>(off + 16)) == 4) {
+      uint32_t ds = be32(ds_raw);
+      uint8_t flags = 0;
+      if (ds < size &&
+          pread(vol->fd, &flags, 1,
+                static_cast<off_t>(off + 16 + 4 + ds)) == 1 &&
+          (flags & kFlagChunkManifest)) {
+        redirect_to_fallback(s, fd, req);
+        return;
+      }
+    }
+  }
+  // tombstone record: empty body, crc of empty data, now-stamped
+  size_t len = vol->version == 3 ? 32 : 24;
+  uint8_t blob[32] = {0};
+  be32_store(blob, cookie);
+  be64_store(blob + 4, key);
+  be32_store(blob + 12, 0);
+  be32_store(blob + 16, masked_crc(crc32c(nullptr, 0)));
+  if (vol->version == 3) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    be64_store(blob + 20, static_cast<uint64_t>(ts.tv_sec) *
+                              1000000000ull +
+                          static_cast<uint64_t>(ts.tv_nsec));
+  }
+  int64_t freed = 0;
+  int64_t rc = do_append(vol.get(), w.get(), blob,
+                         static_cast<int64_t>(len), key, kTombstoneSize,
+                         /*check_cookie=*/true, cookie, &freed);
+  if (rc == -4) {
+    respond_simple(fd, 500, "Internal Server Error",
+                   "{\"error\": \"needle " + std::to_string(key) +
+                       ": mismatching cookie on delete\"}",
+                   req.keepalive, "", "application/json");
+    return;
+  }
+  if (rc == -2 || rc == -1) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  if (rc < 0) {
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error",
+                   "{\"error\": \"delete failed\"}", req.keepalive, "",
+                   "application/json");
+    return;
+  }
+  respond_simple(fd, 200, "OK",
+                 "{\"size\": " + std::to_string(freed) + "}",
+                 req.keepalive, "", "application/json");
+  s->written++;
+}
+
 void handle_conn(Server* s, int fd) {
   struct timeval tv = {30, 0};
   setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
@@ -1205,6 +1297,8 @@ void handle_conn(Server* s, int fd) {
       } else {
         redirect_to_fallback(s, fd, req);
       }
+    } else if (req.method == "DELETE" && fid_ok) {
+      serve_delete(s, fd, req, vid, key, cookie);
     } else {
       redirect_to_fallback(s, fd, req);
     }

@@ -524,3 +524,68 @@ def test_plane_no_lease_under_jwt(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+class TestFastPathDeletes:
+    def test_delete_roundtrip_and_counters(self, cluster):
+        """DELETE on the fast port: tombstone under the lease, freed
+        size in the response like Python, both planes 404 after,
+        counters agree with a reloaded needle map."""
+        master, vs = cluster
+        a = assign(master)
+        vid = int(a["fid"].split(",")[0])
+        body, ctype = multipart_body("d", b"x" * 100)
+        assert raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                           {"Content-Type": ctype})[0] == 200
+        st, _, raw = raw_request(vs.fast_url, "DELETE", f"/{a['fid']}")
+        assert st == 200
+        assert json.loads(raw)["size"] > 0
+        for port in (vs.url, vs.fast_url):
+            assert raw_request(port, "GET", f"/{a['fid']}")[0] in \
+                (404, 307)
+        # idempotent: second delete answers freed=0
+        st, _, raw = raw_request(vs.fast_url, "DELETE", f"/{a['fid']}")
+        assert st == 200 and json.loads(raw)["size"] == 0
+        v = vs.store.find_volume(vid)
+        with v.lock:
+            before = (v.file_count(), v.deleted_count())
+            vs._writer_release(v)
+            after = (v.file_count(), v.deleted_count())
+        assert before == after
+        vs._fast_sync(vid)
+
+    def test_delete_wrong_cookie_500(self, cluster):
+        master, vs = cluster
+        a = assign(master)
+        body, ctype = multipart_body("d", b"keep-me")
+        raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                    {"Content-Type": ctype})
+        vid, key, cookie = parse_file_id(a["fid"])
+        bad = f"{vid},{key:x}{(cookie + 1) & 0xFFFFFFFF:08x}"
+        st, _, raw = raw_request(vs.fast_url, "DELETE", f"/{bad}")
+        assert st == 500
+        assert "mismatching cookie" in json.loads(raw)["error"]
+        assert http_call("GET", f"http://{vs.url}/{a['fid']}") \
+            == b"keep-me"
+
+    def test_delete_manifest_redirects_and_cascades(self, cluster):
+        """A chunk-manifest delete must cascade to the chunk needles —
+        Python's job; the plane hands it over."""
+        master, vs = cluster
+        chunk_a = assign(master)
+        body, ctype = multipart_body("c", b"chunk-bytes")
+        raw_request(vs.fast_url, "POST", f"/{chunk_a['fid']}", body,
+                    {"Content-Type": ctype})
+        manifest = {"name": "big", "chunks": [
+            {"fid": chunk_a["fid"], "offset": 0, "size": 11}]}
+        man = assign(master)
+        post_multipart(
+            f"http://{vs.url}/{man['fid']}?cm=true", "big",
+            json.dumps(manifest).encode())
+        st, hdrs, _ = raw_request(vs.fast_url, "DELETE",
+                                  f"/{man['fid']}")
+        assert st == 307
+        http_call("DELETE", f"http://{vs.fast_url}/{man['fid']}")
+        for fid in (man["fid"], chunk_a["fid"]):
+            with pytest.raises(HttpError):
+                http_call("GET", f"http://{vs.url}/{fid}")

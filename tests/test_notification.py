@@ -72,8 +72,8 @@ def test_log_publisher_writes():
 
 
 def test_stub_publisher_raises():
-    p = make_publisher("google_pub_sub")
-    with pytest.raises(RuntimeError, match="google_pub_sub"):
+    p = make_publisher("gocdk_pub_sub")
+    with pytest.raises(RuntimeError, match="gocdk_pub_sub"):
         p.send("/k", {})
 
 
@@ -609,3 +609,214 @@ def test_kafka_pre_kip35_broker_falls_back_to_v0():
     finally:
         broker.stop()
     assert broker.produced == [(0, b"k", b"legacy")]
+
+
+# -- Google Pub/Sub publisher (notification/google_pub_sub.py) -------------
+
+import base64  # noqa: E402
+import subprocess  # noqa: E402
+import tempfile  # noqa: E402
+import os  # noqa: E402
+
+
+def _make_service_account(tmpdir):
+    """A real RSA keypair (openssl) wrapped as a service-account json."""
+    key = os.path.join(tmpdir, "sa.key")
+    out = subprocess.run(
+        ["openssl", "genpkey", "-algorithm", "RSA",
+         "-pkeyopt", "rsa_keygen_bits:2048", "-out", key],
+        capture_output=True)
+    if out.returncode != 0:
+        pytest.skip(f"openssl unavailable: {out.stderr[:100]}")
+    pub = subprocess.run(["openssl", "pkey", "-in", key, "-pubout"],
+                         capture_output=True, check=True)
+    sa_path = os.path.join(tmpdir, "sa.json")
+    with open(sa_path, "w") as f:
+        json.dump({
+            "type": "service_account",
+            "project_id": "proj-1",
+            "client_email": "weed@proj-1.iam.gserviceaccount.com",
+            "private_key": open(key).read(),
+            "token_uri": "http://OVERRIDDEN/token",
+        }, f)
+    return sa_path, key, pub.stdout
+
+
+class FakePubSub:
+    """In-process HTTP stand-in for oauth2.googleapis.com +
+    pubsub.googleapis.com: VERIFIES the JWT-bearer grant's RS256
+    signature against the service account's public half, issues a
+    bearer token, and accepts :publish only with that token."""
+
+    def __init__(self, key_pem: str):
+        import http.server
+        import threading
+        from seaweedfs_tpu.notification.google_pub_sub import (
+            RsaPrivateKey, _SHA256_PREFIX)
+        self.key = RsaPrivateKey.from_pem(key_pem)
+        self.prefix = _SHA256_PREFIX
+        self.token = "fake-bearer-token-1"
+        self.published = []
+        self.auth_failures = []
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                import hashlib as _h
+                from urllib.parse import parse_qs
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if self.path == "/token":
+                    assertion = parse_qs(body.decode())["assertion"][0]
+                    h, c, s = assertion.split(".")
+                    sig = base64.urlsafe_b64decode(s + "==")
+                    em = pow(int.from_bytes(sig, "big"), fake.key.e,
+                             fake.key.n).to_bytes(fake.key.size, "big")
+                    digest = _h.sha256(f"{h}.{c}".encode()).digest()
+                    want_tail = fake.prefix + digest
+                    ok = em[:2] == b"\x00\x01" and \
+                        em.endswith(b"\x00" + want_tail)
+                    claims = json.loads(
+                        base64.urlsafe_b64decode(c + "=="))
+                    if not ok:
+                        fake.auth_failures.append("bad signature")
+                        self._json(401, {"error": "invalid_grant"})
+                        return
+                    if "pubsub" not in claims.get("scope", ""):
+                        fake.auth_failures.append("bad scope")
+                        self._json(401, {"error": "invalid_scope"})
+                        return
+                    self._json(200, {"access_token": fake.token,
+                                     "expires_in": 3600,
+                                     "token_type": "Bearer"})
+                    return
+                if self.path.endswith(":publish"):
+                    if self.headers.get("Authorization") != \
+                            f"Bearer {fake.token}":
+                        fake.auth_failures.append("bad bearer")
+                        self._json(401, {"error": "unauthenticated"})
+                        return
+                    req = json.loads(body)
+                    for msg in req["messages"]:
+                        fake.published.append(
+                            (self.path,
+                             msg["attributes"]["key"],
+                             base64.b64decode(msg["data"])))
+                    self._json(200, {"messageIds": [
+                        str(i) for i, _ in enumerate(req["messages"])]})
+                    return
+                self._json(404, {"error": "not found"})
+
+        self.httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_pubsub_rs256_verified_by_openssl(tmp_path):
+    """The from-scratch RS256 must verify under openssl — an
+    independent implementation, not our own math twice."""
+    from seaweedfs_tpu.notification.google_pub_sub import (
+        RsaPrivateKey, rs256_sign)
+    sa_path, key_path, pub_pem = _make_service_account(str(tmp_path))
+    sa = json.load(open(sa_path))
+    key = RsaPrivateKey.from_pem(sa["private_key"])
+    data = b"jwt-signing-input.abc123"
+    sig = rs256_sign(key, data)
+    (tmp_path / "data.bin").write_bytes(data)
+    (tmp_path / "sig.bin").write_bytes(sig)
+    (tmp_path / "pub.pem").write_bytes(pub_pem)
+    out = subprocess.run(
+        ["openssl", "dgst", "-sha256", "-verify",
+         str(tmp_path / "pub.pem"), "-signature",
+         str(tmp_path / "sig.bin"), str(tmp_path / "data.bin")],
+        capture_output=True, text=True)
+    assert out.returncode == 0 and "Verified OK" in out.stdout, out
+
+
+def test_pubsub_publish_end_to_end(tmp_path):
+    sa_path, key_path, _ = _make_service_account(str(tmp_path))
+    sa = json.load(open(sa_path))
+    fake = FakePubSub(sa["private_key"])
+    try:
+        p = make_publisher(
+            "google_pub_sub",
+            google_application_credentials=sa_path,
+            topic="weed-events",
+            endpoint=f"http://127.0.0.1:{fake.port}",
+            token_uri=f"http://127.0.0.1:{fake.port}/token")
+        p.send("/dir/file1", {"new_entry": {"name": "file1"}})
+        p.send("/dir/file2", {"deleted": True})
+        assert fake.auth_failures == []
+        assert len(fake.published) == 2
+        path, key, data = fake.published[0]
+        assert path == "/v1/projects/proj-1/topics/weed-events:publish"
+        assert key == "/dir/file1"
+        assert json.loads(data)["new_entry"]["name"] == "file1"
+        # the bearer token is cached: 2 publishes, 1 token grant
+    finally:
+        fake.stop()
+
+
+def test_pubsub_rejects_wrong_key(tmp_path):
+    """A tampered/unmatched key must be REJECTED by the token server —
+    proving the fake actually checks the signature (and therefore that
+    the positive test means something)."""
+    sa_path, _, _ = _make_service_account(str(tmp_path))
+    os.makedirs(str(tmp_path / "o"), exist_ok=True)
+    other_sa, _, _ = _make_service_account(str(tmp_path / "o"))
+    sa = json.load(open(sa_path))
+    fake = FakePubSub(sa["private_key"])
+    try:
+        # publisher signs with a DIFFERENT key than the fake verifies
+        p = make_publisher(
+            "google_pub_sub",
+            google_application_credentials=other_sa,
+            project_id="proj-1", topic="t",
+            endpoint=f"http://127.0.0.1:{fake.port}",
+            token_uri=f"http://127.0.0.1:{fake.port}/token")
+        with pytest.raises(Exception):
+            p.send("/k", {})
+        assert "bad signature" in fake.auth_failures
+        assert fake.published == []
+    finally:
+        fake.stop()
+
+
+def test_pubsub_reauths_on_revoked_token(tmp_path):
+    """Server-side token revocation (key rotation, emulator restart)
+    must trigger one re-auth on 401 instead of dropping every event
+    until the ~55-minute local expiry."""
+    sa_path, _, _ = _make_service_account(str(tmp_path))
+    sa = json.load(open(sa_path))
+    fake = FakePubSub(sa["private_key"])
+    try:
+        p = make_publisher(
+            "google_pub_sub",
+            google_application_credentials=sa_path,
+            topic="t",
+            endpoint=f"http://127.0.0.1:{fake.port}",
+            token_uri=f"http://127.0.0.1:{fake.port}/token")
+        p.send("/a", {"n": 1})
+        # revoke: the fake now only accepts a NEW token value
+        fake.token = "rotated-token-2"
+        p.send("/b", {"n": 2})
+        assert [k for _, k, _ in fake.published] == ["/a", "/b"]
+        assert fake.auth_failures == ["bad bearer"]  # one 401, then ok
+    finally:
+        fake.stop()
