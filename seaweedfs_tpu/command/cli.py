@@ -204,6 +204,11 @@ def cmd_filer(args):
                          "user": args.mysqlUser,
                          "password": args.mysqlPassword,
                          "database": args.mysqlDatabase}
+    elif args.store == "postgres":
+        store_options = {"addr": args.postgresAddr,
+                         "user": args.postgresUser,
+                         "password": args.postgresPassword,
+                         "database": args.postgresDatabase}
     else:
         store_options = {}
     f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
@@ -831,7 +836,7 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
                    choices=["memory", "sqlite", "sharded", "redis",
-                            "mysql"])
+                            "mysql", "postgres"])
     f.add_argument("-db", default="./filer.db",
                    help="metadata path: a sqlite file, or a directory "
                         "of shard dbs for -store sharded (default "
@@ -848,6 +853,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-mysqlUser", default="root")
     f.add_argument("-mysqlPassword", default="")
     f.add_argument("-mysqlDatabase", default="seaweedfs")
+    f.add_argument("-postgresAddr", default="127.0.0.1:5432",
+                   help="postgres endpoint for -store postgres")
+    f.add_argument("-postgresUser", default="postgres")
+    f.add_argument("-postgresPassword", default="")
+    f.add_argument("-postgresDatabase", default="seaweedfs")
     f.add_argument("-collection", default="")
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,

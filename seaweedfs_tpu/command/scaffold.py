@@ -114,6 +114,13 @@ SCAFFOLDS = {
 //   -store redis   -redisAddr host:6379 [-redisPassword ..]
 //          [-redisDb N]               external store over a built-in
 //                                     RESP client (Redis/KeyDB/Valkey)
+//   -store mysql   -mysqlAddr host:3306 -mysqlUser .. -mysqlPassword ..
+//          [-mysqlDatabase seaweedfs]  built-in MySQL wire client
+//                                      (MySQL/MariaDB/Percona/Vitess)
+//   -store postgres -postgresAddr host:5432 -postgresUser ..
+//          -postgresPassword .. [-postgresDatabase seaweedfs]
+//                                      built-in protocol-3.0 client
+//                                      with SCRAM-SHA-256 auth
 {}
 """,
 }

@@ -21,6 +21,7 @@ from .filer import Filer  # noqa: F401
 from .filerstore import FilerStore  # noqa: F401
 from .memory_store import MemoryStore  # noqa: F401
 from .mysql_store import MysqlStore  # noqa: F401
+from .postgres_store import PostgresStore  # noqa: F401
 from .redis_store import RedisStore  # noqa: F401
 from .sharded_store import ShardedStore  # noqa: F401
 from .sqlite_store import SqliteStore  # noqa: F401

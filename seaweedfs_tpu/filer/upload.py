@@ -88,7 +88,11 @@ def _assign_and_upload(master_url: str, blob: bytes, filename: str,
         try:
             a = _fresh_assign(master_url, collection, replication, ttl,
                               failed_vids, failed_urls)
-            up = operation.upload(a["url"], a["fid"], blob,
+            # chunk uploads ride the holder's native write plane when
+            # it advertises one (off-fast-path shapes 307 back and the
+            # client follows with method+body preserved)
+            up = operation.upload(a.get("fastUrl") or a["url"],
+                                  a["fid"], blob,
                                   filename=filename,
                                   content_type=content_type, ttl=ttl,
                                   jwt=a.get("auth", ""))

@@ -135,6 +135,24 @@ class Volume:
     def file_name(self) -> str:
         return volume_file_prefix(self.dir, self.collection, self.id)
 
+    @property
+    def readonly(self) -> bool:
+        return self._readonly
+
+    @readonly.setter
+    def readonly(self, value: bool):
+        """Freezing a volume must IMMEDIATELY stop the native plane's
+        fast-path writes, whatever code path flipped the flag (the
+        admin route, EC-encode orchestration, tier parking, or a test
+        poking the attribute) — the plane's accept gate cannot see a
+        Python attribute on its own. Thawing does NOT re-open the
+        gate here: re-qualification is the owning server's policy
+        (_fast_sync re-acquires the lease)."""
+        self._readonly = value
+        w = getattr(self, "fast_writer", None)
+        if value and w is not None:
+            w.set_accept_posts(False)
+
     def _writer_deltas(self):
         """(puts, put_bytes, deletes, deleted_bytes, max_key) appended
         by the native writer since the needle map was last fresh."""

@@ -10,9 +10,10 @@ import numpy as np
 import pytest
 
 from seaweedfs_tpu.filer import (Entry, Filer, MemoryStore, MysqlStore,
-                                 RedisStore, ShardedStore, SqliteStore)
+                                 PostgresStore, RedisStore, ShardedStore,
+                                 SqliteStore)
 from seaweedfs_tpu.filer.filer import NotFoundError
-from test_filer import fake_mysql, fake_redis
+from test_filer import fake_mysql, fake_postgres, fake_redis
 
 DIRS = ["/a", "/a/b", "/c", "/c/d/e"]
 NAMES = [f"f{i}.bin" for i in range(6)]
@@ -26,6 +27,10 @@ def make_store(store_cls):
         srv = fake_mysql()
         s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
                      password=srv.PASSWORD)
+    elif store_cls is PostgresStore:
+        srv = fake_postgres()
+        s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                     password=srv.PASSWORD)
     else:
         s.initialize()
     return s
@@ -33,7 +38,7 @@ def make_store(store_cls):
 
 @pytest.mark.parametrize("store_cls",
                          [MemoryStore, SqliteStore, ShardedStore,
-                          RedisStore, MysqlStore])
+                          RedisStore, MysqlStore, PostgresStore])
 @pytest.mark.parametrize("seed", [41, 42, 43])
 def test_filer_random_ops_match_model(store_cls, seed):
     rng = np.random.default_rng(seed)
@@ -97,7 +102,7 @@ def _check(f: Filer, model: dict):
 
 @pytest.mark.parametrize("store_cls",
                          [MemoryStore, SqliteStore, ShardedStore,
-                          RedisStore, MysqlStore])
+                          RedisStore, MysqlStore, PostgresStore])
 def test_filer_recursive_delete_fuzz(store_cls):
     """Random trees, then a recursive delete of a random subtree: only
     that subtree disappears."""
