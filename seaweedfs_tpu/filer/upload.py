@@ -90,12 +90,22 @@ def _assign_and_upload(master_url: str, blob: bytes, filename: str,
                               failed_vids, failed_urls)
             # chunk uploads ride the holder's native write plane when
             # it advertises one (off-fast-path shapes 307 back and the
-            # client follows with method+body preserved)
-            up = operation.upload(a.get("fastUrl") or a["url"],
-                                  a["fid"], blob,
-                                  filename=filename,
-                                  content_type=content_type, ttl=ttl,
-                                  jwt=a.get("auth", ""))
+            # client follows with method+body preserved). A PLANE-only
+            # outage must degrade to the healthy Python server, not
+            # blacklist the node: retry a['url'] before classifying.
+            try:
+                up = operation.upload(a.get("fastUrl") or a["url"],
+                                      a["fid"], blob,
+                                      filename=filename,
+                                      content_type=content_type,
+                                      ttl=ttl, jwt=a.get("auth", ""))
+            except HttpError:
+                if not a.get("fastUrl"):
+                    raise
+                up = operation.upload(a["url"], a["fid"], blob,
+                                      filename=filename,
+                                      content_type=content_type,
+                                      ttl=ttl, jwt=a.get("auth", ""))
             return a, up
         except HttpError as e:
             if a is None:

@@ -172,34 +172,16 @@ class GooglePubSubPublisher(Publisher):
         if self._token and now < self._token_exp - 300:
             return self._token
         from urllib.parse import urlencode
-        from ..server.http_util import HttpError, http_call
         body = urlencode({
             "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
             "assertion": self._jwt_assertion(now)}).encode()
-        # the token endpoint deserves the same retry discipline the
-        # publish POST gets: a blip at the ~55-minute refresh boundary
-        # must not drop the event
-        last = None
-        for attempt in range(self.retries):
-            try:
-                raw = http_call(
-                    "POST", self._token_uri, body,
-                    {"Content-Type":
-                     "application/x-www-form-urlencoded"},
-                    timeout=self.timeout, external=True)
-                break
-            except HttpError as e:
-                last = e
-                if 400 <= e.status < 500 and e.status != 429:
-                    raise
-            except Exception as e:  # noqa: BLE001 - network: retried
-                last = e
-            if attempt + 1 < self.retries:
-                time.sleep(min(0.2 * (2 ** attempt), 2.0))
-        else:
-            raise RuntimeError(
-                f"google_pub_sub token grant failed after "
-                f"{self.retries} attempts: {last}")
+        # the token endpoint gets the same centralized retry
+        # discipline as every publisher POST: a blip at the
+        # ~55-minute refresh boundary must not drop the event
+        raw = _post_with_retries(
+            self._token_uri, body,
+            {"Content-Type": "application/x-www-form-urlencoded"},
+            self.timeout, self.retries, "google_pub_sub token grant")
         tok = json.loads(raw)
         self._token = tok["access_token"]
         self._token_exp = now + float(tok.get("expires_in", 3600))

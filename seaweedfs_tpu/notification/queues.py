@@ -84,9 +84,8 @@ def _post_with_retries(url: str, body: bytes, headers: dict,
     last = None
     for attempt in range(retries):
         try:
-            http_call("POST", url, body, headers, timeout=timeout,
-                      external=True)
-            return
+            return http_call("POST", url, body, headers,
+                             timeout=timeout, external=True)
         except HttpError as e:
             last = e
             if 400 <= e.status < 500 and e.status != 429:

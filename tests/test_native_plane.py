@@ -564,7 +564,8 @@ class TestPlaneHealthRatio:
 
     LOADGEN = "seaweedfs_tpu/server/native/loadgen"
 
-    def _loadgen(self, vs, paths, tmp_path, seconds="4"):
+    def _loadgen(self, vs, paths, tmp_path, seconds="4", threads="8",
+                 post_size=None):
         import json as _json
         import os
         import subprocess
@@ -573,12 +574,14 @@ class TestPlaneHealthRatio:
             build = os.path.join(os.path.dirname(lg), "build.sh")
             subprocess.run(["sh", build], check=True, timeout=120,
                           capture_output=True)
-        pf = tmp_path / "paths.txt"
+        pf = tmp_path / f"paths{len(paths)}.txt"
         pf.write_text("\n".join(paths))
         host, port = vs.fast_url.split(":")
-        out = subprocess.run(
-            [lg, host, port, seconds, "8", str(pf)],
-            capture_output=True, text=True, timeout=60)
+        cmd = [lg, host, port, seconds, threads, str(pf)]
+        if post_size is not None:
+            cmd += ["post", str(post_size)]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=60)
         return _json.loads(out.stdout)
 
     def test_sustained_reads_keep_redirects_under_1pct(self, cluster,
@@ -625,3 +628,33 @@ class TestPlaneHealthRatio:
             vs._fast_sync(vid)
         st, _, body = raw_get(vs.fast_url, paths[0])
         assert st == 200 and body == b"degraded-0"
+
+    def test_mixed_write_read_soak_zero_errors(self, cluster, tmp_path):
+        """Writes then reads through the plane at loadgen rates: every
+        write must land natively (written counter == requests), reads
+        keep the redirect ratio under the same 1% alarm."""
+        master, vs = cluster
+        # small fid range + ONE writer connection: a single thread
+        # cycles the path file sequentially, so >=2x the range in
+        # requests guarantees complete coverage for the read phase
+        # (and every wrap exercises the overwrite cookie-check path)
+        a = post_json(f"http://{master.url}/dir/assign?count=400", {})
+        paths = [f"/{a['fid']}_{i}" if i else "/" + a["fid"]
+                 for i in range(400)]
+        base_written = vs.fast_plane.written
+        stats = self._loadgen(vs, paths, tmp_path, seconds="3",
+                              threads="1", post_size=1024)
+        assert stats["errors"] == 0, stats
+        assert stats["requests"] >= 2 * len(paths), \
+            (stats, "write phase too slow to cover the fid range")
+        written = vs.fast_plane.written - base_written
+        assert written == stats["requests"], \
+            (written, stats, "some writes fell back to Python")
+        # read back everything that was written
+        base_served = vs.fast_plane.served
+        base_redir = vs.fast_plane.redirected
+        rstats = self._loadgen(vs, paths, tmp_path, seconds="2")
+        assert rstats["errors"] == 0, rstats
+        served = vs.fast_plane.served - base_served
+        redirected = vs.fast_plane.redirected - base_redir
+        assert redirected / max(1, served + redirected) < 0.01
