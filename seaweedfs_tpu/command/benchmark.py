@@ -54,7 +54,7 @@ class Stats:
 def run_benchmark(master_url: str, num_files: int = 1024,
                   file_size: int = 1024, concurrency: int = 16,
                   collection: str = "benchmark", write: bool = True,
-                  read: bool = True, out=None):
+                  read: bool = True, assign_batch: int = 1, out=None):
     import sys
     out = out or sys.stdout
     rng = np.random.default_rng(0)
@@ -71,22 +71,54 @@ def run_benchmark(master_url: str, num_files: int = 1024,
                 (1 if wid < num_files % concurrency else 0)
 
         def writer(wid: int):
-            for i in range(worker_count(wid)):
-                t = time.perf_counter()
+            # assign_batch > 1 amortizes the master round trip over a
+            # batch of sequential keys (?count= assign + the fid_N
+            # suffix convention), so the tool measures the DATA plane
+            # rather than its own per-file assign chatter. The assign
+            # round trip is charged to the batch's FIRST file, so at
+            # the default batch of 1 every request's latency includes
+            # it — identical to the tool's historical numbers.
+            remaining = worker_count(wid)
+            batch = max(1, assign_batch)
+            seq = 0
+            while remaining > 0:
+                t_assign = time.perf_counter()
                 try:
-                    a = op.assign(master_url, collection=collection)
-                    # plain uploads ride the holder's native write
-                    # plane when it advertises one (reference clients
-                    # hit the Go data plane directly); anything the
-                    # plane won't serve 307s back to the Python server
-                    op.upload(a.get("fastUrl") or a["url"], a["fid"],
-                              payload, filename=f"b{wid}_{i}",
-                              jwt=a.get("auth", ""))
-                    stats.add(time.perf_counter() - t, file_size)
-                    with fid_lock:
-                        fids.append(a["fid"])
+                    a = op.assign(master_url,
+                                  count=min(batch, remaining),
+                                  collection=collection)
                 except HttpError:
                     stats.fail()
+                    remaining -= 1
+                    continue
+                granted = max(1, min(int(a.get("count", 1)),
+                                     remaining))
+                if a.get("auth"):
+                    # write JWTs are bound to the exact fid: suffixed
+                    # batch fids would 401 — drop to per-file assigns
+                    # (and stop over-reserving sequencer keys)
+                    granted = 1
+                    batch = 1
+                target = a.get("fastUrl") or a["url"]
+                for i in range(granted):
+                    fid = a["fid"] if i == 0 else f"{a['fid']}_{i}"
+                    t = t_assign if i == 0 else time.perf_counter()
+                    try:
+                        # plain uploads ride the holder's native write
+                        # plane when it advertises one (reference
+                        # clients hit the Go data plane directly);
+                        # anything the plane won't serve 307s back to
+                        # the Python server
+                        op.upload(target, fid, payload,
+                                  filename=f"b{wid}_{seq}",
+                                  jwt=a.get("auth", ""))
+                        stats.add(time.perf_counter() - t, file_size)
+                        with fid_lock:
+                            fids.append(fid)
+                    except HttpError:
+                        stats.fail()
+                    seq += 1
+                remaining -= granted
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=writer, args=(w,))

@@ -302,7 +302,8 @@ def cmd_benchmark(args):
     prof = _maybe_profiler(args)
     try:
         run_benchmark(args.master, num_files=args.n, file_size=args.size,
-                      concurrency=args.c, collection=args.collection)
+                      concurrency=args.c, collection=args.collection,
+                      assign_batch=args.assignBatch)
     finally:
         if prof:
             prof.stop()
@@ -912,6 +913,11 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-c", type=int, default=16)
     b.add_argument("-collection", default="benchmark")
+    b.add_argument("-assignBatch", type=int, default=1,
+                   help="files per master assign (?count= + fid_N "
+                        "suffixes): >1 amortizes assign round trips "
+                        "so the tool measures the data plane, not "
+                        "its own per-file assign chatter")
     b.add_argument("-cpuprofile", default="",
                    help="write an all-thread collapsed-stack CPU "
                         "profile of the run (reference benchmark "

@@ -589,3 +589,26 @@ class TestFastPathDeletes:
         for fid in (man["fid"], chunk_a["fid"]):
             with pytest.raises(HttpError):
                 http_call("GET", f"http://{vs.url}/{fid}")
+
+
+def test_benchmark_batch_assign_all_native(tmp_path):
+    """`weed benchmark -assignBatch N`: one ?count= assign per batch,
+    fid_N suffixed uploads — every write lands on the native plane and
+    every fid reads back."""
+    import io
+    from seaweedfs_tpu.command.benchmark import run_benchmark
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = start_vs(tmp_path, master)
+    try:
+        out = io.StringIO()
+        fids = run_benchmark(master.url, num_files=120, file_size=512,
+                             concurrency=4, assign_batch=25, out=out)
+        assert len(fids) == 120
+        assert "120 ok, 0 failed" in out.getvalue()
+        assert vs.fast_plane.written == 120
+        for fid in fids[::17]:
+            assert len(http_call(
+                "GET", f"http://{vs.fast_url}/{fid}")) == 512
+    finally:
+        vs.stop()
+        master.stop()
