@@ -209,6 +209,11 @@ def cmd_filer(args):
                          "user": args.postgresUser,
                          "password": args.postgresPassword,
                          "database": args.postgresDatabase}
+    elif args.store == "cassandra":
+        store_options = {"addr": args.cassandraAddr,
+                         "user": args.cassandraUser,
+                         "password": args.cassandraPassword,
+                         "keyspace": args.cassandraKeyspace}
     else:
         store_options = {}
     f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
@@ -837,7 +842,7 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
                    choices=["memory", "sqlite", "sharded", "redis",
-                            "mysql", "postgres"])
+                            "mysql", "postgres", "cassandra"])
     f.add_argument("-db", default="./filer.db",
                    help="metadata path: a sqlite file, or a directory "
                         "of shard dbs for -store sharded (default "
@@ -859,6 +864,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-postgresUser", default="postgres")
     f.add_argument("-postgresPassword", default="")
     f.add_argument("-postgresDatabase", default="seaweedfs")
+    f.add_argument("-cassandraAddr", default="127.0.0.1:9042",
+                   help="cassandra endpoint for -store cassandra")
+    f.add_argument("-cassandraUser", default="")
+    f.add_argument("-cassandraPassword", default="")
+    f.add_argument("-cassandraKeyspace", default="seaweedfs")
     f.add_argument("-collection", default="")
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,

@@ -121,6 +121,10 @@ SCAFFOLDS = {
 //          -postgresPassword .. [-postgresDatabase seaweedfs]
 //                                      built-in protocol-3.0 client
 //                                      with SCRAM-SHA-256 auth
+//   -store cassandra -cassandraAddr host:9042 [-cassandraUser ..
+//          -cassandraPassword ..] [-cassandraKeyspace seaweedfs]
+//                                      built-in CQL v4 client
+//                                      (directory-partitioned table)
 {}
 """,
 }

@@ -600,9 +600,31 @@ class TestReadChunked:
 
 @pytest.mark.parametrize("store_cls",
                          [MemoryStore, SqliteStore, ShardedStore,
-                          RedisStore])
+                          RedisStore, "mysql", "postgres",
+                          "cassandra"])
 class TestStores:
     def make(self, store_cls):
+        if store_cls == "mysql":
+            from seaweedfs_tpu.filer import MysqlStore
+            srv = fake_mysql()
+            s = MysqlStore()
+            s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                         password=srv.PASSWORD)
+            return s
+        if store_cls == "postgres":
+            from seaweedfs_tpu.filer import PostgresStore
+            srv = fake_postgres()
+            s = PostgresStore()
+            s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                         password=srv.PASSWORD)
+            return s
+        if store_cls == "cassandra":
+            from seaweedfs_tpu.filer import CassandraStore
+            srv = fake_cassandra()
+            s = CassandraStore()
+            s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                         password=srv.PASSWORD)
+            return s
         s = store_cls()
         if store_cls is RedisStore:
             s.initialize(addr=f"127.0.0.1:{fake_redis().port}")
@@ -1209,4 +1231,291 @@ class TestPostgresStore:
         assert s.find_entry("/pgp/f00").attr.mime == "updated"
         s.delete_entry("/pgp/f00")
         assert s.find_entry("/pgp/f00") is None
+        s.close()
+
+
+class FakeCassandra:
+    """In-process CQL v4 server: STARTUP/AUTHENTICATE (SASL PLAIN,
+    credentials actually checked), QUERY framing with RESULT rows in
+    the global-table-spec metadata shape, and a dict executor for the
+    statement shapes CassandraStore emits."""
+
+    USER, PASSWORD = "weed", "cql-sekrit"
+
+    def __init__(self):
+        import socket
+        import threading
+        self.rows = {}  # (directory, name) -> meta bytes
+        self.lock = threading.Lock()
+        self.auth_failures = 0
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def flushall(self):
+        with self.lock:
+            self.rows.clear()
+
+    def _serve(self):
+        import threading
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn, buf, n):
+        while len(buf) < n:
+            c = conn.recv(65536)
+            if not c:
+                return None, buf
+            buf += c
+        return buf[:n], buf[n:]
+
+    @staticmethod
+    def _frame(stream, opcode, body):
+        import struct
+        return struct.pack(">BBhBI", 0x84, 0x00, stream, opcode,
+                           len(body)) + body
+
+    def _client(self, conn):
+        import struct
+        try:
+            buf = b""
+            authed = False
+            while True:
+                head, buf = self._recv_exact(conn, buf, 9)
+                if head is None:
+                    return
+                stream = struct.unpack(">h", head[2:4])[0]
+                opcode = head[4]
+                (length,) = struct.unpack(">I", head[5:9])
+                body, buf = self._recv_exact(conn, buf, length)
+                if body is None:
+                    return
+                if opcode == 0x01:        # STARTUP -> demand auth
+                    conn.sendall(self._frame(
+                        stream, 0x03,
+                        struct.pack(">H", 42) +
+                        b"org.apache.cassandra.auth.PasswordAuthenticator"
+                        [:42]))
+                elif opcode == 0x0F:      # AUTH_RESPONSE: SASL PLAIN
+                    (n,) = struct.unpack(">i", body[:4])
+                    parts = body[4:4 + n].split(b"\x00")
+                    if parts[-2:] == [self.USER.encode(),
+                                      self.PASSWORD.encode()]:
+                        authed = True
+                        conn.sendall(self._frame(
+                            stream, 0x10, struct.pack(">i", -1)))
+                    else:
+                        self.auth_failures += 1
+                        conn.sendall(self._frame(
+                            stream, 0x00, struct.pack(">i", 0x0100)
+                            + struct.pack(">H", 14)
+                            + b"bad credentials"[:14]))
+                        return
+                elif opcode == 0x07:      # QUERY
+                    if not authed:
+                        return
+                    (qlen,) = struct.unpack(">I", body[:4])
+                    cql = body[4:4 + qlen].decode()
+                    self._query(conn, stream, cql)
+                else:
+                    return
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    # -- executor ---------------------------------------------------------
+
+    @staticmethod
+    def _unescape(s):
+        return s.replace("''", "'")
+
+    def _void(self, conn, stream):
+        import struct
+        conn.sendall(self._frame(stream, 0x08, struct.pack(">i", 1)))
+
+    def _rows(self, conn, stream, names, rows):
+        import struct
+        # kind=rows, flags=global_tables_spec, metadata + rows
+        body = [struct.pack(">i", 2), struct.pack(">ii", 1, len(names))]
+        for s in ("ks", "filemeta"):
+            body.append(struct.pack(">H", len(s)) + s.encode())
+        for nm in names:
+            body.append(struct.pack(">H", len(nm)) + nm.encode())
+            body.append(struct.pack(">H", 0x000D))  # varchar
+        body.append(struct.pack(">i", len(rows)))
+        for row in rows:
+            for v in row:
+                body.append(struct.pack(">i", len(v)) + v)
+        conn.sendall(self._frame(stream, 0x08, b"".join(body)))
+
+    _STR = r"'((?:[^']|'')*)'"
+
+    def _query(self, conn, stream, cql):
+        import re
+        S = self._STR
+        if cql.startswith(("CREATE KEYSPACE", "USE ",
+                           "CREATE TABLE")):
+            self._void(conn, stream)
+            return
+        m = re.match(
+            rf"INSERT INTO filemeta \(directory,name,meta\) VALUES "
+            rf"\({S},{S},0x([0-9a-f]*)\)$", cql)
+        if m:
+            with self.lock:
+                self.rows[(self._unescape(m.group(1)),
+                           self._unescape(m.group(2)))] = \
+                    bytes.fromhex(m.group(3))
+            self._void(conn, stream)
+            return
+        m = re.match(
+            rf"SELECT meta FROM filemeta WHERE directory={S} "
+            rf"AND name={S}$", cql)
+        if m:
+            with self.lock:
+                hit = self.rows.get((self._unescape(m.group(1)),
+                                     self._unescape(m.group(2))))
+            self._rows(conn, stream, ["meta"],
+                       [(hit,)] if hit is not None else [])
+            return
+        m = re.match(
+            rf"DELETE FROM filemeta WHERE directory={S} "
+            rf"AND name={S}$", cql)
+        if m:
+            with self.lock:
+                self.rows.pop((self._unescape(m.group(1)),
+                               self._unescape(m.group(2))), None)
+            self._void(conn, stream)
+            return
+        m = re.match(
+            rf"DELETE FROM filemeta WHERE directory={S}$", cql)
+        if m:
+            d = self._unescape(m.group(1))
+            with self.lock:
+                for k in [k for k in self.rows if k[0] == d]:
+                    del self.rows[k]
+            self._void(conn, stream)
+            return
+        m = re.match(
+            rf"SELECT name, meta FROM filemeta WHERE directory={S}"
+            rf"(?: AND name(>=?){S})? "
+            r"ORDER BY name ASC LIMIT (\d+)$", cql)
+        if m:
+            d = self._unescape(m.group(1))
+            op, start = m.group(2), m.group(3)
+            start = self._unescape(start) if start else None
+            limit = int(m.group(4))
+            with self.lock:
+                names = sorted(
+                    n for (dd, n) in self.rows
+                    if dd == d and (
+                        start is None or
+                        (n >= start if op == ">=" else n > start)))
+                out = [(n.encode(), self.rows[(d, n)])
+                       for n in names[:limit]]
+            self._rows(conn, stream, ["name", "meta"], out)
+            return
+        import struct
+        conn.sendall(self._frame(
+            stream, 0x00, struct.pack(">i", 0x2000)
+            + struct.pack(">H", 20) + b"fake cannot parse: "[:20]))
+
+
+_fake_cql_srv = None
+
+
+def fake_cassandra():
+    global _fake_cql_srv
+    if _fake_cql_srv is None:
+        _fake_cql_srv = FakeCassandra()
+    _fake_cql_srv.flushall()
+    return _fake_cql_srv
+
+
+class TestCassandraStore:
+    """Direct CassandraStore coverage beyond the fuzz matrix: SASL
+    PLAIN auth (credentials actually checked), hostile names through
+    quote-doubling, and the walk-based recursive delete over
+    materialized directory entries."""
+
+    def _store(self):
+        from seaweedfs_tpu.filer import CassandraStore
+        srv = fake_cassandra()
+        s = CassandraStore()
+        s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                     password=srv.PASSWORD)
+        return srv, s
+
+    def test_wrong_password_rejected(self):
+        from seaweedfs_tpu.filer import CassandraStore
+        from seaweedfs_tpu.filer.cassandra_store import CassandraError
+        srv = fake_cassandra()
+        s = CassandraStore()
+        with pytest.raises((CassandraError, OSError)):
+            s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                         password="wrong")
+        assert srv.auth_failures >= 1
+
+    def test_hostile_names_roundtrip(self):
+        srv, s = self._store()
+        nasty = ["it's", "tri'''ple", "per%cent", 'qu"ote',
+                 "back\\slash"]
+        for i, name in enumerate(nasty):
+            e = Entry(full_path=f"/cqlevil/{name}")
+            e.attr.mime = f"m{i}"
+            s.insert_entry(e)
+        # + the materialized '/cqlevil' directory marker, nothing else
+        # (the crafted names did NOT inject rows)
+        assert len(srv.rows) == len(nasty) + 1
+        got = s.list_directory_entries("/cqlevil", "", True, 100)
+        assert sorted(x.name for x in got) == sorted(nasty)
+        for i, name in enumerate(nasty):
+            assert s.find_entry(
+                f"/cqlevil/{name}").attr.mime == f"m{i}"
+        s.close()
+
+    def test_recursive_delete_walks_materialized_tree(self):
+        """Through the Filer (which materializes parents), a recursive
+        delete must take the WHOLE subtree despite the partition-keyed
+        layout."""
+        srv, s = self._store()
+        f = Filer(s)
+        for p in ("/t/a/x.bin", "/t/a/b/y.bin", "/t/a/b/c/z.bin",
+                  "/t/keep.bin", "/other/w.bin"):
+            f.create_entry(Entry(full_path=p))
+        f.delete_entry("/t/a", recursive=True,
+                       ignore_recursive_error=False)
+        assert s.find_entry("/t/a/x.bin") is None
+        assert s.find_entry("/t/a/b/y.bin") is None
+        assert s.find_entry("/t/a/b/c/z.bin") is None
+        assert s.find_entry("/t/a") is None
+        assert s.find_entry("/t/keep.bin") is not None
+        assert s.find_entry("/other/w.bin") is not None
+        s.close()
+
+    def test_listing_pagination(self):
+        srv, s = self._store()
+        for i in range(7):
+            s.insert_entry(Entry(full_path=f"/cqlp/f{i}"))
+        p1 = s.list_directory_entries("/cqlp", "", True, 3)
+        assert [e.name for e in p1] == ["f0", "f1", "f2"]
+        p2 = s.list_directory_entries("/cqlp", p1[-1].name, False, 3)
+        assert [e.name for e in p2] == ["f3", "f4", "f5"]
         s.close()
