@@ -28,6 +28,16 @@ def assign(master_url: str, count: int = 1, collection: str = "",
     return get_json(f"http://{master_url}/dir/assign?{q}")
 
 
+def expand_batch_fids(fid: str, granted: int):
+    """The fid_N suffix convention for `?count=` batch assigns: the
+    master grants `granted` sequential keys addressed as fid, fid_1,
+    fid_2, ... (same volume + cookie). Both benchmark modes and any
+    batch uploader must spell the suffixes identically — this is the
+    single owner of that convention."""
+    for i in range(granted):
+        yield fid if i == 0 else f"{fid}_{i}"
+
+
 def upload(url: str, fid: str, data: bytes, filename: str = "",
            content_type: str = "",
            ttl: str = "", jwt: str = "") -> dict:

@@ -100,8 +100,8 @@ def run_benchmark(master_url: str, num_files: int = 1024,
                     granted = 1
                     batch = 1
                 target = a.get("fastUrl") or a["url"]
-                for i in range(granted):
-                    fid = a["fid"] if i == 0 else f"{a['fid']}_{i}"
+                for i, fid in enumerate(
+                        op.expand_batch_fids(a["fid"], granted)):
                     t = t_assign if i == 0 else time.perf_counter()
                     try:
                         # plain uploads ride the holder's native write
@@ -155,3 +155,161 @@ def run_benchmark(master_url: str, num_files: int = 1024,
             th.join()
         stats.report("random read", time.perf_counter() - t0, out)
     return fids
+
+
+def _loadgen_binary() -> str:
+    """Locate (or build) the native keep-alive load generator that the
+    -native mode uses as its engine. A Python client process tops out
+    near ~350 req/s on this class of kernel, so measuring a native data
+    plane needs a native instrument."""
+    import os
+    import subprocess
+    d = os.path.join(os.path.dirname(__file__), "..", "server", "native")
+    d = os.path.abspath(d)
+    binary = os.path.join(d, "loadgen")
+    src = os.path.join(d, "loadgen.cc")
+    have_src = os.path.exists(src)
+    if os.path.exists(binary) and (
+            not have_src
+            or os.path.getmtime(binary) >= os.path.getmtime(src)):
+        return binary
+    if not have_src:
+        raise RuntimeError(f"no loadgen binary at {binary} and no "
+                           f"source at {src} to build it from")
+    r = subprocess.run(["g++", "-O2", "-std=c++17", "-pthread",
+                        "-o", binary, src],
+                       capture_output=True, timeout=120, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(f"building loadgen failed:\n{r.stderr}")
+    return binary
+
+
+def run_native_benchmark(master_url: str, file_size: int = 1024,
+                         concurrency: int = 16,
+                         collection: str = "benchmark",
+                         seconds: float = 10.0, pool: int = 4096,
+                         assign_batch: int = 256, out=None):
+    """`weed benchmark -native`: drive the cluster with the C++
+    keep-alive load generator instead of Python worker threads.
+
+    The classic mode measures what one Python client process can push
+    (the reference's Go benchmark has no such client-side ceiling); this
+    mode measures what the SERVERS can take: batch-assign a pool of
+    fids, then run the native engine in multipart-POST mode and again in
+    GET mode against each volume server's advertised fast port,
+    duration-based. Reports per-phase req/s aggregated across targets
+    plus one JSON line per phase.
+    """
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    out = out or sys.stdout
+    binary = _loadgen_binary()
+
+    # -- assign a fid pool, grouped by target host:port -------------------
+    targets = {}  # (host, port) -> [paths]
+    assigned = 0
+    assign_failures = 0
+    while assigned < pool:
+        try:
+            a = op.assign(master_url,
+                          count=min(assign_batch, pool - assigned),
+                          collection=collection)
+        except HttpError as e:
+            # same per-batch resilience as the classic writer: one
+            # transient master hiccup must not abort the run
+            assign_failures += 1
+            if assign_failures > 5:
+                raise RuntimeError(
+                    f"assign pool: {assign_failures} consecutive "
+                    f"failures, giving up: {e}") from e
+            time.sleep(0.2 * assign_failures)
+            continue
+        assign_failures = 0
+        if a.get("auth"):
+            raise SystemExit(
+                "benchmark -native does not speak per-fid write JWTs; "
+                "run it against a cluster without -jwtKey")
+        granted = max(1, min(int(a.get("count", 1)), pool - assigned))
+        url = a.get("fastUrl") or a["url"]
+        host, _, port = url.rpartition(":")
+        host = socket.gethostbyname(host.strip("[]") or "127.0.0.1")
+        bucket = targets.setdefault((host, int(port)), [])
+        for fid in op.expand_batch_fids(a["fid"], granted):
+            bucket.append("/" + fid)
+        assigned += granted
+
+    def thread_split() -> dict:
+        """Exactly `concurrency` connections, split proportionally by
+        pooled paths (largest remainder), every target getting >=1."""
+        items = list(targets.items())
+        total_paths = sum(len(p) for _, p in items)
+        want = max(len(items), concurrency)
+        extra = want - len(items)  # every target starts with 1
+        shares = [(key, len(paths) * extra / total_paths)
+                  for key, paths in items]
+        alloc = {key: 1 + int(s) for key, s in shares}
+        left = want - sum(alloc.values())
+        for key, s in sorted(shares, key=lambda kv: kv[1] - int(kv[1]),
+                             reverse=True):
+            if left <= 0:
+                break
+            alloc[key] += 1
+            left -= 1
+        return alloc
+
+    def drive(phase_args, label):
+        """One loadgen per target, concurrency split proportionally."""
+        import shutil
+        procs = []
+        alloc = thread_split()
+        tmpdir = tempfile.mkdtemp(prefix="weedbench")
+        requests = errors = 0
+        wall = 0.0
+        try:
+            for n, ((host, port), paths) in enumerate(targets.items()):
+                threads = alloc[(host, port)]
+                pf = os.path.join(tmpdir, f"paths{n}")
+                with open(pf, "w") as f:
+                    f.write("\n".join(paths))
+                procs.append(subprocess.Popen(
+                    [binary, host, str(port), str(seconds),
+                     str(threads), pf] + phase_args,
+                    stdout=subprocess.PIPE, text=True))
+            for p in procs:
+                stdout, _ = p.communicate(timeout=seconds + 60)
+                if p.returncode != 0:
+                    raise RuntimeError(f"loadgen exited {p.returncode}")
+                r = json.loads(stdout)
+                requests += r["requests"]
+                errors += r["errors"]
+                wall = max(wall, r["seconds"])
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        rps = requests / wall if wall else 0.0
+        conns = sum(alloc.values())
+        print(f"\n--- native {label}: {len(targets)} target(s), "
+              f"{conns} connections ---", file=out)
+        print(f"requests: {requests}  errors: {errors}", file=out)
+        print(f"time taken: {wall:.2f}s  req/s: {rps:.1f}  "
+              f"KB/s: {rps * file_size / 1024:.1f}", file=out)
+        print(json.dumps({"phase": label, "requests": requests,
+                          "errors": errors, "seconds": round(wall, 3),
+                          "rps": round(rps, 1), "connections": conns,
+                          "targets": len(targets)}), file=out)
+        return requests, errors
+
+    drive(["post", str(file_size)], "write")
+    # the write phase cycled the pool for `seconds`, so every pooled
+    # path now exists (loadgen wrote each at least once unless the run
+    # was too short for one full cycle — reads of unwritten fids would
+    # count as errors, which is the honest outcome)
+    _, read_errors = drive([], "random read")
+    return read_errors

@@ -151,7 +151,8 @@ def cmd_server(args):
         from ..server.filer_server import FilerServer
         f = FilerServer(port=args.filerPort, host=args.ip,
                         master_url=m.url,
-                        jwt_signing_key=args.jwtKey).start()
+                        jwt_signing_key=args.jwtKey,
+                        notify_publisher=_notification_publisher()).start()
         print(f"filer on {f.url}")
         if args.s3:
             s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
@@ -181,6 +182,19 @@ def _start_s3(filer_server, port: int, host: str, config_path: str):
             iam = Iam.from_config(_json.load(fh))
     return S3ApiServer(filer_server.filer, filer_server.master_url,
                        port=port, host=host, iam=iam).start()
+
+
+def _notification_publisher():
+    """notification.toml/json from the config search path (plus WEED_*
+    env) — the reference filer's notification.LoadConfiguration: the
+    first `[notification.<backend>]` section with enabled=true becomes
+    the filer's metadata-event publisher."""
+    from ..notification.queues import publisher_from_config
+    from ..util.config import load_config
+    pub = publisher_from_config(load_config("notification"))
+    if pub is not None:
+        print(f"notification -> {pub.name}")
+    return pub
 
 
 def cmd_filer(args):
@@ -214,6 +228,10 @@ def cmd_filer(args):
                          "user": args.cassandraUser,
                          "password": args.cassandraPassword,
                          "keyspace": args.cassandraKeyspace}
+    elif args.store == "etcd":
+        store_options = {"addr": args.etcdAddr,
+                         "user": args.etcdUser,
+                         "password": args.etcdPassword}
     else:
         store_options = {}
     f = FilerServer(port=args.port, host=args.ip, master_url=args.master,
@@ -223,7 +241,8 @@ def cmd_filer(args):
                     chunk_size=args.maxMB << 20,
                     jwt_signing_key=args.jwtKey,
                     cipher=args.encryptVolumeData,
-                    compress=args.compress).start()
+                    compress=args.compress,
+                    notify_publisher=_notification_publisher()).start()
     print(f"filer listening on {f.url}, master {args.master}")
     if args.s3:
         s3 = _start_s3(f, args.s3Port, args.ip, args.s3Config)
@@ -303,12 +322,20 @@ def _maybe_profiler(args):
 
 
 def cmd_benchmark(args):
-    from .benchmark import run_benchmark
+    from .benchmark import run_benchmark, run_native_benchmark
     prof = _maybe_profiler(args)
     try:
-        run_benchmark(args.master, num_files=args.n, file_size=args.size,
-                      concurrency=args.c, collection=args.collection,
-                      assign_batch=args.assignBatch)
+        if args.native:
+            run_native_benchmark(args.master, file_size=args.size,
+                                 concurrency=args.c,
+                                 collection=args.collection,
+                                 seconds=args.seconds, pool=args.pool,
+                                 assign_batch=args.assignBatch)
+        else:
+            run_benchmark(args.master, num_files=args.n,
+                          file_size=args.size,
+                          concurrency=args.c, collection=args.collection,
+                          assign_batch=args.assignBatch)
     finally:
         if prof:
             prof.stop()
@@ -842,7 +869,7 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-master", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
                    choices=["memory", "sqlite", "sharded", "redis",
-                            "mysql", "postgres", "cassandra"])
+                            "mysql", "postgres", "cassandra", "etcd"])
     f.add_argument("-db", default="./filer.db",
                    help="metadata path: a sqlite file, or a directory "
                         "of shard dbs for -store sharded (default "
@@ -869,6 +896,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-cassandraUser", default="")
     f.add_argument("-cassandraPassword", default="")
     f.add_argument("-cassandraKeyspace", default="seaweedfs")
+    f.add_argument("-etcdAddr", default="127.0.0.1:2379",
+                   help="etcd endpoint for -store etcd (v3 JSON "
+                        "gateway)")
+    f.add_argument("-etcdUser", default="")
+    f.add_argument("-etcdPassword", default="")
     f.add_argument("-collection", default="")
     f.add_argument("-defaultReplicaPlacement", default="")
     f.add_argument("-maxMB", type=int, default=32,
@@ -932,6 +964,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write an all-thread collapsed-stack CPU "
                         "profile of the run (reference benchmark "
                         "-cpuprofile)")
+    b.add_argument("-native", action="store_true",
+                   help="drive the cluster with the C++ keep-alive "
+                        "load engine (duration-based): measures server "
+                        "capacity instead of this client's own ceiling")
+    b.add_argument("-seconds", type=float, default=10.0,
+                   help="per-phase duration for -native")
+    b.add_argument("-pool", type=int, default=4096,
+                   help="assigned-fid pool size for -native")
     b.set_defaults(fn=cmd_benchmark)
 
     u = sub.add_parser("upload", help="upload files")

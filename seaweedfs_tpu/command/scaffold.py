@@ -99,8 +99,11 @@ SCAFFOLDS = {
 //              (no SDK), options: google_application_credentials
 //              (service-account json), project_id, topic,
 //              endpoint/token_uri overrides for emulators
-//   gocdk_pub_sub remains a gated stub (its concrete brokers all
-//   have native publishers above)
+//   "gocdk_pub_sub"  URL-dispatching meta-publisher: one topic_url
+//              whose scheme picks the broker (kafka://topic,
+//              awssqs://sqs.<region>.amazonaws.com/<acct>/<queue>,
+//              gcppubsub://projects/<p>/topics/<t>, mem://,
+//              http(s):// webhook); remaining options pass through
 {}
 """,
     "filer": """\
@@ -125,6 +128,10 @@ SCAFFOLDS = {
 //          -cassandraPassword ..] [-cassandraKeyspace seaweedfs]
 //                                      built-in CQL v4 client
 //                                      (directory-partitioned table)
+//   -store etcd -etcdAddr host:2379 [-etcdUser .. -etcdPassword ..]
+//                                      built-in etcd v3 JSON-gateway
+//                                      client (bearer auth, prefix
+//                                      ranges over <dir>\\0<name> keys)
 {}
 """,
 }

@@ -20,6 +20,7 @@ from .filechunks import (  # noqa: F401
 from .filer import Filer  # noqa: F401
 from .filerstore import FilerStore  # noqa: F401
 from .cassandra_store import CassandraStore  # noqa: F401
+from .etcd_store import EtcdStore  # noqa: F401
 from .memory_store import MemoryStore  # noqa: F401
 from .mysql_store import MysqlStore  # noqa: F401
 from .postgres_store import PostgresStore  # noqa: F401

@@ -7,20 +7,21 @@ google_pub_sub, gocdk_pub_sub, log). Here: `log` (stderr/file),
 (JSON POST), `kafka` (version-negotiated wire producer,
 notification/kafka.py), `aws_sqs` (SigV4-signed SendMessage) and
 `google_pub_sub` (from-scratch OAuth2 JWT-bearer + RS256 + REST
-publish, google_pub_sub.py) are real; the gocdk meta-backend stays a
-registered stub that raises on use so config errors surface the same
-way the reference's missing-broker errors do.
+publish, google_pub_sub.py) are real; `gocdk_pub_sub` is the
+URL-dispatching meta-publisher (one topic_url whose scheme picks the
+broker, like the Go CDK's pubsub.OpenTopic) routing to the native
+publishers above.
 """
 
 from .google_pub_sub import GooglePubSubPublisher  # noqa: F401
 from .queues import (  # noqa: F401
     PUBLISHERS,
+    GocdkPubSubPublisher,
     KafkaPublisher,
     LogPublisher,
     MemoryPublisher,
     Publisher,
     SqsPublisher,
-    StubPublisher,
     WebhookPublisher,
     make_publisher,
 )

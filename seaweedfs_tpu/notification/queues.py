@@ -215,23 +215,146 @@ class SqsPublisher(Publisher):
                            self.retries, "sqs")
 
 
-class StubPublisher(Publisher):
-    """Placeholder for meta-backends with nothing concrete to wrap
-    (gocdk_pub_sub points at whichever broker gocdk is configured
-    for — kafka/SQS/pubsub all have native publishers here).
-    Configuring one fails at first send with an actionable error,
-    mirroring how the reference fails when the broker endpoint is
-    unreachable."""
+@register
+class GocdkPubSubPublisher(Publisher):
+    """URL-dispatching meta-publisher — the reference's gocdk_pub_sub
+    slot (weed/notification/gocdk_pub_sub/gocdk_pub_sub.go): one
+    `topic_url` whose scheme selects the broker, like the Go CDK's
+    `pubsub.OpenTopic`. Every scheme routes to a native from-scratch
+    publisher in this package (no SDKs):
+
+    - ``kafka://my-topic`` — brokers from the `hosts` option or the Go
+      CDK's `KAFKA_BROKERS` env var;
+    - ``awssqs://sqs.<region>.amazonaws.com/<acct>/<queue>[?region=..]``;
+    - ``gcppubsub://projects/<project>/topics/<topic>`` (or the
+      shorthand ``gcppubsub://<project>/<topic>``);
+    - ``mem://<topic>`` — the in-process memory publisher;
+    - ``http(s)://...`` — the webhook publisher (an extension: the Go
+      CDK has no HTTP driver, but a URL-shaped catch-all belongs here).
+
+    Schemes the Go CDK supports with no wire analog in this
+    environment (rabbit, nats, azuresb) fail loudly at initialize.
+    Remaining options pass through to the wrapped publisher
+    (credentials, timeouts, retries).
+    """
+
+    name = "gocdk_pub_sub"
+
+    def initialize(self, topic_url: str = "", **options):
+        import os
+        import urllib.parse
+        if not topic_url:
+            raise ValueError("gocdk_pub_sub needs a topic_url")
+        parsed = urllib.parse.urlsplit(topic_url)
+        scheme = parsed.scheme.lower()
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        # the URL always wins over a same-named option (otherwise the
+        # wrapped make_publisher gets the kwarg twice and TypeErrors)
+        if scheme == "kafka":
+            hosts = options.pop("hosts", "") \
+                or os.environ.get("KAFKA_BROKERS", "")
+            if not hosts:
+                raise ValueError(
+                    "gocdk_pub_sub kafka:// needs brokers via the "
+                    "'hosts' option or KAFKA_BROKERS")
+            topic = (parsed.netloc + parsed.path).strip("/")
+            options.pop("topic", None)
+            self._inner = make_publisher("kafka", hosts=hosts,
+                                         topic=topic, **options)
+        elif scheme == "awssqs":
+            opt_region = options.pop("region", "")
+            region = query.get("region", "") or opt_region
+            if not region:
+                host_parts = parsed.netloc.split(".")
+                if len(host_parts) >= 2 and host_parts[0] == "sqs":
+                    region = host_parts[1]
+            if not region:
+                raise ValueError(
+                    "gocdk_pub_sub awssqs:// needs ?region= (host is "
+                    f"not sqs.<region>...: {parsed.netloc!r})")
+            queue_url = f"https://{parsed.netloc}{parsed.path}"
+            options.pop("queue_url", None)
+            self._inner = make_publisher("aws_sqs", queue_url=queue_url,
+                                         region=region, **options)
+        elif scheme == "gcppubsub":
+            parts = [p for p in
+                     (parsed.netloc + parsed.path).split("/") if p]
+            if len(parts) == 4 and parts[0] == "projects" \
+                    and parts[2] == "topics":
+                project, topic = parts[1], parts[3]
+            elif len(parts) == 2:
+                project, topic = parts
+            else:
+                raise ValueError(
+                    "gocdk_pub_sub gcppubsub:// wants "
+                    "projects/<project>/topics/<topic>, got "
+                    f"{topic_url!r}")
+            options.pop("project_id", None)
+            options.pop("topic", None)
+            self._inner = make_publisher("google_pub_sub",
+                                         project_id=project,
+                                         topic=topic, **options)
+        elif scheme == "mem":
+            self._inner = make_publisher("memory")
+        elif scheme in ("http", "https"):
+            options.pop("url", None)
+            self._inner = make_publisher("webhook", url=topic_url,
+                                         **options)
+        else:
+            raise ValueError(
+                f"gocdk_pub_sub: no driver for scheme {scheme!r} "
+                "(have kafka, awssqs, gcppubsub, mem, http/https; "
+                "rabbit/nats/azuresb have no broker analog here)")
+        self.topic_url = topic_url
 
     def send(self, key: str, event: dict) -> None:
-        raise RuntimeError(
-            f"notification backend {self.name!r} requires an external "
-            f"broker that is not available in this environment")
+        self._inner.send(key, event)
+
+    def close(self):
+        self._inner.close()
 
 
-# google_pub_sub is REAL now (google_pub_sub.py: from-scratch OAuth2
-# JWT-bearer + RS256 + REST publish); only the gocdk meta-backend stays
-# a stub (it exists to wrap whichever broker gocdk points at — every
-# concrete broker here already has a native publisher)
-for _name in ("gocdk_pub_sub",):
-    register(type(f"Stub_{_name}", (StubPublisher,), {"name": _name}))
+def publisher_from_config(cfg: dict):
+    """Build the enabled publisher from a flattened notification config
+    (util.config.load_config("notification")) — the reference filer's
+    notification.LoadConfiguration over notification.toml: the section
+    with `enabled = true` wins, its remaining keys become the
+    publisher's options. Returns None when nothing is enabled; more
+    than one enabled section is a config conflict and fails loudly
+    (a flattened dict has no file order to break the tie with, and
+    silently picking one would publish to the wrong broker).
+
+    Env-sourced keys arrive with dots where TOML has underscores
+    (WEED_NOTIFICATION_AWS_SQS_QUEUE_URL ->
+    "notification.aws.sqs.queue.url"), so both spellings of the section
+    name and of option keys are accepted.
+    """
+    def truthy(v) -> bool:
+        if isinstance(v, str):
+            return v.strip().lower() in ("1", "true", "yes", "on")
+        return bool(v)
+
+    enabled_names = []
+    for name in sorted(PUBLISHERS):
+        prefixes = {f"notification.{name}.",
+                    f"notification.{name.replace('_', '.')}."}
+        if any(truthy(cfg.get(p + "enabled")) for p in prefixes):
+            enabled_names.append((name, prefixes))
+    if not enabled_names:
+        return None
+    if len(enabled_names) > 1:
+        raise ValueError(
+            "notification config enables more than one backend: "
+            + ", ".join(n for n, _ in enabled_names)
+            + " — enable exactly one (check WEED_NOTIFICATION_* env "
+            "vars too)")
+    name, prefixes = enabled_names[0]
+    options = {}
+    for key, value in cfg.items():
+        for p in prefixes:
+            if key.startswith(p):
+                opt = key[len(p):].replace(".", "_")
+                if opt != "enabled":
+                    options[opt] = value
+                break
+    return make_publisher(name, **options)

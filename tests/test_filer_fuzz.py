@@ -9,12 +9,12 @@ import posixpath
 import numpy as np
 import pytest
 
-from seaweedfs_tpu.filer import (CassandraStore, Entry, Filer, MemoryStore,
-                                 MysqlStore, PostgresStore, RedisStore,
-                                 ShardedStore, SqliteStore)
+from seaweedfs_tpu.filer import (CassandraStore, Entry, EtcdStore, Filer,
+                                 MemoryStore, MysqlStore, PostgresStore,
+                                 RedisStore, ShardedStore, SqliteStore)
 from seaweedfs_tpu.filer.filer import NotFoundError
-from test_filer import fake_cassandra, fake_mysql, fake_postgres, \
-    fake_redis
+from test_filer import fake_cassandra, fake_etcd, fake_mysql, \
+    fake_postgres, fake_redis
 
 DIRS = ["/a", "/a/b", "/c", "/c/d/e"]
 NAMES = [f"f{i}.bin" for i in range(6)]
@@ -36,6 +36,10 @@ def make_store(store_cls):
         srv = fake_cassandra()
         s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
                      password=srv.PASSWORD)
+    elif store_cls is EtcdStore:
+        srv = fake_etcd()
+        s.initialize(addr=f"127.0.0.1:{srv.port}", user=srv.USER,
+                     password=srv.PASSWORD)
     else:
         s.initialize()
     return s
@@ -44,7 +48,7 @@ def make_store(store_cls):
 @pytest.mark.parametrize("store_cls",
                          [MemoryStore, SqliteStore, ShardedStore,
                           RedisStore, MysqlStore, PostgresStore,
-                          CassandraStore])
+                          CassandraStore, EtcdStore])
 @pytest.mark.parametrize("seed", [41, 42, 43])
 def test_filer_random_ops_match_model(store_cls, seed):
     rng = np.random.default_rng(seed)
@@ -109,7 +113,7 @@ def _check(f: Filer, model: dict):
 @pytest.mark.parametrize("store_cls",
                          [MemoryStore, SqliteStore, ShardedStore,
                           RedisStore, MysqlStore, PostgresStore,
-                          CassandraStore])
+                          CassandraStore, EtcdStore])
 def test_filer_recursive_delete_fuzz(store_cls):
     """Random trees, then a recursive delete of a random subtree: only
     that subtree disappears."""

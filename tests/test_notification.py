@@ -71,10 +71,92 @@ def test_log_publisher_writes():
     assert "/k" in stream.getvalue()
 
 
-def test_stub_publisher_raises():
-    p = make_publisher("gocdk_pub_sub")
-    with pytest.raises(RuntimeError, match="gocdk_pub_sub"):
-        p.send("/k", {})
+class TestGocdkDispatch:
+    """gocdk_pub_sub meta-publisher: the topic_url scheme must route to
+    the matching native publisher (reference gocdk_pub_sub.go's
+    pubsub.OpenTopic URL model)."""
+
+    def test_mem_scheme_delivers(self):
+        p = make_publisher("gocdk_pub_sub", topic_url="mem://events")
+        p.send("/k", {"v": 1})
+        assert p._inner.events == [("/k", {"v": 1})]
+
+    def test_kafka_scheme_routes_to_wire_producer(self):
+        broker = FakeBroker(topic="cdk-top", partitions=1)
+        p = make_publisher(
+            "gocdk_pub_sub", topic_url="kafka://cdk-top",
+            hosts=f"127.0.0.1:{broker.port}")
+        p.send("/a", {"n": 7})
+        p.close()
+        broker.stop()
+        assert len(broker.produced) == 1
+        assert broker.produced[0][1] == b"/a"
+
+    def test_kafka_needs_brokers(self):
+        with pytest.raises(ValueError, match="KAFKA_BROKERS"):
+            make_publisher("gocdk_pub_sub", topic_url="kafka://t")
+
+    def test_webhook_scheme(self):
+        import json
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        got = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers["Content-Length"])
+                got.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, fmt, *args):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        p = make_publisher(
+            "gocdk_pub_sub",
+            topic_url=f"http://127.0.0.1:{srv.server_address[1]}/hook")
+        p.send("/w", {"x": 1})
+        srv.shutdown()
+        assert got == [{"key": "/w", "event": {"x": 1}}]
+
+    def test_awssqs_region_parse(self):
+        p = make_publisher(
+            "gocdk_pub_sub",
+            topic_url="awssqs://sqs.eu-west-1.amazonaws.com/123/q",
+            access_key="k", secret_key="s")
+        assert p._inner.region == "eu-west-1"
+        assert p._inner.queue_url == \
+            "https://sqs.eu-west-1.amazonaws.com/123/q"
+        with pytest.raises(ValueError, match="region"):
+            make_publisher("gocdk_pub_sub",
+                           topic_url="awssqs://myhost/123/q")
+
+    def test_gcppubsub_url_forms(self):
+        # full and shorthand forms must agree; creds are required by
+        # the wrapped publisher, so expect its actionable error
+        for url in ("gcppubsub://projects/p1/topics/t1",
+                    "gcppubsub://p1/t1"):
+            with pytest.raises(ValueError,
+                               match="google_application_credentials"):
+                make_publisher("gocdk_pub_sub", topic_url=url)
+
+    def test_url_wins_over_duplicate_option(self):
+        # a same-named option must not TypeError the wrapped publisher
+        # with a duplicate kwarg — the URL's value wins
+        p = make_publisher(
+            "gocdk_pub_sub",
+            topic_url="awssqs://sqs.eu-west-1.amazonaws.com/1/q"
+                      "?region=eu-west-1",
+            region="us-east-9", access_key="k", secret_key="s")
+        assert p._inner.region == "eu-west-1"
+
+    def test_unroutable_scheme_fails_loudly(self):
+        with pytest.raises(ValueError, match="rabbit"):
+            make_publisher("gocdk_pub_sub", topic_url="rabbit://ex")
+        with pytest.raises(ValueError, match="topic_url"):
+            make_publisher("gocdk_pub_sub")
 
 
 def test_unknown_publisher():
@@ -820,3 +902,30 @@ def test_pubsub_reauths_on_revoked_token(tmp_path):
         assert fake.auth_failures == ["bad bearer"]  # one 401, then ok
     finally:
         fake.stop()
+
+
+def test_publisher_from_config_sections_and_env_spelling():
+    from seaweedfs_tpu.notification.queues import publisher_from_config
+    # TOML spelling
+    p = publisher_from_config({"notification.webhook.enabled": True,
+                               "notification.webhook.url": "http://x/h",
+                               "notification.webhook.hmac_key": "k"})
+    assert p.name == "webhook" and p.url == "http://x/h" \
+        and p.hmac_key == "k"
+    # env spelling: WEED_NOTIFICATION_AWS_SQS_QUEUE_URL flattens with
+    # dots for the section AND the option
+    p = publisher_from_config({
+        "notification.aws.sqs.enabled": "true",
+        "notification.aws.sqs.queue.url": "https://sqs.x/1/q",
+        "notification.aws.sqs.region": "eu-west-1"})
+    assert p.name == "aws_sqs" and p.queue_url == "https://sqs.x/1/q"
+    assert publisher_from_config({}) is None
+    assert publisher_from_config(
+        {"notification.webhook.enabled": "false"}) is None
+
+
+def test_publisher_from_config_multiple_enabled_conflicts():
+    from seaweedfs_tpu.notification.queues import publisher_from_config
+    with pytest.raises(ValueError, match="more than one"):
+        publisher_from_config({"notification.memory.enabled": True,
+                               "notification.log.enabled": "true"})
