@@ -619,11 +619,68 @@ def run_cluster_drill_subprocess(size_mb: int, n_servers: int) -> dict:
         f"{out.stdout[-200:]} {out.stderr[-300:]}")
 
 
+def measure_data_plane(seconds: float = None) -> dict:
+    """The reference's published headline benchmark (README.md:477-522,
+    `weed benchmark`: 15,708 writes/s and 47,019 reads/s of 1KB files):
+    an in-process master+volume server driven by the C++ keep-alive
+    load engine (`weed benchmark -native`), so the number measures the
+    servers, not the Python client. Writes land on the native plane's
+    fast POST path, reads on its fast GET path; `errors` must be 0 for
+    the number to count."""
+    import io
+    import shutil as _shutil
+    from seaweedfs_tpu.command.benchmark import run_native_benchmark
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    seconds = seconds or float(os.environ.get("SW_BENCH_DP_SECONDS",
+                                              "5"))
+    workdir = tempfile.mkdtemp(prefix="swdp_")
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = None
+    try:
+        vs = VolumeServer(port=0,
+                          directories=[os.path.join(workdir, "v")],
+                          master_url=master.url, pulse_seconds=1,
+                          max_volume_counts=[8]).start()
+        time.sleep(2.5)  # volumes reach the master via pulse
+        buf = io.StringIO()
+        run_native_benchmark(master.url, file_size=1024,
+                             concurrency=int(os.environ.get(
+                                 "SW_BENCH_DP_CONNS", "12")),
+                             seconds=seconds, pool=2048, out=buf)
+        out = {}
+        for raw in buf.getvalue().splitlines():
+            if not raw.startswith("{"):
+                continue
+            p = json.loads(raw)
+            key = "write" if p["phase"] == "write" else "read"
+            out[f"{key}_rps"] = p["rps"]
+            out[f"{key}_errors"] = p["errors"]
+        # reference README req/s on its MacBook-i7 run (BASELINE.md)
+        out["vs_ref_write_15708"] = round(out["write_rps"] / 15708.23, 2)
+        out["vs_ref_read_47019"] = round(out["read_rps"] / 47019.38, 2)
+        out["file_size"] = 1024
+        out["note"] = ("native C++ data plane under the native load "
+                       "engine, 1KB files; reference numbers were "
+                       "measured on different hardware (MacBook i7)")
+        log(f"data plane: {out}")
+        return out
+    finally:
+        if vs is not None:
+            vs.stop()
+        master.stop()
+        _shutil.rmtree(workdir, ignore_errors=True)
+
+
 def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
-    """BASELINE configs 3-5, each scaled by env and individually
-    fault-isolated (they report alongside the headline, never instead
-    of it)."""
+    """BASELINE configs 3-5 plus the reference's own req/s headline,
+    each scaled by env and individually fault-isolated (they report
+    alongside the headline, never instead of it)."""
     extras = {}
+    try:
+        extras["data_plane"] = measure_data_plane()
+    except Exception as e:  # noqa: BLE001 - secondary
+        log(f"data-plane bench failed: {e!r}")
     try:
         extras["rs_geometries"] = measure_geometries(
             int(os.environ.get("SW_BENCH_GEO_MB", "256")),

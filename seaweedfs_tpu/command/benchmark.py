@@ -236,7 +236,19 @@ def run_native_benchmark(master_url: str, file_size: int = 1024,
         granted = max(1, min(int(a.get("count", 1)), pool - assigned))
         url = a.get("fastUrl") or a["url"]
         host, _, port = url.rpartition(":")
-        host = socket.gethostbyname(host.strip("[]") or "127.0.0.1")
+        host = host.strip("[]") or "127.0.0.1"
+        # the C++ engine dials IPv4 (inet_addr); prefer an A record and
+        # fail with the reason rather than a bare gaierror when the
+        # host is AAAA-only
+        try:
+            infos = socket.getaddrinfo(host, int(port),
+                                       socket.AF_INET,
+                                       socket.SOCK_STREAM)
+            host = infos[0][4][0]
+        except socket.gaierror as e:
+            raise RuntimeError(
+                f"benchmark -native needs an IPv4 route to {host!r} "
+                f"(the native engine dials IPv4): {e}") from e
         bucket = targets.setdefault((host, int(port)), [])
         for fid in op.expand_batch_fids(a["fid"], granted):
             bucket.append("/" + fid)

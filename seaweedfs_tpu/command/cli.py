@@ -56,7 +56,29 @@ def _apply_tls_config(args):
 def cmd_master(args):
     _apply_security_config(args)
     from ..server.master import MasterServer
+    sequencer = None
+    if args.sequencer == "etcd":
+        # reference -master.sequencer etcd (weed/sequence/
+        # etcd_sequencer.go): file keys granted by CAS blocks on an
+        # external etcd shared by every master
+        from ..topology.topology import EtcdSequencer
+        meta_dir = args.mdir
+        if not meta_dir:
+            # sequencer.dat must never silently vanish (same hazard as
+            # raft persistence, master.py raft_dir fallback): without
+            # it a wiped etcd + restart re-mints live file ids
+            import tempfile
+            meta_dir = os.path.join(tempfile.gettempdir(),
+                                    "weed-tpu-raft")
+            os.makedirs(meta_dir, exist_ok=True)
+        sequencer = EtcdSequencer(args.sequencerEtcd,
+                                  user=args.sequencerEtcdUser,
+                                  password=args.sequencerEtcdPassword,
+                                  meta_dir=meta_dir)
+        print(f"sequencer: etcd at {args.sequencerEtcd} "
+              f"(ceiling file in {meta_dir})")
     m = MasterServer(port=args.port, host=args.ip,
+                     sequencer=sequencer,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds,
@@ -763,6 +785,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "leader (0 disables; reference "
                         "StartRefreshWritableVolumes)")
     m.add_argument("-garbageThreshold", type=float, default=0.3)
+    m.add_argument("-sequencer", default="auto",
+                   choices=["auto", "etcd"],
+                   help="file-key sequencer: auto = in-memory "
+                        "(raft-granted when -peers is set); etcd = "
+                        "CAS blocks on an external etcd "
+                        "(reference etcd_sequencer.go)")
+    m.add_argument("-sequencerEtcd", default="127.0.0.1:2379",
+                   help="etcd endpoint for -sequencer etcd")
+    m.add_argument("-sequencerEtcdUser", default="")
+    m.add_argument("-sequencerEtcdPassword", default="")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="start a volume server")

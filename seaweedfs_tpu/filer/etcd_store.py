@@ -67,6 +67,17 @@ class EtcdClient:
     (etcd tokens expire server-side).
     """
 
+    @classmethod
+    def from_addr(cls, addr: str, **kw) -> "EtcdClient":
+        """Single owner of the endpoint-spelling convention for every
+        etcd consumer (filer store, master sequencer): host:port with
+        bracketed-IPv6 tolerance."""
+        host, _, port = addr.rpartition(":")
+        host = host.strip("[]")  # bracketed IPv6: [::1]:2379
+        if not host or not port.isdigit():
+            raise ValueError(f"bad etcd addr {addr!r}: want host:port")
+        return cls(host, int(port), **kw)
+
     def __init__(self, host: str, port: int, user: str = "",
                  password: str = "", timeout: float = 10.0,
                  api_prefix: str = "/v3"):
@@ -170,6 +181,27 @@ class EtcdClient:
         reply = self._call("/kv/deleterange", payload)
         return int(reply.get("deleted", 0))
 
+    def put_if(self, key: bytes, expect: Optional[bytes],
+               new_value: bytes) -> bool:
+        """Single-key compare-and-swap via /kv/txn: put `new_value` iff
+        the key's current value is `expect` (None = iff the key does
+        not exist, compared on create_revision == 0 per etcd
+        convention). Returns whether the txn succeeded. Field names are
+        the snake_case protobuf originals, which etcd's JSON gateway
+        always accepts."""
+        if expect is None:
+            compare = {"key": _b64(key), "target": "CREATE",
+                       "create_revision": "0"}
+        else:
+            compare = {"key": _b64(key), "target": "VALUE",
+                       "value": _b64(expect)}
+        reply = self._call("/kv/txn", {
+            "compare": [compare],
+            "success": [{"request_put": {"key": _b64(key),
+                                         "value": _b64(new_value)}}],
+        })
+        return bool(reply.get("succeeded"))
+
     def close(self):
         with self._lock:
             if self._conn is not None:
@@ -194,13 +226,10 @@ class EtcdStore(FilerStore):
     def initialize(self, addr: str = "127.0.0.1:2379", user: str = "",
                    password: str = "", timeout: float = 10.0,
                    api_prefix: str = "/v3", **options):
-        host, _, port = addr.rpartition(":")
-        host = host.strip("[]")  # bracketed IPv6: [::1]:2379
-        if not host or not port.isdigit():
-            raise ValueError(f"bad etcd addr {addr!r}: want host:port")
-        self._client = EtcdClient(host, int(port), user=user,
-                                  password=password, timeout=timeout,
-                                  api_prefix=api_prefix)
+        self._client = EtcdClient.from_addr(addr, user=user,
+                                            password=password,
+                                            timeout=timeout,
+                                            api_prefix=api_prefix)
         if user:
             self._client.authenticate()
         # fail fast on a bad endpoint (empty range on our own keyspace)

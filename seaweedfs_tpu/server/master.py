@@ -32,10 +32,10 @@ class MasterServer:
                  maintenance_interval: float = 17 * 60,
                  vacuum_interval: float = 15 * 60,
                  whitelist=(), metrics_address: str = "",
-                 metrics_interval: int = 15):
+                 metrics_interval: int = 15, sequencer=None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
-            pulse_seconds=pulse_seconds)
+            pulse_seconds=pulse_seconds, sequencer=sequencer)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.jwt_signing_key = jwt_signing_key
@@ -138,8 +138,11 @@ class MasterServer:
             # has a consensus log). Installed BEFORE RaftNode so a
             # disk-restored snapshot's sequence_ceiling lands in it;
             # the lambda resolves self.raft lazily for the same reason.
-            self.topology.sequencer = RaftSequencer(
-                lambda cmd: self.raft.propose(cmd))
+            # An explicitly injected sequencer (e.g. EtcdSequencer,
+            # which coordinates across masters on its own) wins.
+            if sequencer is None:
+                self.topology.sequencer = RaftSequencer(
+                    lambda cmd: self.raft.propose(cmd))
 
             def _snapshot_state():
                 state = {"max_volume_id": self._raft_committed_max_vid}

@@ -8,6 +8,7 @@ out file ids (sequencer), and scans for vacuum candidates.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -126,6 +127,126 @@ class RaftSequencer(Sequencer):
     def ceiling(self) -> int:
         with self._lock:
             return self._ceiling
+
+
+class EtcdSequencer(Sequencer):
+    """File-key generator backed by an EXTERNAL etcd — the reference's
+    exact etcd-sequencer slot (weed/sequence/etcd_sequencer.go): grab
+    key blocks by compare-and-swapping a shared counter key upward (one
+    etcd round trip amortized over `block` ids), so any number of
+    masters sharing the etcd can never mint the same id; persist the
+    granted ceiling to <meta_dir>/sequencer.dat like the reference, and
+    seed etcd up to the file's value at boot (a wiped etcd cannot
+    roll ids backwards under a surviving master).
+
+    The raft-backed sequencer (RaftSequencer) fills this HA role
+    without an external dependency; this variant exists for operators
+    who already run etcd and want the reference's topology.
+    """
+
+    KEY = b"/seaweedfs/master/sequence"
+    DEFAULT_BLOCK = 500  # reference DefaultEtcdSteps
+
+    def __init__(self, addr: str, user: str = "", password: str = "",
+                 meta_dir: str = "", block: int = DEFAULT_BLOCK,
+                 api_prefix: str = "/v3"):
+        super().__init__()
+        # the etcd wire client lives with the etcd filer store; the
+        # sequencer is a second consumer of the same gateway protocol
+        from ..filer.etcd_store import EtcdClient
+        self._client = EtcdClient.from_addr(addr, user=user,
+                                            password=password,
+                                            api_prefix=api_prefix)
+        if user:
+            self._client.authenticate()
+        self._block = max(1, int(block))
+        self._window_end = 0  # exclusive top of OUR granted window
+        self._seq_file = os.path.join(meta_dir, "sequencer.dat") \
+            if meta_dir else ""
+        seed = 0
+        if self._seq_file and os.path.exists(self._seq_file):
+            try:
+                with open(self._seq_file) as f:
+                    seed = int(f.read().strip() or "0")
+            except ValueError:
+                seed = 0
+        if seed:
+            self._raise_etcd_to(seed)
+
+    # -- etcd CAS ---------------------------------------------------------
+
+    def _read_current(self):
+        kvs = self._client.range(self.KEY)
+        if not kvs:
+            return None
+        try:
+            return int(kvs[0][1])
+        except ValueError:
+            raise RuntimeError(
+                f"etcd sequence key {self.KEY!r} holds non-integer "
+                f"{kvs[0][1]!r}")
+
+    def _raise_etcd_to(self, floor: int):
+        """CAS the shared counter up to at least `floor` (no grant)."""
+        while True:
+            cur = self._read_current()
+            if cur is not None and cur >= floor:
+                return
+            expect = None if cur is None else str(cur).encode()
+            if self._client.put_if(self.KEY, expect,
+                                   str(floor).encode()):
+                return
+
+    def _grant(self, need: int) -> int:
+        """CAS a block of `need` ids; returns the window base
+        (exclusive — we own (base, base+need])."""
+        while True:
+            cur = self._read_current()
+            base = cur or 0
+            expect = None if cur is None else str(cur).encode()
+            if self._client.put_if(self.KEY, expect,
+                                   str(base + need).encode()):
+                if self._seq_file:
+                    tmp = self._seq_file + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(str(base + need))
+                    os.replace(tmp, self._seq_file)
+                return base
+
+    # -- Sequencer --------------------------------------------------------
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            if self._counter + count - 1 < self._window_end:
+                start = self._counter
+                self._counter += count
+                return start
+            need = max(self._block, count)
+            base = self._grant(need)
+            start = max(base + 1, self._counter)
+            if start + count - 1 > base + need:
+                # local counter (via set_max) sits above even the fresh
+                # grant: push etcd up and regrant from there
+                self._raise_etcd_to(start - 1)
+                base = self._grant(need)
+                start = max(base + 1, self._counter)
+            self._counter = start + count
+            self._window_end = base + need + 1
+            return start
+
+    def set_max(self, seen: int):
+        with self._lock:
+            if seen < self._counter:
+                return
+            if seen < self._window_end - 1:
+                self._counter = seen + 1
+                return
+            self._counter = seen + 1
+            self._window_end = 0  # force a regrant above `seen`
+        self._raise_etcd_to(seen)
+
+    def close(self):
+        self._client.close()
 
 
 class Topology:

@@ -1607,6 +1607,38 @@ class FakeEtcd:
                         with fake.lock:
                             fake.kv[key] = value
                         return self._reply({"header": {}})
+                    if self.path == "/v3/kv/txn":
+                        with fake.lock:
+                            ok = True
+                            for c in req.get("compare", []):
+                                key = base64.b64decode(
+                                    c["key"], validate=True)
+                                if c.get("target") == "CREATE":
+                                    want_missing = str(
+                                        c.get("create_revision",
+                                              "0")) == "0"
+                                    ok &= (key not in fake.kv) \
+                                        == want_missing
+                                elif c.get("target") == "VALUE":
+                                    ok &= fake.kv.get(key) == \
+                                        base64.b64decode(
+                                            c.get("value", ""),
+                                            validate=True)
+                                else:
+                                    return self._err(
+                                        "etcdserver: unsupported "
+                                        "compare target")
+                            branch = req.get(
+                                "success" if ok else "failure", [])
+                            for op in branch:
+                                put = op.get("request_put")
+                                if put:
+                                    fake.kv[base64.b64decode(
+                                        put["key"], validate=True)] = \
+                                        base64.b64decode(
+                                            put.get("value", ""),
+                                            validate=True)
+                        return self._reply({"succeeded": ok})
                     if self.path in ("/v3/kv/range",
                                      "/v3/kv/deleterange"):
                         key = b64key("key")
