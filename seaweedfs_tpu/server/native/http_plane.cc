@@ -630,9 +630,12 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
         if (timegm(&ims) >= n.last_modified) {
           std::string hdr = "Last-Modified: " + lm_header +
                             "\r\nEtag: \"" + etag + "\"\r\n";
+          // counters bump BEFORE the response bytes leave: an observer
+          // that has received the response must see the count (a
+          // post-send bump races clients on a loaded single-core host)
+          s->served++;
           respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
                          "application/octet-stream");
-          s->served++;
           return;
         }
       }
@@ -665,9 +668,9 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
     if (match) {
       // header set mirrors the Python 304 (Etag + default octet-stream)
       std::string hdr = "Etag: " + quoted + "\r\n";
+      s->served++;  // before the send — see the IMS 304 comment
       respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
                      "application/octet-stream");
-      s->served++;
       return;
     }
   }
@@ -717,12 +720,12 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
             std::to_string(total) + "\r\n";
   head += req.keepalive ? "Connection: keep-alive\r\n\r\n"
                         : "Connection: close\r\n\r\n";
+  s->served++;  // before the send — see the IMS 304 comment
   if (req.method == "HEAD")
     send_all(fd, head.data(), head.size());
   else
     send_two(fd, head.data(), head.size(), body + start,
              static_cast<size_t>(length));
-  s->served++;
 }
 
 // ----------------------------------------------------------------- write
@@ -1120,9 +1123,9 @@ void serve_write(Server* s, int fd, const Request& req,
   json_escape(filename, &resp);
   resp += "\", \"size\": " + std::to_string(data_len) +
           ", \"eTag\": \"" + etag + "\"}";
+  s->written++;  // before the send — see the IMS 304 comment
   respond_simple(fd, 200, "OK", resp, req.keepalive, "",
                  "application/json");
-  s->written++;
 }
 
 // Plain needle DELETE on the fast path: tombstone append under the
@@ -1209,10 +1212,10 @@ void serve_delete(Server* s, int fd, const Request& req, uint32_t vid,
                    "application/json");
     return;
   }
+  s->written++;  // before the send — see the IMS 304 comment
   respond_simple(fd, 200, "OK",
                  "{\"size\": " + std::to_string(freed) + "}",
                  req.keepalive, "", "application/json");
-  s->written++;
 }
 
 void handle_conn(Server* s, int fd) {
