@@ -513,7 +513,8 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         # encode + spread via the shell orchestration
         import seaweedfs_tpu.shell  # noqa: F401
         from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
-        env = CommandEnv(master.url)
+        # shell progress to stderr: stdout carries ONLY the bench JSON
+        env = CommandEnv(master.url, out=sys.stderr)
         t_encode = time.perf_counter()
         run_command(env, f"ec.encode -volumeId {vid}")
         encode_s = time.perf_counter() - t_encode

@@ -12,7 +12,8 @@ import time
 
 import pytest
 
-from seaweedfs_tpu.server.http_util import (HttpError, http_call,
+from seaweedfs_tpu.server.http_util import (HttpError, get_json,
+                                            http_call,
                                             http_get_with_headers,
                                             post_json, post_multipart)
 from seaweedfs_tpu.server.master import MasterServer
@@ -658,3 +659,75 @@ class TestPlaneHealthRatio:
         served = vs.fast_plane.served - base_served
         redirected = vs.fast_plane.redirected - base_redir
         assert redirected / max(1, served + redirected) < 0.01
+
+
+class TestNativeBenchmarkMode:
+    """`weed benchmark -native`: the C++ engine driven through
+    run_native_benchmark against live in-process servers — the path
+    bench.py's data_plane section and the CLI both take."""
+
+    def test_single_target_write_then_read(self, cluster, capsys):
+        from seaweedfs_tpu.command.benchmark import run_native_benchmark
+        master, vs = cluster
+        before_written = vs.fast_plane.written
+        read_errors = run_native_benchmark(
+            master.url, file_size=512, concurrency=4, seconds=1.0,
+            pool=64)
+        assert read_errors == 0
+        # every write landed on the native plane
+        assert vs.fast_plane.written > before_written
+        lines = [json.loads(raw) for raw
+                 in capsys.readouterr().out.splitlines()
+                 if raw.startswith("{")]
+        phases = {p["phase"]: p for p in lines}
+        assert phases["write"]["errors"] == 0
+        assert phases["write"]["requests"] > 0
+        assert phases["random read"]["errors"] == 0
+        assert phases["write"]["connections"] == 4
+
+    def test_two_targets_split_connections(self, cluster, tmp_path,
+                                           capsys):
+        from seaweedfs_tpu.command.benchmark import run_native_benchmark
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        master, vs = cluster
+        vs2 = VolumeServer(port=0, directories=[str(tmp_path / "v1")],
+                           master_url=master.url, pulse_seconds=1,
+                           max_volume_counts=[10],
+                           ec_backend="numpy").start()
+        try:
+            # wait until BOTH servers are registered — a fixed sleep
+            # would let a loaded host degrade this into a single-target
+            # run that tests nothing new
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                st = get_json(f"http://{master.url}/dir/status")
+                # topology.to_dict: data_centers -> {dc: {rack: {url:
+                # node}}}
+                nodes = sum(len(nodes_by_url)
+                            for dc in st["topology"]
+                            .get("data_centers", {}).values()
+                            for nodes_by_url in dc.values())
+                if nodes >= 2:
+                    break
+                time.sleep(0.2)
+            assert nodes >= 2, "second volume server never registered"
+            # assigns spread over many volumes so with 256 fids both
+            # servers get a share (growth allocates round-robin-ish)
+            run_native_benchmark(master.url, file_size=512,
+                                 concurrency=5, seconds=1.0, pool=256,
+                                 assign_batch=16)
+            lines = [json.loads(raw) for raw
+                     in capsys.readouterr().out.splitlines()
+                     if raw.startswith("{")]
+            phases = {p["phase"]: p for p in lines}
+            # exactly the requested connections, split across targets
+            assert phases["write"]["connections"] == 5
+            assert phases["write"]["errors"] == 0
+            assert phases["random read"]["errors"] == 0
+            assert phases["write"]["targets"] == 2, \
+                "assign pool never spread over both servers"
+            # both planes took native writes
+            assert vs.fast_plane.written > 0
+            assert vs2.fast_plane.written > 0
+        finally:
+            vs2.stop()
