@@ -446,7 +446,8 @@ def cmd_compact(args):
 
 def cmd_watch(args):
     from ..replication.sub import EventSubscriber, format_event
-    sub = EventSubscriber(args.filer, since=args.since)
+    sub = EventSubscriber(args.filer, since=args.since,
+                          path_prefix=args.pathPrefix)
     try:
         for ts, event in sub.follow():
             print(format_event(ts, event), flush=True)
@@ -1029,6 +1030,10 @@ def build_parser() -> argparse.ArgumentParser:
     wt.add_argument("-filer", default="127.0.0.1:8888")
     wt.add_argument("-since", type=float, default=0.0,
                     help="resume from this event timestamp")
+    wt.add_argument("-pathPrefix", default="",
+                    help="only events under this path prefix "
+                         "(reference watch -pathPrefix; filtered "
+                         "server-side)")
     wt.set_defaults(fn=cmd_watch)
 
     fc = sub.add_parser("filer.copy",

@@ -16,31 +16,44 @@ from ..server.http_util import HttpError, get_json
 
 class EventSubscriber:
     def __init__(self, filer_url: str, since: float = 0.0,
-                 poll_timeout: float = 10.0):
+                 poll_timeout: float = 10.0, path_prefix: str = ""):
         self.filer_url = filer_url
         self.since = since
         self.poll_timeout = poll_timeout
+        self.path_prefix = path_prefix
         self.stopped = False
+        self._batch_cursor = since  # scanned mark of the last poll
 
     def poll_once(self, advance: bool = True):
         """One long-poll; returns the (possibly empty) event batch. With
         advance=False the cursor stays put — callers that might fail to
         apply the batch (a replicator with its sink down) commit() only
         after the whole batch landed, so nothing is ever skipped."""
-        q = urllib.parse.urlencode(
-            {"since": repr(self.since), "timeout": self.poll_timeout})
+        params = {"since": repr(self.since),
+                  "timeout": self.poll_timeout}
+        if self.path_prefix:
+            # server-side filter (reference watch -pathPrefix)
+            params["prefix"] = self.path_prefix
+        q = urllib.parse.urlencode(params)
         out = get_json(f"http://{self.filer_url}/filer/events?{q}",
                        timeout=self.poll_timeout + 30)
         events = out.get("events", [])
-        if events and advance:
-            self.since = max(e["ts"] for e in events)
+        # the server's scanned high-water mark covers every event it
+        # looked at, INCLUDING ones the prefix filter dropped — safe to
+        # resume from (dropped events can never concern this watcher)
+        self._batch_cursor = max(self._batch_cursor,
+                                 float(out.get("cursor", self.since)))
+        if advance:
+            self.since = max(self.since, self._batch_cursor)
         return events
 
     def commit(self, events):
-        """Advance the cursor past an applied batch."""
-        if events:
-            self.since = max(self.since,
-                             max(e["ts"] for e in events))
+        """Advance the cursor past an applied batch (and past whatever
+        filtered-out foreign events the server scanned alongside it —
+        an advance=False + prefix consumer would otherwise busy-loop
+        rescanning them)."""
+        hi = max((e["ts"] for e in events), default=self.since)
+        self.since = max(self.since, hi, self._batch_cursor)
 
     def follow(self) -> Iterator[Tuple[float, dict]]:
         """Yield (ts, event) forever (until .stopped is set). Transient

@@ -195,9 +195,41 @@ class FilerServer:
     def events_handler(self, req: Request):
         since = float(req.query.get("since", 0) or 0)
         timeout = min(float(req.query.get("timeout", 10) or 10), 55.0)
-        events = self.log_buffer.wait_since(since, timeout=timeout)
-        return {"events": [
-            {"ts": t, "event": e} for t, e in events]}
+        # server-side path filter like the reference's ListenForEvents
+        # PathPrefix (weed/command/watch.go -pathPrefix): a subscriber
+        # watching /buckets/x must not pay for the whole event stream
+        prefix = req.query.get("prefix", "")
+
+        def touches(e: dict) -> bool:
+            # an event matches if EITHER side of the mutation lives
+            # under the prefix (a rename out of the watched tree must
+            # still reach the subscriber as its delete half)
+            for side in ("newEntry", "oldEntry"):
+                ent = e.get(side)
+                if ent and str(ent.get("path", "")).startswith(prefix):
+                    return True
+            return False
+
+        # cursor = the scanned high-water mark. Without it, a batch
+        # that the prefix filter empties would leave the client's
+        # `since` untouched and the next long-poll would return (and
+        # refilter) the same events immediately — a busy loop. And
+        # when the filter empties a batch mid-timeout, keep waiting
+        # server-side: a /quiet watcher on a filer ingesting a heavy
+        # foreign stream must not pay one round trip per foreign batch
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = max(0.0, deadline - time.monotonic())
+            events = self.log_buffer.wait_since(since, timeout=remaining)
+            cursor = max((t for t, _ in events), default=since)
+            if prefix and events:
+                events = [(t, e) for t, e in events if touches(e)]
+                if not events and deadline - time.monotonic() > 0:
+                    since = cursor
+                    continue
+            return {"cursor": cursor,
+                    "events": [{"ts": t, "event": e}
+                               for t, e in events]}
 
     def data_handler(self, req: Request):
         # normpath strips the trailing slash, which carries meaning for

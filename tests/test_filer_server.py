@@ -408,3 +408,42 @@ def test_mode_param_and_skip_chunk_deletion(cluster):
     # give the deletion queue a beat: nothing should reap the chunk
     time.sleep(1.5)
     assert op.read_file(master.url, fid) == b"moded-content"
+
+
+def test_events_path_prefix_filter(cluster):
+    """Server-side prefix filter (reference watch -pathPrefix) plus the
+    cursor that prevents a busy loop when a batch filters to empty."""
+    from seaweedfs_tpu.replication import EventSubscriber
+    _, _, filer = cluster
+    post_multipart(furl(filer, "/pfx/in.txt"), "in.txt", b"a")
+    post_multipart(furl(filer, "/other/out.txt"), "out.txt", b"b")
+    out = get_json(furl(filer,
+                        "/filer/events?since=0&timeout=2&prefix=/pfx"))
+    paths = [(e["event"].get("newEntry") or
+              e["event"].get("oldEntry") or {}).get("path")
+             for e in out["events"]]
+    assert "/pfx/in.txt" in paths
+    assert all(str(p).startswith("/pfx") for p in paths)
+    # cursor covers the filtered-out /other event too
+    assert out["cursor"] >= max(
+        e["ts"] for e in get_json(
+            furl(filer, "/filer/events?since=0&timeout=0.2"))["events"])
+
+    # a subscriber watching a prefix that matches NOTHING must advance
+    # past foreign events rather than rescan them forever
+    sub = EventSubscriber(filer.url, path_prefix="/nothing-matches",
+                          poll_timeout=0.2)
+    assert sub.poll_once() == []
+    advanced = sub.since
+    assert advanced > 0  # jumped to the scanned high-water mark
+    assert sub.poll_once() == []
+    assert sub.since >= advanced
+
+    # the replicator pattern (advance=False, then commit) must also
+    # advance past scanned-but-filtered batches via commit
+    sub2 = EventSubscriber(filer.url, path_prefix="/nothing-matches",
+                           poll_timeout=0.2)
+    batch = sub2.poll_once(advance=False)
+    assert batch == [] and sub2.since == 0.0
+    sub2.commit(batch)
+    assert sub2.since > 0  # commit consumed the scanned mark
