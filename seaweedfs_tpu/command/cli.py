@@ -555,7 +555,12 @@ def cmd_filer_replicate(args):
                          path_prefix=src_cfg.get("path", "/"))
     sink = make_sink(cfg["sink"])
     rep = Replicator(source, sink)
-    sub = EventSubscriber(src_cfg["filer"], since=args.since)
+    # the replicator still routes by source.path_prefix; the server-side
+    # prefix just keeps foreign-path event batches off the wire
+    sub = EventSubscriber(src_cfg["filer"], since=args.since,
+                          path_prefix=(source.path_prefix
+                                       if source.path_prefix != "/"
+                                       else ""))
     print(f"replicating {src_cfg['filer']}{source.path_prefix} "
           f"-> {sink.kind} sink", flush=True)
     import time as _time
@@ -597,7 +602,8 @@ def cmd_mount(args):
         fs = WeedFS(args.filer, master_url=args.master,
                     chunk_size=args.chunkSizeLimitMB << 20,
                     collection=args.collection,
-                    replication=args.replication)
+                    replication=args.replication,
+                    root_path=args.filerPath)
         mount = FuseMount(fs, args.dir, allow_other=args.allowOthers)
     except FuseError as e:
         raise SystemExit(str(e))
@@ -1106,6 +1112,9 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("-replication", default="")
     mt.add_argument("-chunkSizeLimitMB", type=int, default=8)
     mt.add_argument("-allowOthers", action="store_true")
+    mt.add_argument("-filer.path", dest="filerPath", default="/",
+                    help="mount this remote subtree of the filer "
+                         "namespace (reference mount -filer.path)")
     mt.set_defaults(fn=cmd_mount)
 
     mb = sub.add_parser("msgBroker", help="message queue broker")

@@ -43,9 +43,13 @@ class WeedFS:
 
     def __init__(self, filer_url: str, master_url: str = "",
                  chunk_size: int = 8 << 20, collection: str = "",
-                 replication: str = ""):
+                 replication: str = "", root_path: str = "/"):
         self.client = FilerClient(filer_url)
         self.filer_url = filer_url
+        # -filer.path: mount a remote subtree (reference mount.go:29
+        # filerMountRootPath) — every kernel path maps under it
+        self.root_path = "/" + root_path.strip("/") \
+            if root_path.strip("/") else "/"
         if not master_url:
             master_url = get_json(
                 f"http://{filer_url}/filer/status")["master"]
@@ -59,7 +63,16 @@ class WeedFS:
 
     # -- helpers -----------------------------------------------------------
     def _path(self, raw) -> str:
+        """Decode only — for xattr names and symlink targets, which are
+        not filer paths and must never be root-remapped."""
         return raw.decode() if isinstance(raw, bytes) else raw
+
+    def _fpath(self, raw) -> str:
+        """Kernel path -> filer path (under -filer.path when set)."""
+        p = self._path(raw)
+        if self.root_path != "/":
+            p = self.root_path if p == "/" else self.root_path + p
+        return p
 
     def _entry(self, path: str) -> Entry:
         try:
@@ -110,15 +123,17 @@ class WeedFS:
 
     # -- fuse_operations ---------------------------------------------------
     def getattr(self, path, st):
-        p = self._path(path)
-        if p == "/":
+        if self._path(path) == "/":
+            # the mount root is synthetic — under -filer.path the
+            # remote subtree may not even exist yet (first write
+            # creates it), and a stat on it must still succeed
             self._fill_stat(st, None)
             return 0
-        self._fill_stat(st, self._entry(p))
+        self._fill_stat(st, self._entry(self._fpath(path)))
         return 0
 
     def readdir(self, path, buf, filler, offset, fi):
-        p = self._path(path)
+        p = self._fpath(path)
         filler(buf, b".", None, 0)
         filler(buf, b"..", None, 0)
         start = ""
@@ -132,7 +147,7 @@ class WeedFS:
             start = batch[-1].name
 
     def mkdir(self, path, mode):
-        p = self._path(path)
+        p = self._fpath(path)
         now = time.time()
         entry = Entry(full_path=p,
                       attr=Attr(mtime=now, crtime=now,
@@ -145,11 +160,11 @@ class WeedFS:
         return 0
 
     def unlink(self, path):
-        self._delete(self._path(path), recursive=False)
+        self._delete(self._fpath(path), recursive=False)
         return 0
 
     def rmdir(self, path):
-        p = self._path(path)
+        p = self._fpath(path)
         if self.client.list_entries(p, limit=1):
             raise OSError(errno.ENOTEMPTY, p)
         self._delete(p, recursive=False)
@@ -169,13 +184,13 @@ class WeedFS:
 
     def rename(self, old, new):
         try:
-            self.client.rename_entry(self._path(old), self._path(new))
+            self.client.rename_entry(self._fpath(old), self._fpath(new))
         except NotFoundError:
-            raise OSError(errno.ENOENT, self._path(old))
+            raise OSError(errno.ENOENT, self._fpath(old))
         return 0
 
     def chmod(self, path, mode):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         keep_dir = entry.is_directory
         entry.attr.mode = mode & 0o7777
         if keep_dir:
@@ -184,13 +199,13 @@ class WeedFS:
         return 0
 
     def chown(self, path, uid, gid):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         entry.attr.uid, entry.attr.gid = uid, gid
         self.client.update_entry(entry)
         return 0
 
     def utimens(self, path, times):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         if times:
             entry.attr.mtime = times[1].tv_sec
         else:
@@ -200,7 +215,7 @@ class WeedFS:
 
     # -- symlinks (reference weed/filesys/dir_link.go:15-45) ---------------
     def symlink(self, target, linkpath):
-        p = self._path(linkpath)
+        p = self._fpath(linkpath)
         now = time.time()
         entry = Entry(full_path=p,
                       attr=Attr(mtime=now, crtime=now, mode=0o777))
@@ -219,7 +234,7 @@ class WeedFS:
         return 0
 
     def readlink(self, path, buf, size):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         target = entry.attr.symlink_target
         if not target:
             raise OSError(errno.EINVAL, "not a symlink")
@@ -233,7 +248,7 @@ class WeedFS:
     _XATTR_CREATE, _XATTR_REPLACE = 1, 2
 
     def setxattr(self, path, name, value, size, flags):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         key = self._path(name)
         exists = key in (entry.extended or {})
         if flags & self._XATTR_CREATE and exists:
@@ -248,7 +263,7 @@ class WeedFS:
         return 0
 
     def getxattr(self, path, name, buf, size):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         data = (entry.extended or {}).get(self._path(name))
         if data is None:
             raise OSError(errno.ENODATA, self._path(name))
@@ -260,18 +275,18 @@ class WeedFS:
         return len(data)
 
     def listxattr(self, path, buf, size):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         blob = b"".join(k.encode() + b"\x00"
                         for k in sorted(entry.extended or {}))
         if size == 0:
             return len(blob)
         if size < len(blob):
-            raise OSError(errno.ERANGE, self._path(path))
+            raise OSError(errno.ERANGE, self._fpath(path))
         ctypes.memmove(buf, blob, len(blob))
         return len(blob)
 
     def removexattr(self, path, name):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         key = self._path(name)
         if key not in (entry.extended or {}):
             raise OSError(errno.ENODATA, key)
@@ -280,7 +295,7 @@ class WeedFS:
         return 0
 
     def create(self, path, mode, fi):
-        p = self._path(path)
+        p = self._fpath(path)
         now = time.time()
         entry = Entry(full_path=p,
                       attr=Attr(mtime=now, crtime=now,
@@ -293,7 +308,7 @@ class WeedFS:
         return 0
 
     def open(self, path, fi):
-        entry = self._entry(self._path(path))
+        entry = self._entry(self._fpath(path))
         fi.contents.fh = self._open_handle(entry)
         return 0
 
@@ -371,7 +386,7 @@ class WeedFS:
         materialize-to-length step would read only the stored chunks and
         overwrite the unflushed bytes with zeros (and a later flush could
         resurrect cut bytes)."""
-        p = self._path(path)
+        p = self._fpath(path)
         for h in self.handles.values():
             if h.entry.full_path == p and (h.dirty.intervals
                                            or h.pending_chunks):

@@ -40,9 +40,13 @@ class EventSubscriber:
         events = out.get("events", [])
         # the server's scanned high-water mark covers every event it
         # looked at, INCLUDING ones the prefix filter dropped — safe to
-        # resume from (dropped events can never concern this watcher)
-        self._batch_cursor = max(self._batch_cursor,
-                                 float(out.get("cursor", self.since)))
+        # resume from (dropped events can never concern this watcher).
+        # A pre-cursor server omits the field: fall back to the batch's
+        # own max ts, NOT self.since (that fallback would never advance
+        # and follow() would hot-loop re-fetching the same batch)
+        batch_hi = max((e["ts"] for e in events), default=self.since)
+        self._batch_cursor = max(self._batch_cursor, batch_hi,
+                                 float(out.get("cursor", 0) or 0))
         if advance:
             self.since = max(self.since, self._batch_cursor)
         return events

@@ -199,6 +199,10 @@ class FilerServer:
         # PathPrefix (weed/command/watch.go -pathPrefix): a subscriber
         # watching /buckets/x must not pay for the whole event stream
         prefix = req.query.get("prefix", "")
+        # component-boundary matching: /data must cover /data itself
+        # (deletes/chmods of the watched root) and /data/x, but never
+        # the sibling tree /database
+        base = prefix.rstrip("/")
 
         def touches(e: dict) -> bool:
             # an event matches if EITHER side of the mutation lives
@@ -206,7 +210,10 @@ class FilerServer:
             # still reach the subscriber as its delete half)
             for side in ("newEntry", "oldEntry"):
                 ent = e.get(side)
-                if ent and str(ent.get("path", "")).startswith(prefix):
+                if not ent:
+                    continue
+                path = str(ent.get("path", ""))
+                if path == base or path.startswith(base + "/"):
                     return True
             return False
 

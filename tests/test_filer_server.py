@@ -417,13 +417,26 @@ def test_events_path_prefix_filter(cluster):
     _, _, filer = cluster
     post_multipart(furl(filer, "/pfx/in.txt"), "in.txt", b"a")
     post_multipart(furl(filer, "/other/out.txt"), "out.txt", b"b")
+    # component boundary: a sibling tree sharing the prefix string must
+    # NOT match (/pfx must not capture /pfxother), while the watched
+    # root itself must
+    post_multipart(furl(filer, "/pfxother/sib.txt"), "sib.txt", b"c")
     out = get_json(furl(filer,
                         "/filer/events?since=0&timeout=2&prefix=/pfx"))
     paths = [(e["event"].get("newEntry") or
               e["event"].get("oldEntry") or {}).get("path")
              for e in out["events"]]
     assert "/pfx/in.txt" in paths
-    assert all(str(p).startswith("/pfx") for p in paths)
+    assert all(p == "/pfx" or str(p).startswith("/pfx/")
+               for p in paths), paths
+    # a trailing-slash prefix (FilerSource normalizes to '/pfx/') still
+    # matches the root-dir event for /pfx itself
+    out2 = get_json(furl(filer,
+                         "/filer/events?since=0&timeout=2&prefix=/pfx/"))
+    paths2 = [(e["event"].get("newEntry") or
+               e["event"].get("oldEntry") or {}).get("path")
+              for e in out2["events"]]
+    assert "/pfx" in paths2  # the mkdir event of the watched root
     # cursor covers the filtered-out /other event too
     assert out["cursor"] >= max(
         e["ts"] for e in get_json(

@@ -13,6 +13,25 @@ import pytest
 from seaweedfs_tpu.mount.dirty_pages import ContinuousIntervals
 
 
+@pytest.fixture
+def wfs_cluster(tmp_path):
+    """One master + volume + filer for ops-level WeedFS tests (shared
+    by TestWfsSpill / TestWfsXattrOps / TestFilerPathSubtree)."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vol = VolumeServer(port=0, directories=[str(tmp_path / "v0")],
+                       master_url=master.url, pulse_seconds=1,
+                       max_volume_counts=[20],
+                       ec_backend="numpy").start()
+    filer = FilerServer(port=0, master_url=master.url).start()
+    yield filer, master
+    filer.stop()
+    vol.stop()
+    master.stop()
+
+
 class TestContinuousIntervals:
     def test_single_and_merge_adjacent(self):
         ci = ContinuousIntervals()
@@ -113,20 +132,8 @@ class TestWfsSpill:
     (advisor finding: the mount used to hold whole files in memory)."""
 
     @pytest.fixture
-    def cluster(self, tmp_path):
-        from seaweedfs_tpu.server.filer_server import FilerServer
-        from seaweedfs_tpu.server.master import MasterServer
-        from seaweedfs_tpu.server.volume_server import VolumeServer
-        master = MasterServer(port=0, pulse_seconds=1).start()
-        vol = VolumeServer(port=0, directories=[str(tmp_path / "v0")],
-                           master_url=master.url, pulse_seconds=1,
-                           max_volume_counts=[20],
-                           ec_backend="numpy").start()
-        filer = FilerServer(port=0, master_url=master.url).start()
-        yield filer, master
-        filer.stop()
-        vol.stop()
-        master.stop()
+    def cluster(self, wfs_cluster):
+        return wfs_cluster
 
     def test_large_write_spills_and_roundtrips(self, cluster):
         import ctypes as C
@@ -359,24 +366,13 @@ class TestWfsXattrOps:
     kernel refuses to forward for xattr (see test_xattr_roundtrip)."""
 
     @pytest.fixture
-    def wfs(self, tmp_path):
-        from seaweedfs_tpu.mount.wfs import WeedFS
-        from seaweedfs_tpu.server.filer_server import FilerServer
-        from seaweedfs_tpu.server.master import MasterServer
-        from seaweedfs_tpu.server.volume_server import VolumeServer
-        master = MasterServer(port=0, pulse_seconds=1).start()
-        vol = VolumeServer(port=0, directories=[str(tmp_path / "v0")],
-                           master_url=master.url, pulse_seconds=1,
-                           max_volume_counts=[20],
-                           ec_backend="numpy").start()
-        filer = FilerServer(port=0, master_url=master.url).start()
-        fs = WeedFS(filer.url, master_url=master.url)
+    def wfs(self, wfs_cluster):
         from seaweedfs_tpu.filer.entry import Entry
+        from seaweedfs_tpu.mount.wfs import WeedFS
+        filer, master = wfs_cluster
+        fs = WeedFS(filer.url, master_url=master.url)
         fs.client.create_entry(Entry(full_path="/f.txt"))
-        yield fs, filer
-        filer.stop()
-        vol.stop()
-        master.stop()
+        return fs, filer
 
     @staticmethod
     def _set(fs, path, name, value, flags=0):
@@ -453,3 +449,53 @@ class TestWfsXattrOps:
         fs.getattr(b"/lnk", st)
         assert stat_mod.S_ISLNK(st.contents.st_mode)
         assert st.contents.st_size == len("/f.txt")
+
+
+class TestFilerPathSubtree:
+    """-filer.path (reference mount.go filerMountRootPath): the kernel
+    namespace maps under a remote subtree; xattr names and symlink
+    targets must NOT be remapped."""
+
+    @pytest.fixture
+    def cluster(self, wfs_cluster):
+        return wfs_cluster
+
+    def test_subtree_mapping(self, cluster):
+        import ctypes as C
+        from seaweedfs_tpu.mount.wfs import WeedFS
+        filer, master = cluster
+        wfs = WeedFS(filer.url, master_url=master.url,
+                     root_path="/sub/tree")
+        # root stat is synthetic even though /sub/tree doesn't exist
+        st = C.pointer(__import__(
+            "seaweedfs_tpu.mount.fuse_ll",
+            fromlist=["Stat"]).Stat())
+        assert wfs.getattr("/", st) == 0
+
+        fi = _FakeFi()
+        assert wfs.create("/a.txt", 0o644, fi) == 0
+        buf = C.create_string_buffer(b"subtree!", 8)
+        assert wfs.write("/a.txt", buf, 8, 0, fi) == 8
+        assert wfs.flush("/a.txt", fi) == 0
+        # the file landed under the remote subtree
+        entry = filer.filer.find_entry("/sub/tree/a.txt")
+        assert entry is not None
+
+        # xattr names are NOT remapped
+        assert wfs.setxattr("/a.txt", b"user.k", b"v", 1, 0) == 0
+        entry = filer.filer.find_entry("/sub/tree/a.txt")
+        assert entry.extended.get("user.k") == b"v"
+
+        # symlink target stored verbatim (absolute target must not
+        # gain the /sub/tree prefix)
+        assert wfs.symlink(b"/outside/t", b"/ln") == 0
+        entry = filer.filer.find_entry("/sub/tree/ln")
+        assert entry.attr.symlink_target == "/outside/t"
+
+        # rename stays inside the subtree
+        assert wfs.rename(b"/a.txt", b"/b.txt") == 0
+        assert filer.filer.find_entry("/sub/tree/b.txt") is not None
+        import pytest as _pytest
+        from seaweedfs_tpu.filer.filer import NotFoundError
+        with _pytest.raises(NotFoundError):
+            filer.filer.find_entry("/sub/tree/a.txt")
