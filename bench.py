@@ -515,6 +515,11 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
         # shell progress to stderr: stdout carries ONLY the bench JSON
         env = CommandEnv(master.url, out=sys.stderr)
+        # keep the drill bounded even if the device link degrades
+        # mid-run (the interactive shell default is a generous 3600s;
+        # a wedged tunnel would stall the whole bench on it)
+        env.admin_timeout = float(
+            os.environ.get("SW_BENCH_DRILL_TIMEOUT", "900"))
         t_encode = time.perf_counter()
         run_command(env, f"ec.encode -volumeId {vid}")
         encode_s = time.perf_counter() - t_encode

@@ -124,10 +124,20 @@ class WeedFS:
     # -- fuse_operations ---------------------------------------------------
     def getattr(self, path, st):
         if self._path(path) == "/":
-            # the mount root is synthetic — under -filer.path the
-            # remote subtree may not even exist yet (first write
-            # creates it), and a stat on it must still succeed
-            self._fill_stat(st, None)
+            # the mount root: report the remote entry's real
+            # attributes when it exists (so chmod/chown on the root of
+            # a -filer.path subtree read back correctly), but a stat
+            # must still succeed before the first write creates the
+            # subtree — hence the synthetic directory fallback
+            entry = None
+            if self.root_path != "/":
+                try:
+                    entry = self._entry(self.root_path)
+                except OSError:
+                    entry = None
+                if entry is not None and not entry.is_directory:
+                    entry = None
+            self._fill_stat(st, entry)
             return 0
         self._fill_stat(st, self._entry(self._fpath(path)))
         return 0

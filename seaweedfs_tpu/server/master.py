@@ -379,6 +379,9 @@ class MasterServer:
             if not self.is_leader():
                 continue
             env = CommandEnv(self.url)
+            # unattended cron: one wedged volume server must not stall
+            # the loop for the interactive shell's 3600s admin budget
+            env.admin_timeout = 900.0
             for line in self.maintenance_scripts:
                 try:
                     run_command(env, line)

@@ -25,6 +25,12 @@ class CommandEnv:
         self.master_url = master_url
         self.filer_url = filer_url
         self.cwd = "/"          # fs.* commands' working directory
+        # admin operations move whole volumes (encode/copy/rebuild of
+        # tens of GB): a short client deadline would orphan a
+        # still-running server-side op, so the cap is generous — the
+        # reference's gRPC admin streams carry no deadline at all.
+        # Batch drivers (bench) lower it to keep their runs bounded.
+        self.admin_timeout = 3600.0
         import sys
         self.out = out or sys.stdout
 
@@ -55,7 +61,10 @@ class CommandEnv:
     def master_post(self, path: str) -> dict:
         return post_json(f"http://{self.master_url}{path}")
 
-    def node_post(self, node: str, path: str, timeout: float = 600) -> dict:
+    def node_post(self, node: str, path: str,
+                  timeout: "float | None" = None) -> dict:
+        if timeout is None:
+            timeout = self.admin_timeout
         return post_json(f"http://{node}{path}", timeout=timeout)
 
     def node_get(self, node: str, path: str) -> dict:

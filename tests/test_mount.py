@@ -462,14 +462,13 @@ class TestFilerPathSubtree:
 
     def test_subtree_mapping(self, cluster):
         import ctypes as C
+        from seaweedfs_tpu.mount.fuse_ll import Stat
         from seaweedfs_tpu.mount.wfs import WeedFS
         filer, master = cluster
         wfs = WeedFS(filer.url, master_url=master.url,
                      root_path="/sub/tree")
         # root stat is synthetic even though /sub/tree doesn't exist
-        st = C.pointer(__import__(
-            "seaweedfs_tpu.mount.fuse_ll",
-            fromlist=["Stat"]).Stat())
+        st = C.pointer(Stat())
         assert wfs.getattr("/", st) == 0
 
         fi = _FakeFi()
@@ -495,7 +494,19 @@ class TestFilerPathSubtree:
         # rename stays inside the subtree
         assert wfs.rename(b"/a.txt", b"/b.txt") == 0
         assert filer.filer.find_entry("/sub/tree/b.txt") is not None
-        import pytest as _pytest
         from seaweedfs_tpu.filer.filer import NotFoundError
-        with _pytest.raises(NotFoundError):
+        with pytest.raises(NotFoundError):
             filer.filer.find_entry("/sub/tree/a.txt")
+
+        # once the subtree root exists, the mount root's getattr
+        # reports its REAL attributes, not the synthetic 0755 stat
+        import stat as stat_mod
+        root_entry = filer.filer.find_entry("/sub/tree")
+        root_entry.attr.mode = (root_entry.attr.mode & ~0o7777) | 0o700
+        root_entry.attr.uid = 1234
+        filer.filer.update_entry(root_entry)
+        st2 = C.pointer(Stat())
+        assert wfs.getattr("/", st2) == 0
+        assert stat_mod.S_ISDIR(st2.contents.st_mode)
+        assert st2.contents.st_mode & 0o7777 == 0o700
+        assert st2.contents.st_uid == 1234
