@@ -67,7 +67,9 @@ class Attr:
         return (self.mode & 0o170000) == 0o040000
 
     def set_directory(self):
-        self.mode = (self.mode & 0o777) | 0o040000
+        # keep setuid/setgid/sticky: masking to 0o777 here would strip
+        # them on every entry decode round-trip
+        self.mode = (self.mode & 0o7777) | 0o040000
 
 
 @dataclass

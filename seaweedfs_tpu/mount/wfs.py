@@ -89,8 +89,14 @@ class WeedFS:
             s.st_nlink = 2
             return
         mode = entry.attr.mode & 0o7777
+        # file-type bits on the stored mode mean the permission bits
+        # were explicitly set (chmod keeps them) — honor even 0000;
+        # entries from non-FUSE writers get per-kind defaults when
+        # their bare mode is 0
+        explicit = bool(entry.attr.mode & 0o170000)
         if entry.is_directory:
-            s.st_mode = stat_mod.S_IFDIR | (mode or 0o755)
+            s.st_mode = stat_mod.S_IFDIR | \
+                (mode if explicit else (mode or 0o755))
             s.st_nlink = 2
         elif entry.attr.symlink_target:
             # a symlink's size is its target length (reference
@@ -99,7 +105,8 @@ class WeedFS:
             s.st_nlink = 1
             s.st_size = len(entry.attr.symlink_target.encode())
         else:
-            s.st_mode = stat_mod.S_IFREG | (mode or 0o644)
+            s.st_mode = stat_mod.S_IFREG | \
+                (mode if explicit else (mode or 0o644))
             s.st_nlink = 1
             s.st_size = total_size(entry.chunks)
         s.st_uid = entry.attr.uid
@@ -201,10 +208,11 @@ class WeedFS:
 
     def chmod(self, path, mode):
         entry = self._entry(self._fpath(path))
-        keep_dir = entry.is_directory
-        entry.attr.mode = mode & 0o7777
-        if keep_dir:
-            entry.attr.set_directory()
+        # keep the file-type bits: they preserve is_directory AND mark
+        # the permission bits as explicitly set, so a chmod 0000 reads
+        # back as 0000 instead of _fill_stat's legacy-entry default
+        kind = 0o040000 if entry.is_directory else 0o100000
+        entry.attr.mode = (mode & 0o7777) | kind
         self.client.update_entry(entry)
         return 0
 

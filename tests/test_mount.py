@@ -359,6 +359,37 @@ class TestFuseEndToEnd:
         assert sorted(os.listdir(mnt)) == []
 
 
+class TestWfsChmod:
+    """Permission read-back at the fuse_operations surface: chmod marks
+    the stored mode explicit (file-type bits), so even 0000 survives a
+    stat instead of being resurrected to the per-kind default."""
+
+    def test_chmod_0000_reads_back(self, wfs_cluster):
+        import ctypes as C
+        import stat as stat_mod
+        from seaweedfs_tpu.mount.fuse_ll import Stat
+        from seaweedfs_tpu.mount.wfs import WeedFS
+        filer, master = wfs_cluster
+        fs = WeedFS(filer.url, master_url=master.url)
+        assert fs.mkdir(b"/locked", 0o755) == 0
+        fi = _FakeFi()
+        assert fs.create(b"/locked/f.txt", 0o644, fi) == 0
+        assert fs.flush(b"/locked/f.txt", fi) == 0
+
+        for path, want_dir in ((b"/locked", True),
+                               (b"/locked/f.txt", False)):
+            assert fs.chmod(path, 0o000) == 0
+            st = C.pointer(Stat())
+            assert fs.getattr(path, st) == 0
+            assert st.contents.st_mode & 0o7777 == 0
+            assert stat_mod.S_ISDIR(st.contents.st_mode) == want_dir
+            # and a normal mode still round-trips
+            assert fs.chmod(path, 0o2750) == 0
+            st = C.pointer(Stat())
+            assert fs.getattr(path, st) == 0
+            assert st.contents.st_mode & 0o7777 == 0o2750
+
+
 class TestWfsXattrOps:
     """xattr + symlink at the fuse_operations surface (real ctypes
     buffers, the exact calling convention fuse_ll registers) against a
