@@ -53,8 +53,81 @@ def _apply_tls_config(args):
         configure_tls(cert, key, ca, mutual=mutual)
 
 
+def _apply_master_config(args) -> dict:
+    """master.toml / WEED_MASTER_* (reference scaffold.go
+    MASTER_TOML_EXAMPLE + master_server.go:187-232): config fills
+    whatever the flags left at their defaults — an explicit flag
+    always wins. Returns extra MasterServer kwargs that have no flag
+    spelling (growth counts, the maintenance shell's filer)."""
+    from ..util.config import config_get, load_config
+    cfg = load_config("master")
+    scripts = str(config_get(cfg, "master.maintenance.scripts", "")
+                  or "")
+    if scripts.strip() and not getattr(args, "maintenanceScripts", ""):
+        # reference scripts are newline-separated; the flag is ';'
+        args.maintenanceScripts = ";".join(
+            ln.strip() for ln in scripts.splitlines() if ln.strip())
+    sleep_m = config_get(cfg, "master.maintenance.sleep_minutes", None)
+    if sleep_m is not None and \
+            getattr(args, "maintenanceIntervalSeconds", 17 * 60) \
+            == 17 * 60:
+        args.maintenanceIntervalSeconds = float(sleep_m) * 60
+    if str(config_get(cfg, "master.sequencer.type", "")) == "etcd" \
+            and getattr(args, "sequencer", "auto") == "auto":
+        args.sequencer = "etcd"
+        urls = str(config_get(
+            cfg, "master.sequencer.sequencer_etcd_urls", "") or "")
+        if urls and getattr(args, "sequencerEtcd", "") \
+                in ("", "127.0.0.1:2379"):
+            from urllib.parse import urlparse
+            first = urls.split(",")[0].strip()
+            p = urlparse(first if "//" in first else "//" + first)
+            if p.hostname:
+                args.sequencerEtcd = f"{p.hostname}:{p.port or 2379}"
+    growth = {}
+    for copies, key in ((1, "copy_1"), (2, "copy_2"), (3, "copy_3"),
+                        ("other", "copy_other")):
+        val = config_get(cfg, f"master.volume_growth.{key}", None)
+        if val is not None:
+            growth[copies] = int(val)
+    # [storage.backend.<kind>.<id>] tier destinations (flattened keys
+    # back to the nested configure_backends shape; reference TOML
+    # credential names mapped to the client's)
+    nested = {}
+    for key, val in cfg.items():
+        parts = key.split(".")
+        if parts[:2] == ["storage", "backend"] and len(parts) == 5:
+            _, _, kind, bid, param = parts
+            nested.setdefault(kind, {}).setdefault(bid, {})[param] = val
+    backends = {}
+    rename = {"aws_access_key_id": "access_key",
+              "aws_secret_access_key": "secret_key"}
+    for kind, ids in nested.items():
+        for bid, params in ids.items():
+            enabled = params.pop("enabled", False)
+            if str(enabled).lower() not in ("true", "1"):
+                continue
+            backends.setdefault(kind, {})[bid] = {
+                rename.get(k, k): v for k, v in params.items()}
+    if backends:
+        from ..storage.backend import configure_backends
+        configure_backends(backends)
+    filer_url = str(config_get(cfg, "master.filer.default_filer_url",
+                               "") or "")
+    maintenance_filer = ""
+    if filer_url:
+        from urllib.parse import urlparse
+        p = urlparse(filer_url if "//" in filer_url
+                     else "//" + filer_url)
+        if p.hostname:
+            maintenance_filer = f"{p.hostname}:{p.port or 8888}"
+    return {"growth_counts": growth or None,
+            "maintenance_filer_url": maintenance_filer}
+
+
 def cmd_master(args):
     _apply_security_config(args)
+    master_cfg = _apply_master_config(args)
     from ..server.master import MasterServer
     sequencer = None
     if args.sequencer == "etcd":
@@ -91,7 +164,8 @@ def cmd_master(args):
                      whitelist=[w for w in args.whiteList.split(",")
                                 if w],
                      metrics_address=args.metricsAddress,
-                     metrics_interval=args.metricsInterval).start()
+                     metrics_interval=args.metricsInterval,
+                     **master_cfg).start()
     print(f"master listening on {m.url}")
     _wait(m)
 
@@ -150,12 +224,18 @@ def cmd_server(args):
     """Combined master + volume (+ filer) in one process
     (reference `weed server`)."""
     _apply_security_config(args)
+    master_cfg = _apply_master_config(args)
     from ..server.master import MasterServer
     from ..server.volume_server import VolumeServer
     _load_tier_config(getattr(args, "tierConfig", ""))
     m = MasterServer(port=args.masterPort, host=args.ip,
                      default_replication=args.defaultReplication,
-                     jwt_signing_key=args.jwtKey).start()
+                     jwt_signing_key=args.jwtKey,
+                     maintenance_scripts=getattr(
+                         args, "maintenanceScripts", ""),
+                     maintenance_interval=getattr(
+                         args, "maintenanceIntervalSeconds", 17 * 60),
+                     **master_cfg).start()
     dirs = args.dir.split(",")
     maxes = [int(args.max)] * len(dirs)
     vs = VolumeServer(port=args.port, host=args.ip, directories=dirs,
@@ -1125,7 +1205,7 @@ def build_parser() -> argparse.ArgumentParser:
     sc = sub.add_parser("scaffold", help="print example config files")
     sc.add_argument("-config", default="replication",
                     choices=["tier", "s3", "replication", "security",
-                             "notification", "filer"])
+                             "notification", "filer", "master"])
     sc.set_defaults(fn=cmd_scaffold)
 
     ver = sub.add_parser("version", help="print version")

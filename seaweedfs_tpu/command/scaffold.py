@@ -134,6 +134,48 @@ SCAFFOLDS = {
 //                                      ranges over <dir>\\0<name> keys)
 {}
 """,
+    "master": """\
+# master.toml — searched in ., ~/.seaweedfs_tpu, /etc/seaweedfs_tpu
+# (reference scaffold.go MASTER_TOML_EXAMPLE); keys also overridable
+# via WEED_MASTER_* env vars. Flags win over config when both are set.
+
+[master.maintenance]
+# shell command lines cron'd on the leader, one per line
+# (equivalent flag: -maintenanceScripts, ';'-separated)
+scripts = \"\"\"
+  ec.rebuild
+  volume.balance
+  volume.vacuum -garbageThreshold 0.3
+\"\"\"
+sleep_minutes = 17            # -maintenanceIntervalSeconds / 60
+
+[master.filer]
+# filer the maintenance shell's fs.* commands talk to
+default_filer_url = "http://localhost:8888/"
+
+[master.sequencer]
+type = "memory"               # memory | etcd  (-sequencer)
+# first URL is used; plain host:port works too  (-sequencerEtcd)
+sequencer_etcd_urls = "http://127.0.0.1:2379"
+
+# tier destinations for volume.tier.upload (same shape as the
+# reference master.toml [storage.backend]; also via -tierConfig JSON)
+[storage.backend.s3.default]
+enabled = false
+aws_access_key_id = ""
+aws_secret_access_key = ""
+region = "us-east-1"
+bucket = "volume-tier"
+endpoint = "http://s3.example.com:8333"
+
+# volumes grown per growth event, by replica copy count
+# (reference master.toml [master.volume_growth])
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_3 = 3
+copy_other = 1
+""",
 }
 
 

@@ -32,7 +32,9 @@ class MasterServer:
                  maintenance_interval: float = 17 * 60,
                  vacuum_interval: float = 15 * 60,
                  whitelist=(), metrics_address: str = "",
-                 metrics_interval: int = 15, sequencer=None):
+                 metrics_interval: int = 15, sequencer=None,
+                 growth_counts: dict = None,
+                 maintenance_filer_url: str = ""):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds, sequencer=sequencer)
@@ -98,6 +100,10 @@ class MasterServer:
         from ..shell.command_env import split_script
         self.maintenance_scripts = split_script(maintenance_scripts)
         self.maintenance_interval = float(maintenance_interval)
+        self.maintenance_filer_url = maintenance_filer_url
+        # volumes grown per growth event by replica copy count
+        # (reference master.toml [master.volume_growth])
+        self.growth_counts = dict(growth_counts or {})
         self._maintenance_runs = 0
         self._maintenance_thread = None
         if self.maintenance_scripts:
@@ -378,7 +384,8 @@ class MasterServer:
         while not self._stop.wait(self.maintenance_interval):
             if not self.is_leader():
                 continue
-            env = CommandEnv(self.url)
+            env = CommandEnv(self.url,
+                             filer_url=self.maintenance_filer_url)
             # unattended cron: one wedged volume server must not stall
             # the loop for the interactive shell's 3600s admin budget
             env.admin_timeout = 900.0
@@ -548,9 +555,15 @@ class MasterServer:
     def _grow_volumes(self, collection: str, replication: str, ttl: TTL,
                       preferred_dc: str = "", count: int = None):
         rp = ReplicaPlacement.parse(replication)
-        # reference growth counts by copy type (volume_growth.go:39-53)
+        # reference growth counts by copy type (volume_growth.go:39-53),
+        # overridable via master.toml [master.volume_growth]
         if count is None:
-            count = {1: 7, 2: 6, 3: 3}.get(rp.copy_count, 1)
+            defaults = {1: 7, 2: 6, 3: 3}
+            if rp.copy_count in defaults:
+                count = self.growth_counts.get(
+                    rp.copy_count, defaults[rp.copy_count])
+            else:
+                count = self.growth_counts.get("other", 1)
         grown = 0
         for _ in range(count):
             try:

@@ -131,6 +131,65 @@ def test_master_maintenance_scripts_run():
         master.stop()
 
 
+def test_master_toml_fills_flag_defaults(tmp_path, monkeypatch):
+    """master.toml (reference scaffold MASTER_TOML_EXAMPLE) provides
+    maintenance scripts / interval, sequencer choice, growth counts and
+    the maintenance shell's filer; explicit flags always win."""
+    import argparse
+
+    from seaweedfs_tpu.command.cli import _apply_master_config
+    from seaweedfs_tpu.command.scaffold import print_scaffold
+
+    # the scaffold's own output must parse through the loader
+    (tmp_path / "master.toml").write_text(print_scaffold("master"))
+    monkeypatch.chdir(tmp_path)
+    args = argparse.Namespace(maintenanceScripts="",
+                              maintenanceIntervalSeconds=17 * 60,
+                              sequencer="auto",
+                              sequencerEtcd="127.0.0.1:2379")
+    kw = _apply_master_config(args)
+    assert args.maintenanceScripts == \
+        "ec.rebuild;volume.balance;volume.vacuum -garbageThreshold 0.3"
+    assert args.maintenanceIntervalSeconds == 17 * 60
+    assert args.sequencer == "auto"  # scaffold says memory
+    assert kw["growth_counts"] == {1: 7, 2: 6, 3: 3, "other": 1}
+    assert kw["maintenance_filer_url"] == "localhost:8888"
+
+    # a config with explicit overrides + etcd sequencer urls
+    (tmp_path / "master.toml").write_text(
+        '[master.maintenance]\nscripts = "volume.vacuum"\n'
+        'sleep_minutes = 2\n'
+        '[master.sequencer]\ntype = "etcd"\n'
+        'sequencer_etcd_urls = "http://etcd-a:2390,http://etcd-b:2390"\n'
+        '[master.volume_growth]\ncopy_1 = 2\ncopy_other = 5\n')
+    args = argparse.Namespace(maintenanceScripts="",
+                              maintenanceIntervalSeconds=17 * 60,
+                              sequencer="auto",
+                              sequencerEtcd="127.0.0.1:2379")
+    kw = _apply_master_config(args)
+    assert args.maintenanceIntervalSeconds == 120
+    assert args.sequencer == "etcd"
+    assert args.sequencerEtcd == "etcd-a:2390"
+    assert kw["growth_counts"] == {1: 2, "other": 5}
+
+    # flags beat config
+    args = argparse.Namespace(maintenanceScripts="volume.list",
+                              maintenanceIntervalSeconds=60.0,
+                              sequencer="etcd",
+                              sequencerEtcd="me:2379")
+    _apply_master_config(args)
+    assert args.maintenanceScripts == "volume.list"
+    assert args.maintenanceIntervalSeconds == 60.0
+    assert args.sequencerEtcd == "me:2379"
+
+    # growth counts reach volume growth decisions
+    m = MasterServer(port=0, growth_counts={1: 2, "other": 5})
+    try:
+        assert m.growth_counts[1] == 2
+    finally:
+        m.stop()
+
+
 # -- status UIs --------------------------------------------------------------
 
 def test_filer_browser_page(tmp_path):
