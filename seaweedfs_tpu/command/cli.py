@@ -96,8 +96,13 @@ def _apply_master_config(args) -> dict:
     nested = {}
     for key, val in cfg.items():
         parts = key.split(".")
-        if parts[:2] == ["storage", "backend"] and len(parts) == 5:
-            _, _, kind, bid, param = parts
+        if parts[:2] == ["storage", "backend"] and len(parts) >= 5:
+            # >5 parts happen via WEED_* env overrides, whose underscores
+            # all became dots (aws_access_key_id -> aws.access.key.id):
+            # everything past the 4th segment is one underscore-joined
+            # param name
+            _, _, kind, bid = parts[:4]
+            param = "_".join(parts[4:])
             nested.setdefault(kind, {}).setdefault(bid, {})[param] = val
     backends = {}
     rename = {"aws_access_key_id": "access_key",
@@ -125,31 +130,48 @@ def _apply_master_config(args) -> dict:
             "maintenance_filer_url": maintenance_filer}
 
 
+def _build_sequencer(args):
+    """-sequencer etcd -> an EtcdSequencer, else None (in-memory/raft).
+    Shared by `weed master` and `weed server` so [master.sequencer]
+    config is honored in both modes."""
+    if getattr(args, "sequencer", "auto") != "etcd":
+        return None
+    # reference -master.sequencer etcd (weed/sequence/
+    # etcd_sequencer.go): file keys granted by CAS blocks on an
+    # external etcd shared by every master
+    from ..topology.topology import EtcdSequencer
+    meta_dir = getattr(args, "mdir", "")
+    if not meta_dir:
+        # sequencer.dat must never silently vanish (same hazard as
+        # raft persistence, master.py raft_dir fallback): without
+        # it a wiped etcd + restart re-mints live file ids. In
+        # `weed server` mode (no -mdir flag) anchor it to this
+        # cluster's own data dir — a fixed shared /tmp path would be
+        # overwritten by any other cluster on the host
+        data_dirs = getattr(args, "dir", "")
+        if data_dirs:
+            meta_dir = os.path.join(data_dirs.split(",")[0].strip(),
+                                    "master-meta")
+        else:
+            import tempfile
+            meta_dir = os.path.join(tempfile.gettempdir(),
+                                    "weed-tpu-raft")
+        os.makedirs(meta_dir, exist_ok=True)
+    endpoint = getattr(args, "sequencerEtcd", "") or "127.0.0.1:2379"
+    sequencer = EtcdSequencer(
+        endpoint,
+        user=getattr(args, "sequencerEtcdUser", ""),
+        password=getattr(args, "sequencerEtcdPassword", ""),
+        meta_dir=meta_dir)
+    print(f"sequencer: etcd at {endpoint} (ceiling file in {meta_dir})")
+    return sequencer
+
+
 def cmd_master(args):
     _apply_security_config(args)
     master_cfg = _apply_master_config(args)
     from ..server.master import MasterServer
-    sequencer = None
-    if args.sequencer == "etcd":
-        # reference -master.sequencer etcd (weed/sequence/
-        # etcd_sequencer.go): file keys granted by CAS blocks on an
-        # external etcd shared by every master
-        from ..topology.topology import EtcdSequencer
-        meta_dir = args.mdir
-        if not meta_dir:
-            # sequencer.dat must never silently vanish (same hazard as
-            # raft persistence, master.py raft_dir fallback): without
-            # it a wiped etcd + restart re-mints live file ids
-            import tempfile
-            meta_dir = os.path.join(tempfile.gettempdir(),
-                                    "weed-tpu-raft")
-            os.makedirs(meta_dir, exist_ok=True)
-        sequencer = EtcdSequencer(args.sequencerEtcd,
-                                  user=args.sequencerEtcdUser,
-                                  password=args.sequencerEtcdPassword,
-                                  meta_dir=meta_dir)
-        print(f"sequencer: etcd at {args.sequencerEtcd} "
-              f"(ceiling file in {meta_dir})")
+    sequencer = _build_sequencer(args)
     m = MasterServer(port=args.port, host=args.ip,
                      sequencer=sequencer,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
@@ -231,6 +253,7 @@ def cmd_server(args):
     m = MasterServer(port=args.masterPort, host=args.ip,
                      default_replication=args.defaultReplication,
                      jwt_signing_key=args.jwtKey,
+                     sequencer=_build_sequencer(args),
                      maintenance_scripts=getattr(
                          args, "maintenanceScripts", ""),
                      maintenance_interval=getattr(
@@ -519,7 +542,8 @@ def cmd_fix(args):
 def cmd_compact(args):
     from .volume_tools import compact_volume
     out = compact_volume(args.dir, args.volumeId,
-                         collection=args.collection)
+                         collection=args.collection,
+                         method=args.method)
     print(f"volume {out['volume']}: {out['before']} -> "
           f"{out['after']} bytes")
 
@@ -922,10 +946,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="throttle vacuum/compaction writes (MB/s, "
                         "0 = unthrottled; reference compactionMBps)")
     v.add_argument("-index", default="memory",
-                   choices=["memory", "compact", "sortedfile"],
+                   choices=["memory", "compact", "sortedfile", "disk"],
                    help="needle map variant (reference -index flag): "
-                        "memory dict, 16B/needle compact arrays, or "
-                        "mmap'd sorted file")
+                        "memory dict, 16B/needle compact arrays, "
+                        "mmap'd sorted file, or a disk-backed writable "
+                        "map for indexes larger than RAM (reference "
+                        "-index leveldb)")
     v.add_argument("-cpuprofile", default="",
                    help="write an all-thread collapsed-stack CPU "
                         "profile here on shutdown (flamegraph.pl/"
@@ -1181,6 +1207,9 @@ def build_parser() -> argparse.ArgumentParser:
     cp.add_argument("-dir", default=".")
     cp.add_argument("-volumeId", type=int, required=True)
     cp.add_argument("-collection", default="")
+    cp.add_argument("-method", type=int, default=1, choices=[0, 1],
+                    help="0 = scan the .dat (reference Compact), "
+                         "1 = copy by the index (reference Compact2)")
     cp.set_defaults(fn=cmd_compact)
 
     mt = sub.add_parser("mount", help="FUSE-mount the filer namespace")

@@ -120,14 +120,22 @@ def fix_volume(dirname: str, vid: int, collection: str = "") -> int:
     return volume_backup.rebuild_index(prefix + ".dat", prefix + ".idx")
 
 
-def compact_volume(dirname: str, vid: int, collection: str = "") -> dict:
-    """Force-vacuum a local volume in place."""
+def compact_volume(dirname: str, vid: int, collection: str = "",
+                   method: int = 1) -> dict:
+    """Force-vacuum a local volume in place. method 0 scans the .dat
+    (reference Compact / `weed compact -method 0`), method 1 copies by
+    the index (reference Compact2 / -method 1, the default the live
+    vacuum uses)."""
     v = Volume(dirname, collection, vid)
     try:
         before = v.size()
-        v.compact()
+        if method == 0:
+            v.compact_scan()
+        else:
+            v.compact()
         v.commit_compact()
-        return {"volume": vid, "before": before, "after": v.size()}
+        return {"volume": vid, "before": before, "after": v.size(),
+                "method": method}
     finally:
         v.close()
 

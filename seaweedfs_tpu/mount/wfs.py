@@ -50,6 +50,13 @@ class WeedFS:
         # filerMountRootPath) — every kernel path maps under it
         self.root_path = "/" + root_path.strip("/") \
             if root_path.strip("/") else "/"
+        # root stats are on every path resolution: briefly cache the
+        # subtree root's ABSENCE so a slow/down filer can't stall each
+        # one for a full HTTP timeout (present roots always re-stat —
+        # external attr changes stay immediately visible); any local op
+        # that could materialize the subtree clears the cache
+        self._root_absent_until = 0.0
+        self._root_cache_ttl = 1.0
         if not master_url:
             master_url = get_json(
                 f"http://{filer_url}/filer/status")["master"]
@@ -101,7 +108,8 @@ class WeedFS:
         elif entry.attr.symlink_target:
             # a symlink's size is its target length (reference
             # weed/filesys/dir_link.go:36 os.ModeSymlink)
-            s.st_mode = stat_mod.S_IFLNK | (mode or 0o777)
+            s.st_mode = stat_mod.S_IFLNK | \
+                (mode if explicit else (mode or 0o777))
             s.st_nlink = 1
             s.st_size = len(entry.attr.symlink_target.encode())
         else:
@@ -137,13 +145,17 @@ class WeedFS:
             # must still succeed before the first write creates the
             # subtree — hence the synthetic directory fallback
             entry = None
-            if self.root_path != "/":
+            if self.root_path != "/" and \
+                    time.monotonic() >= self._root_absent_until:
                 try:
                     entry = self._entry(self.root_path)
                 except OSError:
                     entry = None
                 if entry is not None and not entry.is_directory:
                     entry = None
+                if entry is None:
+                    self._root_absent_until = \
+                        time.monotonic() + self._root_cache_ttl
             self._fill_stat(st, entry)
             return 0
         self._fill_stat(st, self._entry(self._fpath(path)))
@@ -164,6 +176,7 @@ class WeedFS:
             start = batch[-1].name
 
     def mkdir(self, path, mode):
+        self._root_absent_until = 0.0  # may materialize the subtree
         p = self._fpath(path)
         now = time.time()
         entry = Entry(full_path=p,
@@ -208,10 +221,15 @@ class WeedFS:
 
     def chmod(self, path, mode):
         entry = self._entry(self._fpath(path))
-        # keep the file-type bits: they preserve is_directory AND mark
+        # keep the file-type bits: they preserve the entry kind AND mark
         # the permission bits as explicitly set, so a chmod 0000 reads
         # back as 0000 instead of _fill_stat's legacy-entry default
-        kind = 0o040000 if entry.is_directory else 0o100000
+        if entry.is_directory:
+            kind = 0o040000
+        elif getattr(entry.attr, "symlink_target", ""):
+            kind = 0o120000
+        else:
+            kind = 0o100000
         entry.attr.mode = (mode & 0o7777) | kind
         self.client.update_entry(entry)
         return 0
@@ -233,6 +251,7 @@ class WeedFS:
 
     # -- symlinks (reference weed/filesys/dir_link.go:15-45) ---------------
     def symlink(self, target, linkpath):
+        self._root_absent_until = 0.0  # may materialize the subtree
         p = self._fpath(linkpath)
         now = time.time()
         entry = Entry(full_path=p,
@@ -313,11 +332,15 @@ class WeedFS:
         return 0
 
     def create(self, path, mode, fi):
+        self._root_absent_until = 0.0  # may materialize the subtree
         p = self._fpath(path)
         now = time.time()
+        # stamp the type bits: FUSE-created files carry an explicitly
+        # chosen mode, so open(path, O_CREAT, 0000) must read back as
+        # 0000 (same semantics as mkdir/chmod), not the legacy default
         entry = Entry(full_path=p,
                       attr=Attr(mtime=now, crtime=now,
-                                mode=mode & 0o7777))
+                                mode=(mode & 0o7777) | 0o100000))
         try:
             self.client.create_entry(entry)
         except FilerError:

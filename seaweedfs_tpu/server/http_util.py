@@ -485,7 +485,10 @@ class HttpServer:
         return self
 
     def stop(self):
-        self.httpd.shutdown()
+        # shutdown() blocks on serve_forever()'s ack; if start() never ran
+        # there is no loop to ack and the call would deadlock.
+        if self._thread is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
         self.httpd.close_all_connections()
 

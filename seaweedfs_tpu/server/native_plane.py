@@ -157,13 +157,16 @@ class NativeReadPlane:
             return False
         import numpy as np
         with volume.lock:
-            entries = list(volume.nm.items())
-        keys, offsets, sizes = [], [], []
-        for key, nv in entries:
-            keys.append(key)
-            offsets.append(nv.offset)
-            sizes.append(nv.size)
-        if keys:
+            by_off = getattr(volume.nm, "items_by_offset", None)
+            if by_off is not None:
+                # -index disk: stream from a pinned snapshot connection
+                # instead of materializing a >RAM index into lists
+                volume.nm.flush()
+                entries = by_off()
+            else:
+                entries = list(volume.nm.items())
+
+        def put_chunk(keys, offsets, sizes):
             ka = np.asarray(keys, dtype=np.uint64)
             oa = np.asarray(offsets, dtype=np.uint64)
             sa = np.asarray(sizes, dtype=np.uint32)
@@ -172,6 +175,17 @@ class NativeReadPlane:
                 ka.ctypes.data_as(ctypes.c_void_p),
                 oa.ctypes.data_as(ctypes.c_void_p),
                 sa.ctypes.data_as(ctypes.c_void_p), len(keys))
+
+        keys, offsets, sizes = [], [], []
+        for key, nv in entries:
+            keys.append(key)
+            offsets.append(nv.offset)
+            sizes.append(nv.size)
+            if len(keys) >= (1 << 20):   # bound the staging lists
+                put_chunk(keys, offsets, sizes)
+                keys, offsets, sizes = [], [], []
+        if keys:
+            put_chunk(keys, offsets, sizes)
         return True
 
     def unregister_volume(self, vid: int):

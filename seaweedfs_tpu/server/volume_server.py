@@ -123,6 +123,12 @@ class VolumeServer:
         self._ec_loc_cache = EcShardLocationCache(
             self._fetch_ec_shard_locations)
         self._stop = threading.Event()
+        # immediate delta-push (reference store.go:40-64 change channels,
+        # consumed by volume_grpc_client_to_master.go:57-185): volume
+        # create/delete and EC shard mount/unmount wake the heartbeat
+        # loop so the master learns within milliseconds, not a pulse.
+        self._hb_wake = threading.Event()
+        self.store.on_change = self._hb_wake.set
         # native read plane (reference: the Go data plane itself; here
         # a C++ thread-per-connection server on a second advertised
         # port, serving plain needle GETs without the GIL — anything
@@ -170,6 +176,7 @@ class VolumeServer:
 
     def stop(self):
         self._stop.set()
+        self._hb_wake.set()
         try:
             # clean shutdown: tell the master now so watch subscribers
             # reroute immediately instead of after heartbeat expiry
@@ -271,7 +278,11 @@ class VolumeServer:
 
     def _heartbeat_loop(self):
         from ..util import glog
-        while not self._stop.wait(self.pulse_seconds):
+        while True:
+            self._hb_wake.wait(self.pulse_seconds)
+            self._hb_wake.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.heartbeat_once()
                 glog.V(4).infof("heartbeat to %s ok", self.master_url)
@@ -790,7 +801,14 @@ class VolumeServer:
         from ..storage.needle import CorruptNeedle
         checked = errors = 0
         with v.lock:
-            snapshot = list(v.nm.items())
+            by_off = getattr(v.nm, "items_by_offset", None)
+            if by_off is not None:
+                # -index disk: pinned streaming snapshot, no full-index
+                # materialization (the map exists for >RAM indexes)
+                v.nm.flush()
+                snapshot = by_off()
+            else:
+                snapshot = list(v.nm.items())
         for nid, nv in snapshot:
             checked += 1
             try:

@@ -329,19 +329,23 @@ class SortedFileNeedleMap(_SortedBase):
         super().close()
 
 
-NEEDLE_MAP_KINDS = {"memory", "compact", "sortedfile"}
+NEEDLE_MAP_KINDS = {"memory", "compact", "sortedfile", "disk"}
 
 
 def load_needle_map(idx_path: str, kind: str = "memory",
                     offset_width: int = 4):
     """Factory selecting the needle-map variant, like the reference's
-    volume -index flag (memory | compact | sortedfile).
+    volume -index flag (memory | compact | sortedfile | disk —
+    the last mirroring -index leveldb, needle_map_leveldb.go:15-120).
 
-    5-byte-offset volumes (17B .idx records) always use the dict map:
-    the numpy fast paths here are wired for the 16B layout, and >32GB
-    volumes are expected to be EC-bound (whose .ecx index is searched
-    on file, not held in RAM) rather than long-lived dict residents.
+    5-byte-offset volumes (17B .idx records) use the dict map unless
+    the disk map was asked for: the numpy fast paths here are wired for
+    the 16B layout, and the disk map is exactly the variant meant for
+    volumes too big to hold an in-RAM index.
     """
+    if kind == "disk":
+        from .needle_map_disk import DiskNeedleMap
+        return DiskNeedleMap.load(idx_path, offset_width)
     if offset_width != 4:
         from .needle_map import NeedleMap
         return NeedleMap.load(idx_path, offset_width)

@@ -38,6 +38,11 @@ class Store:
         self.data_center = data_center
         self.rack = rack
         self.codec = codec
+        # fired after any volume create/delete or EC shard mount/unmount
+        # (reference store.go:40-64 NewVolumesChan/DeletedVolumesChan/
+        # NewEcShardsChan/DeletedEcShardsChan): lets the volume server
+        # push a heartbeat delta immediately instead of waiting a pulse.
+        self.on_change = None
         self.lock = threading.RLock()
         for loc in self.locations:
             loc.load_existing_volumes()
@@ -77,16 +82,24 @@ class Store:
         loc = self.find_free_location()
         if loc is None:
             raise VolumeError("no free volume slots")
-        return loc.add_volume(
+        v = loc.add_volume(
             collection, vid,
             replica_placement=ReplicaPlacement.parse(replication),
             ttl=TTL.parse(ttl))
+        self._changed()
+        return v
 
     def delete_volume(self, vid: int) -> bool:
         for loc in self.locations:
             if loc.delete_volume(vid):
+                self._changed()
                 return True
         return False
+
+    def _changed(self):
+        cb = self.on_change
+        if cb is not None:
+            cb()
 
     def mark_volume_readonly(self, vid: int,
                              readonly: bool = True) -> Optional[bool]:
@@ -166,6 +179,8 @@ class Store:
                 else:
                     ev.close()
             break
+        if mounted:
+            self._changed()
         return mounted
 
     def unmount_ec_shards(self, vid: int, shard_ids: List[int]) -> List[int]:
@@ -183,6 +198,8 @@ class Store:
                 if loc.ec_volumes.get(vid) is ev:
                     loc.ec_volumes.pop(vid)
             ev.close()
+        if out:
+            self._changed()
         return out
 
     def rebuild_ec_shards(self, vid: int, collection: str = "") -> List[int]:

@@ -446,6 +446,29 @@ class Volume:
                 offset += actual
 
     # -- vacuum (reference volume_vacuum.go) -------------------------------
+    def _begin_compaction(self):
+        """Shared preamble of both vacuum algorithms (caller holds the
+        lock): claim the single-compaction guard, name the .cpd/.cpx
+        outputs, bump the superblock revision, and capture the makeup
+        watermark. Returns (new_sb, cpd, cpx, deleted_size)."""
+        # exactly one compaction at a time: two copiers would
+        # interleave writes into the same .cpd and commit garbage
+        if getattr(self, "_compacting", False):
+            raise VolumeError(
+                f"volume {self.id}: compaction already in progress")
+        self._compacting = True
+        prefix = self.file_name()
+        new_sb = SuperBlock(
+            version=self.version,
+            replica_placement=self.super_block.replica_placement,
+            ttl=self.super_block.ttl,
+            compaction_revision=(
+                self.super_block.compaction_revision + 1) & 0xFFFF,
+            flags=self.super_block.flags)
+        self._compact_idx_watermark = os.path.getsize(self.idx_path)
+        return (new_sb, prefix + ".cpd", prefix + ".cpx",
+                self.nm.deleted_size)
+
     def compact(self, bytes_per_second: int = 0) -> int:
         """Copy live needles to .cpd/.cpx. Returns reclaimed byte estimate.
 
@@ -467,25 +490,26 @@ class Volume:
         # exists (holding the lock throughout would make it dead code
         # and stall the volume for the copy's duration).
         with self.lock:
-            # exactly one compaction at a time: two copiers would
-            # interleave writes into the same .cpd and commit garbage
-            if getattr(self, "_compacting", False):
-                raise VolumeError(
-                    f"volume {self.id}: compaction already in progress")
-            self._compacting = True
-            prefix = self.file_name()
-            cpd, cpx = prefix + ".cpd", prefix + ".cpx"
-            new_sb = SuperBlock(
-                version=self.version,
-                replica_placement=self.super_block.replica_placement,
-                ttl=self.super_block.ttl,
-                compaction_revision=(
-                    self.super_block.compaction_revision + 1) & 0xFFFF,
-                flags=self.super_block.flags)
-            width = self.offset_width
-            live = sorted(self.nm.items(), key=lambda kv: kv[1].offset)
-            self._compact_idx_watermark = os.path.getsize(self.idx_path)
-            deleted_size = self.nm.deleted_size
+            new_sb, cpd, cpx, deleted_size = self._begin_compaction()
+            try:
+                width = self.offset_width
+                by_off = getattr(self.nm, "items_by_offset", None)
+                if by_off is not None:
+                    # disk map: commit pending state, then stream the
+                    # live set from a snapshot connection — no
+                    # full-index RAM spike on exactly the volumes
+                    # -index disk exists for
+                    self.nm.flush()
+                    live = by_off()
+                else:
+                    live = sorted(self.nm.items(),
+                                  key=lambda kv: kv[1].offset)
+            except BaseException:
+                # anything failing after the guard was claimed (e.g.
+                # sqlite disk-I/O error in flush) must release it, or
+                # every future vacuum on this volume is wedged
+                self._compacting = False
+                raise
         from .needle_map import entry_to_bytes
         try:
             with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
@@ -498,6 +522,80 @@ class Volume:
                         blob = self._read_blob(nv.offset, nv.size)
                     dat_out.write(blob)
                     idx_out.write(entry_to_bytes(nid, new_off, nv.size,
+                                                 width))
+                    throttler.maybe_slowdown(len(blob))
+        finally:
+            self._compacting = False
+        return deleted_size
+
+    def compact_scan(self, bytes_per_second: int = 0) -> int:
+        """Scan-based compaction — the reference's OTHER vacuum
+        algorithm (`Compact`, volume_vacuum.go:37 +
+        VolumeFileScanner4Vacuum, :310-352; `weed compact -method 0`,
+        command/compact.go:20-30): walk the .dat sequentially and keep
+        a record only when the needle map shows it live at exactly this
+        offset and its TTL (volume-level, against the needle's
+        last_modified) hasn't expired. compact() is the index-driven
+        Compact2/method 1. Same .cpd/.cpx outputs, same
+        commit_compact()."""
+        from ..util.throttler import WriteThrottler
+        throttler = WriteThrottler(bytes_per_second)
+        with self.lock:
+            new_sb, cpd, cpx, deleted_size = self._begin_compaction()
+            try:
+                width = self.offset_width
+                end = self.size()
+                # one offset-ordered live snapshot taken here, then
+                # merge-walked against the .dat scan — no per-record
+                # lock/map-lookup round trips (mutations after this
+                # point are covered by commit's makeup diff, exactly
+                # like compact())
+                by_off = getattr(self.nm, "items_by_offset", None)
+                if by_off is not None:
+                    self.nm.flush()
+                    live_iter = by_off()
+                else:
+                    live_iter = iter(sorted(
+                        self.nm.items(), key=lambda kv: kv[1].offset))
+            except BaseException:
+                self._compacting = False   # same guard as compact()
+                raise
+        from .needle_map import entry_to_bytes
+        from .volume_backup import walk_records
+        ttl_seconds = self.super_block.ttl.minutes * 60
+        now = time.time()
+        live_nid, live_nv = next(live_iter, (None, None))
+        try:
+            with open(self.dat_path, "rb") as src, \
+                    open(cpd, "wb") as dat_out, \
+                    open(cpx, "wb") as idx_out:
+
+                def pread(off, size):
+                    src.seek(off)
+                    return src.read(size)
+
+                dat_out.write(new_sb.to_bytes())
+                for n, offset, actual in walk_records(
+                        pread, self.version, SUPER_BLOCK_SIZE, end):
+                    if n.size == TOMBSTONE_FILE_SIZE or n.size <= 0:
+                        continue
+                    while live_nv is not None and \
+                            live_nv.offset < offset:
+                        live_nid, live_nv = next(live_iter,
+                                                 (None, None))
+                    if live_nv is None or live_nv.offset != offset or \
+                            live_nid != n.id or live_nv.size <= 0 or \
+                            live_nv.size == TOMBSTONE_FILE_SIZE:
+                        continue
+                    blob = pread(offset, actual)
+                    if ttl_seconds:
+                        full = Needle.from_bytes(blob, self.version)
+                        if full.last_modified and \
+                                now >= full.last_modified + ttl_seconds:
+                            continue
+                    new_off = dat_out.tell()
+                    dat_out.write(blob)
+                    idx_out.write(entry_to_bytes(n.id, new_off, n.size,
                                                  width))
                     throttler.maybe_slowdown(len(blob))
         finally:
@@ -618,7 +716,10 @@ class Volume:
 
     def destroy(self):
         self.close()
-        exts = [".dat", ".idx", ".cpd", ".cpx"]
+        # .ndb* are the -index disk sqlite checkpoint (+ WAL/shm); .sdx*
+        # the sortedfile sidecar — all derived from the .idx being removed
+        exts = [".dat", ".idx", ".cpd", ".cpx",
+                ".ndb", ".ndb-wal", ".ndb-shm", ".sdx", ".sdx.meta"]
         # the .vif sidecar is shared with the EC lifecycle: after
         # ec.encode deletes the original volume, parity-only holders
         # still need its offset_width — keep it while shard files exist

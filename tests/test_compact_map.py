@@ -16,7 +16,7 @@ from seaweedfs_tpu.storage.needle_map import NeedleMap
 from seaweedfs_tpu.storage.types import TOMBSTONE_FILE_SIZE
 from seaweedfs_tpu.storage.volume import Volume
 
-KINDS = ["compact", "sortedfile"]
+KINDS = ["compact", "sortedfile", "disk"]
 
 
 def random_workload(nm, rng, n_ops=3000, key_space=500):
@@ -182,3 +182,213 @@ def test_sorted_file_fast_reload_skips_replay(tmp_path, monkeypatch):
 def test_unknown_kind_rejected(tmp_path):
     with pytest.raises(ValueError, match="unknown needle map"):
         load_needle_map(str(tmp_path / "x.idx"), "leveldb")
+
+
+# -- disk map (-index disk; reference needle_map_leveldb.go:15-120) -------
+
+def test_disk_map_survives_restart_without_full_replay(tmp_path,
+                                                       monkeypatch):
+    """Clean close -> reopen must serve from the sqlite checkpoint (no
+    .idx replay); puts and deletes from the first session are all
+    there."""
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "d.idx")
+    nm = DiskNeedleMap.load(path)
+    random_workload(nm, random.Random(11), n_ops=4000)
+    counters = {f: getattr(nm, f) for f in
+                ("file_counter", "file_byte_counter", "deletion_counter",
+                 "deletion_byte_counter", "maximum_file_key")}
+    live = {k: (v.offset, v.size) for k, v in nm.items()}
+    nm.close()
+
+    def boom(self, start, end):
+        raise AssertionError("tail replay ran on a clean checkpoint")
+
+    monkeypatch.setattr(DiskNeedleMap, "_replay_range", boom)
+    again = DiskNeedleMap.load(path)
+    assert {k: (v.offset, v.size) for k, v in again.items()} == live
+    for f, want in counters.items():
+        assert getattr(again, f) == want, f
+    again.close()
+
+
+def test_disk_map_tail_catch_up_after_crash(tmp_path):
+    """Mutations past the last checkpoint (a 'crash' drops the final
+    commit) are recovered from the .idx tail — not lost, not a full
+    rebuild."""
+    from seaweedfs_tpu.storage import needle_map_disk
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "c.idx")
+    nm = DiskNeedleMap.load(path)
+    for i in range(1, 200):
+        nm.put(i, i * 8, 100)
+    nm.close()
+    # simulate a crash: append straight to the .idx behind the db's back
+    from seaweedfs_tpu.storage.needle_map import entry_to_bytes
+    from seaweedfs_tpu.storage.types import TOMBSTONE_FILE_SIZE as TOMB
+    with open(path, "ab") as f:
+        f.write(entry_to_bytes(500, 4000, 123))
+        f.write(entry_to_bytes(7, 0, TOMB))
+    again = DiskNeedleMap.load(path)
+    assert again.get(500).size == 123
+    assert again.get(7) is None
+    assert again.get(199).size == 100
+    # parity with a dict-map replay of the same .idx
+    ref = NeedleMap.load(path)
+    assert_maps_equal(ref, again)
+    again.close()
+
+
+def test_disk_map_rebuilds_after_idx_rewrite(tmp_path):
+    """A shrunken .idx (vacuum rewrote it) invalidates the checkpoint:
+    the map must rebuild, not trust a stale watermark."""
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "r.idx")
+    nm = DiskNeedleMap.load(path)
+    for i in range(1, 300):
+        nm.put(i, i * 8, 64)
+    nm.close()
+    # vacuum analog: rewrite the .idx keeping only every third needle
+    ref = NeedleMap.load(path)
+    survivors = [(k, v.offset, v.size) for k, v in ref.items()
+                 if k % 3 == 0]
+    ref.close()
+    fresh = NeedleMap(str(tmp_path / "tmp.idx"))
+    for k, off, size in survivors:
+        fresh.put(k, off, size)
+    fresh.close()
+    os.replace(str(tmp_path / "tmp.idx"), path)
+    again = DiskNeedleMap.load(path)
+    assert len(again) == len(survivors)
+    assert again.get(3).size == 64 and again.get(4) is None
+    again.close()
+
+
+def test_disk_map_five_byte_offsets(tmp_path):
+    """The disk map is exactly the variant meant for >32GB volumes, so
+    it must speak the 17B record layout (5-byte offsets) end to end."""
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "five.idx")
+    nm = DiskNeedleMap.load(path, offset_width=5)
+    big = (1 << 38) // 8          # an offset only 5 bytes can hold
+    nm.put(1, big, 4096)
+    nm.put(2, big + 512, 77)
+    nm.delete(2)
+    nm.close()
+    again = DiskNeedleMap.load(path, offset_width=5)
+    assert again.get(1).offset == big
+    assert again.get(2) is None
+    # the .idx bytes themselves are 17B records any walker can read
+    assert os.path.getsize(path) % 17 == 0
+    ref = NeedleMap.load(path, offset_width=5)
+    assert_maps_equal(ref, again)
+    again.close()
+
+
+def test_disk_map_detects_same_size_idx_rewrite(tmp_path):
+    """offline compact/fix replace the .idx wholesale; if the new file
+    is at least as long as the checkpoint's watermark, size alone can't
+    catch it — the content fingerprint must force a rebuild."""
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "w.idx")
+    nm = DiskNeedleMap.load(path)
+    for i in range(1, 101):
+        nm.put(i, i * 8, 50)
+    nm.close()
+    # rewrite: identical length (same record count), different offsets
+    fresh = NeedleMap(str(tmp_path / "tmp.idx"))
+    for i in range(1, 101):
+        fresh.put(i, i * 16, 50)
+    fresh.close()
+    assert os.path.getsize(str(tmp_path / "tmp.idx")) == \
+        os.path.getsize(path)
+    os.replace(str(tmp_path / "tmp.idx"), path)
+    again = DiskNeedleMap.load(path)
+    assert again.get(5).offset == 5 * 16   # rebuilt, not stale
+    ref = NeedleMap.load(path)
+    assert_maps_equal(ref, again)
+    again.close()
+
+
+def test_disk_map_vacuum_streams_without_full_materialize(tmp_path):
+    """Volume.compact on a disk-index volume walks items_by_offset (a
+    snapshot connection), and the full volume lifecycle stays correct."""
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    rng = np.random.default_rng(12)
+    v = Volume(str(tmp_path), "", 1, create=True, index_kind="disk")
+    assert isinstance(v.nm, DiskNeedleMap)
+    payloads = {}
+    for i in range(1, 50):
+        data = rng.integers(0, 256, 1500).astype(np.uint8).tobytes()
+        v.write_needle(Needle(id=i, cookie=9, data=data))
+        payloads[i] = data
+    for i in (3, 17, 40):
+        v.delete_needle(Needle(id=i, cookie=9))
+        del payloads[i]
+    before = v.size()
+    v.compact()
+    v.commit_compact()
+    assert v.size() < before
+    for i, data in payloads.items():
+        assert v.read_needle(Needle(id=i, cookie=9)).data == data
+    v.close()
+    # cold boot reuses the post-vacuum checkpoint-or-rebuild correctly
+    v2 = Volume(str(tmp_path), "", 1, index_kind="disk")
+    for i, data in payloads.items():
+        assert v2.read_needle(Needle(id=i, cookie=9)).data == data
+    v2.close()
+
+
+def test_disk_map_truncates_torn_idx_tail(tmp_path):
+    """A torn trailing .idx record must be truncated away, not merely
+    skipped — the append handle writes at the physical end, and a
+    half-record left in place would misframe every later record."""
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "t.idx")
+    nm = DiskNeedleMap.load(path)
+    for i in range(1, 20):
+        nm.put(i, i * 8, 30)
+    nm.close()
+    with open(path, "ab") as f:
+        f.write(b"\x00" * 7)               # torn half-record
+    again = DiskNeedleMap.load(path)
+    assert os.path.getsize(path) % 16 == 0  # truncated
+    again.put(100, 800, 44)                 # lands record-aligned
+    again.close()
+    ref = NeedleMap.load(path)              # any variant reframes cleanly
+    assert ref.get(100).offset == 800
+    assert ref.get(19).size == 30
+    assert_maps_equal(ref, DiskNeedleMap.load(path))
+
+
+def test_disk_map_checkpoint_excludes_foreign_tail(tmp_path):
+    """.idx records appended behind the map's back (exactly what the
+    native write lease does) must stay PAST the checkpoint watermark so
+    the next boot's tail replay ingests them — close() stamping
+    getsize() would silently lose every lease-written needle."""
+    from seaweedfs_tpu.storage.needle_map import entry_to_bytes
+    from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
+    path = str(tmp_path / "lease.idx")
+    nm = DiskNeedleMap.load(path)
+    for i in range(1, 11):
+        nm.put(i, i * 8, 50)
+    # foreign append while the map is open (lease analog)
+    with open(path, "ab") as f:
+        f.write(entry_to_bytes(99, 8000, 55))
+    nm.close()     # checkpoint must NOT cover the foreign record
+    again = DiskNeedleMap.load(path)
+    assert again.get(99) is not None and again.get(99).size == 55
+    ref = NeedleMap.load(path)
+    assert_maps_equal(ref, again)
+
+    # a live put AFTER another foreign append ingests both, in order
+    with open(path, "ab") as f:
+        f.write(entry_to_bytes(100, 8800, 66))
+    again.put(101, 9600, 77)
+    assert again.get(100).size == 66
+    assert again.get(101).size == 77
+    again.close()
+    third = DiskNeedleMap.load(path)
+    ref2 = NeedleMap.load(path)
+    assert_maps_equal(ref2, third)
+    third.close()

@@ -94,3 +94,48 @@ def test_master_amnesia_forces_resync(cluster):
     vs.heartbeat_once()                     # delta -> resync -> full
     assert master.topology.find_node(vs.url) is not None
     assert vid in master.topology.find_node(vs.url).volumes
+
+
+def test_immediate_push_beats_the_pulse(tmp_path):
+    """Volume create and EC shard mount must reach the master within
+    milliseconds via the store change hook (reference store.go:40-64
+    change channels + volume_grpc_client_to_master.go:57-185), NOT a
+    pulse later — pulse here is 30s, so only the immediate push can
+    explain propagation."""
+    from seaweedfs_tpu.server.http_util import HttpError
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=30).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=30,
+                      max_volume_counts=[20], ec_backend="numpy").start()
+    try:
+        t0 = time.monotonic()
+        a = op.assign(master.url)
+        vid = int(a["fid"].split(",")[0])
+        op.upload(a["url"], a["fid"], b"x" * 200_000, filename="f.bin")
+        post_json(f"http://{vs.url}/admin/volume/readonly?volume={vid}")
+        post_json(f"http://{vs.url}/admin/ec/generate?volume={vid}")
+        post_json(f"http://{vs.url}/admin/ec/mount?volume={vid}"
+                  f"&shards={','.join(str(s) for s in range(14))}")
+
+        def ec_known():
+            try:
+                out = get_json(f"http://{master.url}/cluster/ec_lookup"
+                               f"?volumeId={vid}")
+            except HttpError:
+                return False
+            return bool(out.get("shards"))
+
+        assert wait_until(ec_known, timeout=5.0), \
+            "ec shards did not reach the master without a pulse"
+        # the whole flow must finish far below the 30s pulse period
+        assert time.monotonic() - t0 < 20
+
+        # deletion propagates immediately too
+        post_json(f"http://{vs.url}/admin/ec/unmount?volume={vid}"
+                  f"&shards={','.join(str(s) for s in range(14))}")
+        assert wait_until(lambda: not ec_known(), timeout=5.0), \
+            "ec unmount did not reach the master without a pulse"
+    finally:
+        vs.stop()
+        master.stop()
