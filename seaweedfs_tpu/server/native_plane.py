@@ -156,15 +156,9 @@ class NativeReadPlane:
         if rc != 0:
             return False
         import numpy as np
+        from ..storage.compact_map import snapshot_live_items
         with volume.lock:
-            by_off = getattr(volume.nm, "items_by_offset", None)
-            if by_off is not None:
-                # -index disk: stream from a pinned snapshot connection
-                # instead of materializing a >RAM index into lists
-                volume.nm.flush()
-                entries = by_off()
-            else:
-                entries = list(volume.nm.items())
+            entries = snapshot_live_items(volume.nm)
 
         def put_chunk(keys, offsets, sizes):
             ka = np.asarray(keys, dtype=np.uint64)

@@ -800,15 +800,9 @@ class VolumeServer:
             raise HttpError(404, f"volume {vid} not found")
         from ..storage.needle import CorruptNeedle
         checked = errors = 0
+        from ..storage.compact_map import snapshot_live_items
         with v.lock:
-            by_off = getattr(v.nm, "items_by_offset", None)
-            if by_off is not None:
-                # -index disk: pinned streaming snapshot, no full-index
-                # materialization (the map exists for >RAM indexes)
-                v.nm.flush()
-                snapshot = by_off()
-            else:
-                snapshot = list(v.nm.items())
+            snapshot = snapshot_live_items(v.nm)
         for nid, nv in snapshot:
             checked += 1
             try:

@@ -332,6 +332,25 @@ class SortedFileNeedleMap(_SortedBase):
 NEEDLE_MAP_KINDS = {"memory", "compact", "sortedfile", "disk"}
 
 
+def snapshot_live_items(nm, by_offset: bool = False):
+    """Consistent live-set snapshot of ANY needle-map variant; the
+    caller must hold the volume lock across this call. Disk maps
+    flush pending state then stream from a pinned private-connection
+    snapshot (RAM-bounded — flush-before-read is mandatory and lives
+    HERE so no caller can forget it); in-memory maps list-copy.
+    by_offset orders by .dat offset (the vacuum merge-walk's need);
+    leave it False where order doesn't matter — for the disk map that
+    skips a whole-table sort."""
+    snap = getattr(nm, "items_snapshot", None)
+    if snap is not None:
+        nm.flush()
+        return snap(by_offset=by_offset)
+    items = list(nm.items())
+    if by_offset:
+        items.sort(key=lambda kv: kv[1].offset)
+    return items
+
+
 def load_needle_map(idx_path: str, kind: str = "memory",
                     offset_width: int = 4):
     """Factory selecting the needle-map variant, like the reference's

@@ -297,12 +297,19 @@ class DiskNeedleMap:
         (vacuum's snapshot) or to snapshot the .idx watermark."""
         self._checkpoint()
 
-    def items_by_offset(self) -> Iterator[Tuple[int, NeedleValue]]:
-        """Stream the live set ordered by .dat offset from a PRIVATE
-        connection (WAL snapshot isolation): vacuum walks millions of
-        needles without materializing the index in RAM — the reason
-        this map variant exists. Call flush() first so the snapshot
-        includes every acknowledged mutation.
+    def items_snapshot(self,
+                       by_offset: bool = False
+                       ) -> Iterator[Tuple[int, NeedleValue]]:
+        """Stream the live set from a PRIVATE connection (WAL snapshot
+        isolation): callers walk millions of needles without
+        materializing the index in RAM — the reason this map variant
+        exists. Call flush() first so the snapshot includes every
+        acknowledged mutation (snapshot_live_items does both).
+
+        by_offset=True adds the ORDER BY the vacuum merge-walk needs
+        (a whole-table sort — `off` has no index); order-insensitive
+        callers (native-plane bulk load, fsck) stream in PK order
+        free of that cost.
 
         The snapshot is pinned EAGERLY (first row fetched before this
         returns), so a caller holding the volume lock gets a view of
@@ -310,8 +317,8 @@ class DiskNeedleMap:
         out of the snapshot and is replayed by the vacuum makeup diff
         instead of being copied twice."""
         db = sqlite3.connect(self.db_path, check_same_thread=False)
-        cur = db.execute("SELECT nid, off, size FROM needles "
-                         "ORDER BY off")
+        cur = db.execute("SELECT nid, off, size FROM needles"
+                         + (" ORDER BY off" if by_offset else ""))
         first = cur.fetchone()            # pins the WAL read snapshot
 
         def walk():

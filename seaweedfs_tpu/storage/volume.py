@@ -489,21 +489,12 @@ class Volume:
         # makeup_diff — that replay is the whole reason the watermark
         # exists (holding the lock throughout would make it dead code
         # and stall the volume for the copy's duration).
+        from .compact_map import snapshot_live_items
         with self.lock:
             new_sb, cpd, cpx, deleted_size = self._begin_compaction()
             try:
                 width = self.offset_width
-                by_off = getattr(self.nm, "items_by_offset", None)
-                if by_off is not None:
-                    # disk map: commit pending state, then stream the
-                    # live set from a snapshot connection — no
-                    # full-index RAM spike on exactly the volumes
-                    # -index disk exists for
-                    self.nm.flush()
-                    live = by_off()
-                else:
-                    live = sorted(self.nm.items(),
-                                  key=lambda kv: kv[1].offset)
+                live = snapshot_live_items(self.nm, by_offset=True)
             except BaseException:
                 # anything failing after the guard was claimed (e.g.
                 # sqlite disk-I/O error in flush) must release it, or
@@ -540,6 +531,7 @@ class Volume:
         commit_compact()."""
         from ..util.throttler import WriteThrottler
         throttler = WriteThrottler(bytes_per_second)
+        from .compact_map import snapshot_live_items
         with self.lock:
             new_sb, cpd, cpx, deleted_size = self._begin_compaction()
             try:
@@ -550,13 +542,8 @@ class Volume:
                 # lock/map-lookup round trips (mutations after this
                 # point are covered by commit's makeup diff, exactly
                 # like compact())
-                by_off = getattr(self.nm, "items_by_offset", None)
-                if by_off is not None:
-                    self.nm.flush()
-                    live_iter = by_off()
-                else:
-                    live_iter = iter(sorted(
-                        self.nm.items(), key=lambda kv: kv[1].offset))
+                live_iter = iter(snapshot_live_items(self.nm,
+                                                     by_offset=True))
             except BaseException:
                 self._compacting = False   # same guard as compact()
                 raise
@@ -666,6 +653,13 @@ class Volume:
                 self.super_block = SuperBlock.from_bytes(
                     f.read(SUPER_BLOCK_SIZE))
             self.dat = open(self.dat_path, "r+b")
+            # for -index disk this reload detects the rewritten .idx
+            # (watermark/CRC mismatch) and rebuilds the sqlite map from
+            # the post-vacuum index, under the lock. The index is at its
+            # smallest right now (live needles only), and the .ndb being
+            # self-validating derived data keeps every crash window safe;
+            # building it alongside .cpx would shave the stall but add a
+            # third commit artifact to the crash protocol.
             self.nm = load_needle_map(self.idx_path, self.index_kind,
                                   self.offset_width)
 
