@@ -91,7 +91,8 @@ def export_volume(dirname: str, vid: int, collection: str = "",
     listed = []
     tar = tarfile.open(tar_path, "w") if tar_path else None
     try:
-        for nid, nv in sorted(v.nm.items(), key=lambda kv: kv[1].offset):
+        from ..storage.compact_map import snapshot_live_items
+        for nid, nv in snapshot_live_items(v.nm, by_offset=True):
             if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
                 continue
             from ..storage.needle import Needle

@@ -802,7 +802,9 @@ class VolumeServer:
         checked = errors = 0
         from ..storage.compact_map import snapshot_live_items
         with v.lock:
-            snapshot = snapshot_live_items(v.nm)
+            # offset order: the per-needle reads below then stream the
+            # .dat sequentially instead of random-seeking a large volume
+            snapshot = snapshot_live_items(v.nm, by_offset=True)
         for nid, nv in snapshot:
             checked += 1
             try:

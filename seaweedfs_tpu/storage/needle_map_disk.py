@@ -335,7 +335,8 @@ class DiskNeedleMap:
         # NOT snapshot-consistent: this cursor shares the mutating
         # connection, and sqlite may skip/repeat rows if the table
         # changes mid-iteration — callers needing a stable view under
-        # concurrent writes must use items_by_offset() (own connection)
+        # concurrent writes must use items_snapshot() (own connection)
+        # via compact_map.snapshot_live_items
         cur = self._db.cursor()
         for nid_s, off, size in cur.execute(
                 "SELECT nid, off, size FROM needles ORDER BY nid"):

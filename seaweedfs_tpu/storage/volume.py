@@ -446,6 +446,28 @@ class Volume:
                 offset += actual
 
     # -- vacuum (reference volume_vacuum.go) -------------------------------
+    def _blob_expired(self, blob: bytes, ttl_seconds: int,
+                      now: float) -> bool:
+        """Volume-TTL expiry of one raw needle record (both vacuum
+        algorithms; reference volume_vacuum.go:333-335 and :426-428).
+        Parses only the body fields — the payload CRC is irrelevant to
+        the timestamp and would double vacuum CPU. Unparseable records
+        report not-expired: vacuum keeps the bytes verbatim instead of
+        aborting (reclamation would starve forever) or dropping them."""
+        if not ttl_seconds:
+            return False
+        from .needle import NEEDLE_HEADER_SIZE
+        try:
+            n = Needle.parse_header(blob)
+            if self.version == 1:
+                return False          # v1 records carry no timestamp
+            n._parse_body_v2(
+                blob[NEEDLE_HEADER_SIZE:NEEDLE_HEADER_SIZE + n.size])
+        except Exception:  # noqa: BLE001 - corrupt record: keep it
+            return False
+        return bool(n.last_modified) and \
+            now >= n.last_modified + ttl_seconds
+
     def _begin_compaction(self):
         """Shared preamble of both vacuum algorithms (caller holds the
         lock): claim the single-compaction guard, name the .cpd/.cpx
@@ -502,15 +524,22 @@ class Volume:
                 self._compacting = False
                 raise
         from .needle_map import entry_to_bytes
+        # volume-TTL'd needles past last_modified+ttl are reclaimed here
+        # too (reference Compact2 does the same check as the scan path,
+        # volume_vacuum.go:426-428)
+        ttl_seconds = self.super_block.ttl.minutes * 60
+        now = time.time()
         try:
             with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
                 dat_out.write(new_sb.to_bytes())
                 for nid, nv in live:
                     if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
                         continue
-                    new_off = dat_out.tell()
                     with self.lock:
                         blob = self._read_blob(nv.offset, nv.size)
+                    if self._blob_expired(blob, ttl_seconds, now):
+                        continue
+                    new_off = dat_out.tell()
                     dat_out.write(blob)
                     idx_out.write(entry_to_bytes(nid, new_off, nv.size,
                                                  width))
@@ -575,11 +604,8 @@ class Volume:
                             live_nv.size == TOMBSTONE_FILE_SIZE:
                         continue
                     blob = pread(offset, actual)
-                    if ttl_seconds:
-                        full = Needle.from_bytes(blob, self.version)
-                        if full.last_modified and \
-                                now >= full.last_modified + ttl_seconds:
-                            continue
+                    if self._blob_expired(blob, ttl_seconds, now):
+                        continue
                     new_off = dat_out.tell()
                     dat_out.write(blob)
                     idx_out.write(entry_to_bytes(n.id, new_off, n.size,

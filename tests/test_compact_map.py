@@ -311,8 +311,9 @@ def test_disk_map_detects_same_size_idx_rewrite(tmp_path):
 
 
 def test_disk_map_vacuum_streams_without_full_materialize(tmp_path):
-    """Volume.compact on a disk-index volume walks items_by_offset (a
-    snapshot connection), and the full volume lifecycle stays correct."""
+    """Volume.compact on a disk-index volume streams from a pinned
+    snapshot connection (snapshot_live_items -> items_snapshot), and
+    the full volume lifecycle stays correct."""
     from seaweedfs_tpu.storage.needle_map_disk import DiskNeedleMap
     rng = np.random.default_rng(12)
     v = Volume(str(tmp_path), "", 1, create=True, index_kind="disk")

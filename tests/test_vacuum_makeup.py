@@ -224,10 +224,12 @@ def test_compact_scan_matches_index_compact(tmp_path):
     vb.close()
 
 
-def test_compact_scan_drops_ttl_expired_needles(tmp_path, monkeypatch):
-    """Reference VisitNeedle TTL check (volume_vacuum.go:333-335): the
-    scan-based vacuum reclaims needles whose volume TTL has lapsed even
-    though they were never explicitly deleted."""
+@pytest.mark.parametrize("method", ["scan", "index"])
+def test_vacuum_drops_ttl_expired_needles(tmp_path, monkeypatch, method):
+    """BOTH vacuum algorithms reclaim needles whose volume TTL has
+    lapsed even though they were never explicitly deleted (reference
+    VisitNeedle volume_vacuum.go:333-335 and Compact2's identical
+    check at :426-428)."""
     v = Volume(str(tmp_path), "", 1, create=True, ttl=TTL.parse("1m"))
     v.write_needle(Needle(id=1, cookie=5, data=b"fresh"))
     v.write_needle(Needle(id=2, cookie=5, data=b"stale"))
@@ -239,10 +241,47 @@ def test_compact_scan_drops_ttl_expired_needles(tmp_path, monkeypatch):
     # guarantees restoration of the (process-global) clock.
     monkeypatch.setattr(volmod.time, "time",
                         lambda: real_time() + 120)
-    v.compact_scan()
+    if method == "scan":
+        v.compact_scan()
+    else:
+        v.compact()
     monkeypatch.undo()
     v.commit_compact()
     for i in (1, 2):
         with pytest.raises(NotFound):
             v.read_needle(Needle(id=i, cookie=5))
+    v.close()
+
+
+@pytest.mark.parametrize("method", ["scan", "index"])
+def test_vacuum_keeps_unparseable_records_on_ttl_volume(tmp_path,
+                                                        monkeypatch,
+                                                        method):
+    """A bit-rotted record on a TTL volume must neither abort the
+    vacuum (reclamation would starve forever) nor be dropped — the
+    bytes ride through verbatim and reads surface the corruption."""
+    import os
+    v = Volume(str(tmp_path), "", 1, create=True, ttl=TTL.parse("1h"))
+    v.write_needle(Needle(id=1, cookie=5, data=b"keepme" * 100))
+    v.write_needle(Needle(id=2, cookie=5, data=b"fresh" * 100))
+    nv = v.nm.get(1)
+    # flip a payload byte of needle 1 behind the volume's back
+    with open(v.dat_path, "r+b") as f:
+        f.seek(nv.offset + 40)
+        b = f.read(1)
+        f.seek(nv.offset + 40)
+        f.write(bytes([b[0] ^ 0xFF]))
+    before = v.size()
+    if method == "scan":
+        v.compact_scan()
+    else:
+        v.compact()
+    v.commit_compact()
+    # both records (incl. the corrupt one) survived; nothing reclaimed
+    assert v.nm.get(1) is not None and v.nm.get(2) is not None
+    assert v.size() == before
+    from seaweedfs_tpu.storage.needle import CorruptNeedle
+    with pytest.raises(CorruptNeedle):
+        v.read_needle(Needle(id=1, cookie=5))
+    assert v.read_needle(Needle(id=2, cookie=5)).data == b"fresh" * 100
     v.close()

@@ -149,7 +149,7 @@ def test_mark_volume_readonly_returns_prior_state(tmp_path):
     store.close()
 
 
-@pytest.mark.parametrize("kind", ["compact", "sortedfile"])
+@pytest.mark.parametrize("kind", ["compact", "sortedfile", "disk"])
 @pytest.mark.parametrize("seed", [51, 52])
 def test_volume_fuzz_index_variants_equivalent(tmp_path, kind, seed):
     """The same random op sequence through a RAM-bounded index variant
