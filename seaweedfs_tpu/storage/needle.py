@@ -181,8 +181,13 @@ class Needle:
 
     @classmethod
     def from_bytes(cls, blob: bytes, version: int = CURRENT_VERSION,
-                   expected_size: int = None) -> "Needle":
-        """Hydrate from a full needle blob (header..padding)."""
+                   expected_size: int = None,
+                   verify_crc: bool = True) -> "Needle":
+        """Hydrate from a full needle blob (header..padding).
+
+        verify_crc=False skips the whole-payload checksum — for callers
+        that only need metadata fields (e.g. vacuum's TTL check reads
+        last_modified and must not pay a full CRC per live needle)."""
         n = cls.parse_header(blob)
         if expected_size is not None and n.size != expected_size:
             raise CorruptNeedle(
@@ -198,10 +203,11 @@ class Needle:
             stored = struct.unpack(
                 ">I", blob[NEEDLE_HEADER_SIZE + size:
                            NEEDLE_HEADER_SIZE + size + NEEDLE_CHECKSUM_SIZE])[0]
-            actual = crc_mod.needle_checksum(n.data)
-            if stored != actual:
-                raise CorruptNeedle(f"needle {n.id}: CRC mismatch")
-            n.checksum = actual
+            if verify_crc:
+                actual = crc_mod.needle_checksum(n.data)
+                if stored != actual:
+                    raise CorruptNeedle(f"needle {n.id}: CRC mismatch")
+            n.checksum = stored
         if version == VERSION3:
             ts_off = NEEDLE_HEADER_SIZE + size + NEEDLE_CHECKSUM_SIZE
             n.append_at_ns = struct.unpack(

@@ -446,23 +446,23 @@ class Volume:
                 offset += actual
 
     # -- vacuum (reference volume_vacuum.go) -------------------------------
+    def _ttl_clock(self):
+        """(ttl_seconds, now) for one vacuum pass — captured once so
+        both algorithms expire against the same instant."""
+        return self.super_block.ttl.minutes * 60, time.time()
+
     def _blob_expired(self, blob: bytes, ttl_seconds: int,
                       now: float) -> bool:
         """Volume-TTL expiry of one raw needle record (both vacuum
         algorithms; reference volume_vacuum.go:333-335 and :426-428).
-        Parses only the body fields — the payload CRC is irrelevant to
-        the timestamp and would double vacuum CPU. Unparseable records
-        report not-expired: vacuum keeps the bytes verbatim instead of
+        Skips the payload CRC — it is irrelevant to the timestamp and
+        would double vacuum CPU. Unparseable records report
+        not-expired: vacuum keeps the bytes verbatim instead of
         aborting (reclamation would starve forever) or dropping them."""
-        if not ttl_seconds:
-            return False
-        from .needle import NEEDLE_HEADER_SIZE
+        if not ttl_seconds or self.version == 1:
+            return False              # v1 records carry no timestamp
         try:
-            n = Needle.parse_header(blob)
-            if self.version == 1:
-                return False          # v1 records carry no timestamp
-            n._parse_body_v2(
-                blob[NEEDLE_HEADER_SIZE:NEEDLE_HEADER_SIZE + n.size])
+            n = Needle.from_bytes(blob, self.version, verify_crc=False)
         except Exception:  # noqa: BLE001 - corrupt record: keep it
             return False
         return bool(n.last_modified) and \
@@ -527,8 +527,7 @@ class Volume:
         # volume-TTL'd needles past last_modified+ttl are reclaimed here
         # too (reference Compact2 does the same check as the scan path,
         # volume_vacuum.go:426-428)
-        ttl_seconds = self.super_block.ttl.minutes * 60
-        now = time.time()
+        ttl_seconds, now = self._ttl_clock()
         try:
             with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
                 dat_out.write(new_sb.to_bytes())
@@ -578,8 +577,7 @@ class Volume:
                 raise
         from .needle_map import entry_to_bytes
         from .volume_backup import walk_records
-        ttl_seconds = self.super_block.ttl.minutes * 60
-        now = time.time()
+        ttl_seconds, now = self._ttl_clock()
         live_nid, live_nv = next(live_iter, (None, None))
         try:
             with open(self.dat_path, "rb") as src, \

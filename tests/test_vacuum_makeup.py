@@ -253,23 +253,30 @@ def test_vacuum_drops_ttl_expired_needles(tmp_path, monkeypatch, method):
     v.close()
 
 
+@pytest.mark.parametrize("corruption", ["crc", "structure"])
 @pytest.mark.parametrize("method", ["scan", "index"])
 def test_vacuum_keeps_unparseable_records_on_ttl_volume(tmp_path,
                                                         monkeypatch,
-                                                        method):
+                                                        method,
+                                                        corruption):
     """A bit-rotted record on a TTL volume must neither abort the
     vacuum (reclamation would starve forever) nor be dropped — the
-    bytes ride through verbatim and reads surface the corruption."""
-    import os
+    bytes ride through verbatim and reads surface the corruption.
+    Both rot shapes: payload-only (CRC mismatch) and structural (the
+    body's data_size field trashed, so even the no-CRC metadata parse
+    raises — _blob_expired's except branch)."""
+    from seaweedfs_tpu.storage.needle import NEEDLE_HEADER_SIZE
     v = Volume(str(tmp_path), "", 1, create=True, ttl=TTL.parse("1h"))
     v.write_needle(Needle(id=1, cookie=5, data=b"keepme" * 100))
     v.write_needle(Needle(id=2, cookie=5, data=b"fresh" * 100))
     nv = v.nm.get(1)
-    # flip a payload byte of needle 1 behind the volume's back
+    # corrupt needle 1 behind the volume's back
+    off = nv.offset + (40 if corruption == "crc"
+                       else NEEDLE_HEADER_SIZE)  # body data_size field
     with open(v.dat_path, "r+b") as f:
-        f.seek(nv.offset + 40)
+        f.seek(off)
         b = f.read(1)
-        f.seek(nv.offset + 40)
+        f.seek(off)
         f.write(bytes([b[0] ^ 0xFF]))
     before = v.size()
     if method == "scan":

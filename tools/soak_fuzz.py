@@ -8,9 +8,9 @@ import tempfile
 import pathlib
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_here = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, _here)
-sys.path.insert(0, os.path.join(_here, "tests"))  # intra-test imports
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _root)
+sys.path.insert(0, os.path.join(_root, "tests"))  # intra-test imports
 
 from tests.test_volume_fuzz import (  # noqa: E402
     test_volume_random_ops_match_model)
