@@ -250,26 +250,48 @@ def measure_tpu_e2e(base: str, dat_size: int, slab_mb: int):
     return best, best_stages
 
 
-def measure_tpu_rebuild(base: str, dat_size: int, slab_mb: int):
-    """Drop 4 random shards, rebuild through the device, verify digests."""
+def _measure_rebuild(base: str, dat_size: int, codec, label: str,
+                     seed: int, slab: int, pipelined: bool) -> float:
+    """Shared BASELINE-config-2 harness: drop M seeded-random shards,
+    rebuild with the given codec, digest-verify, report MB/s of volume
+    bytes."""
     import random
     from seaweedfs_tpu.ec import rebuild_ec_files
-    from seaweedfs_tpu.ops.rs_tpu import TpuCodec
     before = shard_digests(base)
-    dropped = sorted(random.Random(42).sample(range(TOTAL), M))
+    dropped = sorted(random.Random(seed).sample(range(TOTAL), M))
     remove_shards(base, dropped)
-    codec = TpuCodec(K, M)
     t = time.perf_counter()
-    rebuilt = rebuild_ec_files(base, codec=codec, slab=slab_mb << 20,
-                               pipelined=True)
+    rebuilt = rebuild_ec_files(base, codec=codec, slab=slab,
+                               pipelined=pipelined)
     dt = time.perf_counter() - t
     assert sorted(rebuilt) == dropped, (rebuilt, dropped)
-    after = shard_digests(base)
-    if after != before:
-        raise AssertionError(f"rebuild of shards {dropped} not byte-identical")
+    if shard_digests(base) != before:
+        raise AssertionError(
+            f"{label} rebuild of shards {dropped} not byte-identical")
     mbps = dat_size / dt / 1e6
-    log(f"tpu e2e rebuild of {M} shards: {mbps:.0f} MB/s of volume bytes "
-        f"({dt:.1f}s, dropped {dropped}, digests verified)")
+    log(f"{label} e2e rebuild of {M} shards: {mbps:.0f} MB/s of volume "
+        f"bytes ({dt:.1f}s, dropped {dropped}, digests verified)")
+    return mbps
+
+
+def measure_tpu_rebuild(base: str, dat_size: int, slab_mb: int):
+    """Drop 4 random shards, rebuild through the device, verify digests."""
+    from seaweedfs_tpu.ops.rs_tpu import TpuCodec
+    return _measure_rebuild(base, dat_size, TpuCodec(K, M), "tpu",
+                            seed=42, slab=slab_mb << 20, pipelined=True)
+
+
+def measure_cpu_rebuild(base: str, dat_size: int) -> float:
+    """BASELINE config 2 on the CPU path: drop M random shards of the
+    just-encoded volume, rebuild with the native codec, verify digests.
+    Runs in every mode so the fallback artifact still carries a
+    rebuild number (device runs add the TPU variant on top)."""
+    from seaweedfs_tpu.ops.codec import get_codec
+    backend = "native" if ensure_native() else "numpy"
+    return _measure_rebuild(base, dat_size,
+                            get_codec(K, M, backend=backend),
+                            f"cpu[{backend}]", seed=7, slab=1 << 20,
+                            pipelined=False)
 
 
 def measure_cpu_inmem(slab_mb: int, iters: int = 6) -> float:
@@ -792,6 +814,11 @@ def main():
 
         cpu_mbps = measure_cpu_e2e(base, dat_size)
         cpu_digests = shard_digests(base)
+        try:
+            cpu_rebuild = measure_cpu_rebuild(base, dat_size)
+        except Exception as e:  # noqa: BLE001 - secondary figure
+            log(f"cpu rebuild measurement failed: {e!r}")
+            cpu_rebuild = 0.0
         remove_shards(base)
         cpu_inmem = measure_cpu_inmem(slab_mb)
 
@@ -811,6 +838,7 @@ def main():
                            "attempts; value is the native CPU e2e path"),
                      device_init_attempts=retry_log,
                      cpu_inmem_mbps=round(cpu_inmem),
+                     cpu_rebuild_mbps=round(cpu_rebuild),
                      **late_secondary)
                 return
             # device arrived late: spend the remaining window on the
@@ -830,6 +858,7 @@ def main():
                      "device_kernel_chained",
                      chained_fit=chained_diag,
                      cpu_inmem_mbps=round(cpu_inmem),
+                     cpu_rebuild_mbps=round(cpu_rebuild),
                      device_init_attempts=retry_log,
                      chained_by_geo_mbps={
                          f"rs({k},{m})": round(v[0])
@@ -847,6 +876,7 @@ def main():
                           "native CPU e2e path",
                      device_init_attempts=retry_log,
                      cpu_inmem_mbps=round(cpu_inmem),
+                     cpu_rebuild_mbps=round(cpu_rebuild),
                      chained_by_geo_mbps={
                          f"rs({k},{m})": round(v[0])
                          for (k, m), v in chained_by_geo.items()
@@ -881,6 +911,7 @@ def main():
                      "device_kernel_chained",
                      chained_fit=chained_diag,
                      cpu_inmem_mbps=round(cpu_inmem),
+                     cpu_rebuild_mbps=round(cpu_rebuild),
                      e2e_tunnel={"error": f"{e!r:.120}"},
                      note="e2e phase failed mid-run (tunnel); kernel "
                           "chained-slope measured before it",
@@ -889,6 +920,8 @@ def main():
                 emit(cpu_mbps, 1.0, "cpu_e2e_device_failed_midrun",
                      note=f"TPU bench failed mid-run ({e!r:.120}); "
                           "value is the native CPU e2e path",
+                     cpu_inmem_mbps=round(cpu_inmem),
+                     cpu_rebuild_mbps=round(cpu_rebuild),
                      **secondary)
             return
         # correctness failures must NOT fall back to a healthy-looking
@@ -910,6 +943,7 @@ def main():
                             "means the pipeline saturates the link")}
         extras = {"e2e_tunnel": e2e_ctx,
                   "cpu_inmem_mbps": round(cpu_inmem),
+                  "cpu_rebuild_mbps": round(cpu_rebuild),
                   "device_init_attempts": retry_log}
         try:
             med, best, thr = measure_device_resident(slab_mb)
