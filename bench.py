@@ -72,10 +72,11 @@ def generate_dat(path: str, size_mb: int) -> int:
 
 def shard_digests(base: str) -> list:
     from seaweedfs_tpu.ec import to_ext
+    from seaweedfs_tpu.util import file_sha256
     out = []
     for i in range(TOTAL):
         with open(base + to_ext(i), "rb") as f:
-            out.append(hashlib.file_digest(f, "sha256").hexdigest())
+            out.append(file_sha256(f))
     return out
 
 
@@ -631,6 +632,12 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         ok = have == set(range(TOTAL))
         gather_s = timings.get("gather_s", 0.0)
         compute_s = timings.get("compute_s", 0.0)
+        # device telemetry relayed from the rebuilder (rebuild_ec_files
+        # via /admin/ec/rebuild): dispatch discipline must be VISIBLE in
+        # vs_baseline — a regression back to per-slab bitmat uploads or
+        # two-dispatch slabs shows here before it shows in wall time
+        stream_s = timings.get("stream_s", 0.0)
+        survivor_bytes = timings.get("survivor_bytes", 0)
         out = {"servers": n_servers, "volume_mb": size_mb,
                "backend": backend, "lost_shards": len(lost),
                "encode_spread_s": round(encode_s, 1),
@@ -643,6 +650,10 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                "gather_frac": round(gather_s / rebuild_s, 2),
                "compute_frac": round(compute_s / rebuild_s, 2),
                "gathered_shards": timings.get("gathered_shards", 0),
+               "dispatches": timings.get("dispatches", 0),
+               "bitmat_uploads": timings.get("bitmat_uploads", 0),
+               "rebuild_device_mbps": round(
+                   survivor_bytes / stream_s / 1e6) if stream_s else 0,
                "all_shards_restored": ok}
         log(f"cluster rebuild: {out}")
         return out

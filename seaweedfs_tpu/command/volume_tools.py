@@ -90,9 +90,11 @@ def export_volume(dirname: str, vid: int, collection: str = "",
     v = Volume(dirname, collection, vid)
     listed = []
     tar = tarfile.open(tar_path, "w") if tar_path else None
+    snapshot = None
     try:
         from ..storage.compact_map import snapshot_live_items
-        for nid, nv in snapshot_live_items(v.nm, by_offset=True):
+        snapshot = snapshot_live_items(v.nm, by_offset=True)
+        for nid, nv in snapshot:
             if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
                 continue
             from ..storage.needle import Needle
@@ -111,6 +113,8 @@ def export_volume(dirname: str, vid: int, collection: str = "",
     finally:
         if tar is not None:
             tar.close()
+        if snapshot is not None:
+            snapshot.close()
         v.close()
     return listed
 

@@ -137,7 +137,7 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
     k, m = codec.k, codec.m
     if pipelined is None:
-        pipelined = codec.backend == "tpu"
+        pipelined = codec.backend in ("tpu", "mesh")
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     slabs = _dat_slabs(dat_path, dat_size, k, large_block, small_block, slab,
@@ -147,7 +147,7 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
             pm = PipelinedMatmul(codec.matrix[k:], max_width=slab,
-                                 timer=timer)
+                                 timer=timer, codec=codec)
             stream = pm.stream(_coalesce_slabs(slabs, slab))
         else:
             stream = ((meta, data, codec.encode(data))
@@ -170,13 +170,23 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
 def rebuild_ec_files(base_name: str,
                      codec: Optional[ReedSolomonCodec] = None,
                      slab: int = DEFAULT_SLAB,
-                     pipelined: Optional[bool] = None) -> List[int]:
+                     pipelined: Optional[bool] = None,
+                     stats: Optional[dict] = None) -> List[int]:
     """Regenerate missing shard files from survivors. Returns the list of
-    rebuilt shard ids. Raises if fewer than k survive."""
+    rebuilt shard ids. Raises if fewer than k survive.
+
+    Device-backed codecs (tpu AND mesh) stream survivor slabs through
+    PipelinedMatmul with the fused decode plan: one device dispatch per
+    slab regenerates every missing shard (data + parity rows stacked),
+    with bounded in-flight depth instead of a synchronous per-slab
+    round-trip. ``stats``, when given, is filled with the dispatch
+    telemetry of this rebuild (dispatches / bitmat_uploads /
+    device_bytes / host_fallbacks deltas, survivor_bytes, stream_s) —
+    the bench's regression counters."""
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
     k, total = codec.k, codec.total
     if pipelined is None:
-        pipelined = codec.backend == "tpu"
+        pipelined = codec.backend in ("tpu", "mesh")
     present = [os.path.exists(base_name + to_ext(i)) for i in range(total)]
     missing = [i for i, p in enumerate(present) if not p]
     if not missing:
@@ -208,11 +218,14 @@ def rebuild_ec_files(base_name: str,
                 rows.append(np.frombuffer(ins[i].read(n), dtype=np.uint8))
             yield None, np.stack(rows, axis=0)
 
+    from ..ops import telemetry
+    before = telemetry.STATS.snapshot()
+    t_stream = time.perf_counter()
     try:
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
             coeffs = _rebuild_coeffs(codec, present, missing)
-            pm = PipelinedMatmul(coeffs, max_width=slab)
+            pm = PipelinedMatmul(coeffs, max_width=slab, codec=codec)
             for _, _, out in pm.stream(survivor_slabs()):
                 for r, i in enumerate(missing):
                     outs[i].write(out[r].tobytes())
@@ -236,6 +249,12 @@ def rebuild_ec_files(base_name: str,
                 h.close()
         for h in outs.values():
             h.close()
+    if stats is not None:
+        stats.update(telemetry.delta(before))
+        stats["survivor_bytes"] = shard_size * k
+        stats["rebuilt_bytes"] = shard_size * len(missing)
+        stats["stream_s"] = round(time.perf_counter() - t_stream, 3)
+        stats["backend"] = codec.backend
     return missing
 
 
@@ -244,22 +263,13 @@ def _rebuild_coeffs(codec: ReedSolomonCodec, present: List[bool],
     """(len(missing), k) GF coefficients so that
     missing_rows = coeffs @ stack(first k surviving shards).
 
-    Derivation mirrors ReedSolomonCodec.reconstruct: data rows come from
-    the inverse of the first-k-survivors submatrix; parity rows from
-    matrix[row] @ that inverse.
-    """
-    from ..ops import gf256
-
-    src = [i for i, p in enumerate(present) if p][:codec.k]
-    sub = codec.matrix[src, :]
-    inv = gf256.mat_inv(sub)
-    rows = []
-    for i in missing:
-        if i < codec.k:
-            rows.append(inv[i])
-        else:
-            rows.append(gf256.mat_mul(codec.matrix[i:i + 1, :], inv)[0])
-    return np.stack(rows, axis=0)
+    Delegates to the codec's fused decode-plan cache (the same plan
+    reconstruct() uses per-slab), so the derivation exists once —
+    ops/gf256.decode_coeff_rows."""
+    _, plan_missing, coeffs = codec.decode_plan(tuple(bool(p)
+                                                      for p in present))
+    assert plan_missing == list(missing)
+    return coeffs
 
 
 def ec_shard_base_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,

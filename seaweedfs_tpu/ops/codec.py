@@ -14,6 +14,8 @@ built, else numpy. All backends produce bit-identical output.
 
 from __future__ import annotations
 
+import os
+from collections import OrderedDict
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -21,14 +23,77 @@ import numpy as np
 from . import gf256
 
 
+def host_matmul(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """The pure-numpy GF(2^8) matmul: one 256-entry LUT gather + XOR per
+    (output row, input row) pair. The conformance oracle, and the
+    small-payload path device codecs delegate kilobyte reads to (a
+    device dispatch costs more than the whole LUT walk below
+    small_dispatch_bytes)."""
+    coeffs = np.asarray(coeffs, dtype=np.uint8)
+    data = np.asarray(data, dtype=np.uint8)
+    r = coeffs.shape[0]
+    out = np.zeros((r, data.shape[1]), dtype=np.uint8)
+    mt = gf256.MUL_TABLE
+    for i in range(r):
+        acc = out[i]
+        for j in range(coeffs.shape[1]):
+            c = coeffs[i, j]
+            if c == 0:
+                continue
+            if c == 1:
+                acc ^= data[j]
+            else:
+                acc ^= mt[c][data[j]]
+    return out
+
+
+def small_dispatch_default() -> int:
+    """Width (bytes) below which device codecs answer reconstruct() on
+    the host: reconstruct-on-read serves kilobyte needle ranges
+    (server/volume_server._reconstruct_shard_range) and a full device
+    round-trip per read would dominate the latency. Env-tunable."""
+    return int(os.environ.get("SW_EC_SMALL_DISPATCH_BYTES",
+                              str(256 << 10)))
+
+
+class _ConstCache:
+    """Bounded LRU of device-resident coefficient constants, keyed by
+    the coefficient bytes. A 256 MB rebuild must upload its ~14 KB
+    bit-matrix once, not once per slab — every make() call counts as a
+    bitmat_upload in ops/telemetry, so the bench can assert exactly
+    that."""
+
+    def __init__(self, maxsize: int = 32):
+        self._entries: OrderedDict = OrderedDict()
+        self._maxsize = maxsize
+
+    def get(self, key, make):
+        hit = self._entries.get(key)
+        if hit is not None:
+            self._entries.move_to_end(key)
+            return hit
+        val = make()
+        from .telemetry import STATS
+        STATS.add("bitmat_uploads")
+        self._entries[key] = val
+        if len(self._entries) > self._maxsize:
+            self._entries.popitem(last=False)
+        return val
+
+
 class ReedSolomonCodec:
     """Base class: matrix construction + reconstruction planning.
 
     Subclasses implement _matmul(coeffs, data) — the GF(2^8) matrix-vector
     product over byte rows — which is the only compute-heavy primitive.
+    Device-backed subclasses additionally expose device_fn() so
+    ops/pipeline.PipelinedMatmul can stream slabs through their kernel
+    (encode and rebuild share the same pipelined hot path).
     """
 
     backend = "abstract"
+    # 0 = never delegate; device codecs override with the env default
+    small_dispatch_bytes = 0
 
     def __init__(self, data_shards: int, parity_shards: int,
                  matrix_kind: str = "vandermonde"):
@@ -42,10 +107,25 @@ class ReedSolomonCodec:
         self.matrix_kind = matrix_kind
         self.matrix = gf256.build_matrix(self.k, self.total, matrix_kind)
         self._decode_cache: dict = {}
+        self._plan_cache: dict = {}
 
     # -- primitive ---------------------------------------------------------
     def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
         raise NotImplementedError
+
+    # -- device streaming hooks (ops/pipeline.PipelinedMatmul) -------------
+    def device_fn(self, coeffs: np.ndarray, width: int):
+        """Device-backed codecs return (jitted fn, device-resident
+        constant, put) for `width`-wide slabs: ``fn(constant,
+        put(slab))`` dispatches asynchronously and the constant stays
+        resident across slabs. Host codecs return None (no pipeline)."""
+        return None
+
+    def pipeline_width_bucket(self, n: int, cap: int) -> int:
+        """Bucket a slab width for compiled-executable reuse; mesh
+        codecs additionally pad to their shard split."""
+        from .rs_tpu import width_bucket
+        return width_bucket(n, cap)
 
     # -- public API --------------------------------------------------------
     def encode(self, data: np.ndarray) -> np.ndarray:
@@ -76,11 +156,36 @@ class ReedSolomonCodec:
         self._decode_cache[key] = (src, inv)
         return src, inv
 
+    def decode_plan(self, present: tuple, data_only: bool = False) -> tuple:
+        """Fused decode plan for a presence pattern: (src_rows, missing,
+        coeffs) with coeffs (len(missing), k) such that ALL missing rows
+        — data and parity stacked — come from ONE matmul against the
+        first k survivors. Cached per (present, data_only) alongside
+        _decode_cache, so steady-state rebuild pays zero GF planning per
+        slab and exactly one device dispatch."""
+        key = (tuple(present), bool(data_only))
+        hit = self._plan_cache.get(key)
+        if hit is not None:
+            return hit
+        src, inv = self._decode_coeffs(key[0])
+        limit = self.k if data_only else self.total
+        missing = [i for i in range(limit) if not present[i]]
+        coeffs = gf256.decode_coeff_rows(self.matrix, self.k, src,
+                                         missing, inv=inv)
+        plan = (src, missing, coeffs)
+        self._plan_cache[key] = plan
+        return plan
+
     def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
                     data_only: bool = False) -> List[np.ndarray]:
         """Fill in missing (None) shards. Mirrors reference Reconstruct /
         ReconstructData. Returns the full shard list (data-only mode leaves
-        missing parity as None)."""
+        missing parity as None).
+
+        All missing rows are regenerated by a single fused matmul
+        (decode_plan), and device codecs answer sub-small_dispatch_bytes
+        widths on the host — reconstruct-on-read of a kilobyte range
+        must not pay a device round-trip."""
         shards = list(shards)
         if len(shards) != self.total:
             raise ValueError(f"expected {self.total} shards, got {len(shards)}")
@@ -90,24 +195,20 @@ class ReedSolomonCodec:
         lens = {s.shape[-1] for s in shards if s is not None}
         if len(lens) != 1:
             raise ValueError("surviving shards have differing lengths")
-        src, inv = self._decode_coeffs(present)
+        src, missing, coeffs = self.decode_plan(present, data_only)
+        if not missing:
+            return shards
         survivors = np.stack([np.asarray(shards[i], dtype=np.uint8)
                               for i in src], axis=0)
-        missing_data = [i for i in range(self.k) if shards[i] is None]
-        if missing_data:
-            rows = inv[missing_data, :]
-            out = self._matmul(rows, survivors)
-            for r, i in enumerate(missing_data):
-                shards[i] = out[r]
-        if not data_only:
-            missing_par = [i for i in range(self.k, self.total)
-                           if shards[i] is None]
-            if missing_par:
-                # parity row = matrix[row] @ data = (matrix[row] @ inv) @ survivors
-                coeffs = gf256.mat_mul(self.matrix[missing_par, :], inv)
-                out = self._matmul(coeffs, survivors)
-                for r, i in enumerate(missing_par):
-                    shards[i] = out[r]
+        if self.small_dispatch_bytes and \
+                survivors.shape[1] < self.small_dispatch_bytes:
+            from .telemetry import STATS
+            STATS.add("host_fallbacks")
+            out = host_matmul(coeffs, survivors)
+        else:
+            out = self._matmul(coeffs, survivors)
+        for r, i in enumerate(missing):
+            shards[i] = out[r]
         return shards
 
     def reconstruct_data(self, shards: Sequence[Optional[np.ndarray]]
@@ -136,22 +237,7 @@ class NumpyCodec(ReedSolomonCodec):
     backend = "numpy"
 
     def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
-        coeffs = np.asarray(coeffs, dtype=np.uint8)
-        data = np.asarray(data, dtype=np.uint8)
-        r = coeffs.shape[0]
-        out = np.zeros((r, data.shape[1]), dtype=np.uint8)
-        mt = gf256.MUL_TABLE
-        for i in range(r):
-            acc = out[i]
-            for j in range(coeffs.shape[1]):
-                c = coeffs[i, j]
-                if c == 0:
-                    continue
-                if c == 1:
-                    acc ^= data[j]
-                else:
-                    acc ^= mt[c][data[j]]
-        return out
+        return host_matmul(coeffs, data)
 
 
 _TPU_PROBE_RESULT = None

@@ -202,3 +202,47 @@ def bit_matrix(coeff_rows: np.ndarray) -> np.ndarray:
                     if (prod >> k) & 1:
                         b[j * 8 + l, i * 8 + k] = 1
     return b
+
+
+def pack_bit_matrix(coeff_rows: np.ndarray) -> np.ndarray:
+    """bit_matrix with the input-bit axis packed into uint32 words.
+
+    Returns P of shape (ceil(cols*8/32), rows*8) uint32 where bit
+    (j % 32) of P[j // 32, o] is bit_matrix[j, o]. With payload columns
+    packed the same way (4 consecutive byte rows -> one uint32, byte j
+    at bit offset 8*(j % 4)), output bit o of a column is
+    parity(popcount(x & P[:, o])) — the AND/popcount form of the GF(2)
+    matmul that CPU backends run ~2 orders of magnitude faster than the
+    8x-lifted int8 dot (ops/rs_tpu.py chooses per platform).
+    """
+    bm = bit_matrix(coeff_rows)
+    k8, r8 = bm.shape
+    packed = np.zeros(((k8 + 31) // 32, r8), dtype=np.uint32)
+    for j in range(k8):
+        packed[j // 32] |= bm[j].astype(np.uint32) << np.uint32(j % 32)
+    return packed
+
+
+def decode_coeff_rows(matrix: np.ndarray, k: int, survivor_rows,
+                      missing_rows, inv: np.ndarray = None) -> np.ndarray:
+    """Fused decode plan: (len(missing_rows), k) GF coefficients C such
+    that missing = C @ stack(first k surviving shards).
+
+    Data rows come from the inverse of the first-k-survivors submatrix,
+    parity rows from matrix[row] @ that inverse — one derivation shared
+    by ReedSolomonCodec.decode_plan, ec/encoder._rebuild_coeffs and
+    parallel/sharded_ec.decode_bitmat, so the three call sites cannot
+    drift apart.
+    """
+    src = list(survivor_rows)[:k]
+    if inv is None:
+        inv = mat_inv(matrix[src, :])
+    rows = []
+    for r in missing_rows:
+        if r < k:
+            rows.append(inv[r])
+        else:
+            rows.append(mat_mul(matrix[r:r + 1, :], inv)[0])
+    if not rows:
+        return np.zeros((0, k), dtype=np.uint8)
+    return np.stack(rows, axis=0)

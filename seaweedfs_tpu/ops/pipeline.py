@@ -15,9 +15,15 @@ disk I/O with everything else (file reads release the GIL).
 
 PipelinedMatmul computes ``coeffs @ data`` over GF(2^8) for a stream of
 data slabs with a fixed coefficient matrix — encode (coeffs = parity
-rows) and rebuild (coeffs = decode-plan rows vs survivors) both reduce to
-this. Only the r output rows round-trip back to the host; for encode that
-is m/k of the h2d traffic.
+rows) and rebuild (coeffs = fused decode-plan rows vs survivors) both
+reduce to this. Only the r output rows round-trip back to the host; for
+encode that is m/k of the h2d traffic.
+
+The device kernel is pluggable: pass ``codec`` and the stream runs
+through ``codec.device_fn()`` — single-chip TpuCodec and the SPMD
+MeshCodec (sharded payloads, replicated device-resident coefficients)
+both pipeline through the same loop. Without a codec the single-device
+rs_tpu kernel is used directly (bench/raw callers).
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from typing import Iterable, Iterator, Optional, Tuple
 import numpy as np
 
 from .rs_tpu import fn_and_bitmat, width_bucket
+from .telemetry import STATS
 from ..util.profiling import StageTimer
 
 _SENTINEL = object()
@@ -48,7 +55,8 @@ class PipelinedMatmul:
     def __init__(self, coeffs: np.ndarray,
                  max_width: int = 32 << 20, depth: int = 4,
                  prefetch: int = 3, drain_threads: int = 2,
-                 timer: Optional[StageTimer] = None):
+                 timer: Optional[StageTimer] = None,
+                 codec=None):
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         self.r, self.k = coeffs.shape
         self.max_width = int(max_width)
@@ -56,18 +64,32 @@ class PipelinedMatmul:
         self.prefetch = int(prefetch)
         self.drain_threads = int(drain_threads)
         self.timer = timer  # optional per-stage breakdown (bench/profiling)
+        self.codec = codec  # device fn + shardings come from the codec
         self._coeffs = coeffs
         self._bitmat_dev = None
+        self._put = None
+
+    def _bucket(self, width: int) -> int:
+        if self.codec is not None:
+            return self.codec.pipeline_width_bucket(width, self.max_width)
+        return width_bucket(width, self.max_width)
 
     def _fn(self, width: int):
-        """Platform-appropriate kernel for this width (fused Pallas on
-        TPU, XLA elsewhere); also lazily uploads the matching bitmat on
+        """Kernel for this width from the codec (mesh-sharded program
+        with device-resident replicated coefficients, or the single-chip
+        kernel) or, codec-less, the platform rs_tpu kernel (fused Pallas
+        on TPU, packed-popcount XLA elsewhere). Constants upload on
         first use — the choice must happen at stream time, after the
         backend is known."""
+        if self.codec is not None:
+            fn, self._bitmat_dev, self._put = \
+                self.codec.device_fn(self._coeffs, width)
+            return fn
         fn, bitmat_np = fn_and_bitmat(self._coeffs, width)
         if self._bitmat_dev is None:
             import jax.numpy as jnp
             self._bitmat_dev = jnp.asarray(bitmat_np)
+            STATS.add("bitmat_uploads")
         return fn
 
     def stream(self, slabs: Iterable[Tuple[object, np.ndarray]]
@@ -122,19 +144,22 @@ class PipelinedMatmul:
                 if w > self.max_width:
                     raise ValueError(
                         f"slab width {w} exceeds max_width {self.max_width}")
-                bucket = width_bucket(w, self.max_width)
+                bucket = self._bucket(w)
                 if w < bucket:
                     padded = np.zeros((self.k, bucket), dtype=np.uint8)
                     padded[:, :w] = data
                 else:
                     padded = data
                 fn = self._fn(bucket)                # also uploads bitmat
+                put = self._put or jnp.asarray
                 t0 = time.perf_counter()
-                dev = jnp.asarray(padded)            # h2d (blocking copy)
+                dev = put(padded)                    # h2d (blocking copy)
                 if timer is not None:
                     end = time.perf_counter()
                     timer.add("h2d", end - t0, padded.nbytes,
                               interval=(t0, end))
+                STATS.add("dispatches")
+                STATS.add("device_bytes", data.nbytes)
                 out = fn(self._bitmat_dev, dev)      # async dispatch
                 fut = drain_pool.submit(fetch, out, self.r * bucket)
                 pending.append((meta, data, fut, w))

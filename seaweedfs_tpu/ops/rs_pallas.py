@@ -88,9 +88,13 @@ def _fused_fn(k: int, r: int, n: int, tile: int, interpret: bool):
         data = data_ref[...]  # (k, tile) uint8
         # unpack: eight mask-and-compare planes, stacked plane-major
         # along sublanes -> (8k, tile) in {0,1}. (Mask+compare, not
-        # shifts: Mosaic has no uint8 shrui legalization.)
+        # shifts: Mosaic has no uint8 shrui legalization. The masks and
+        # the payload view are int8 — bit-identical for bitwise AND,
+        # and Mosaic can't materialize uint8 constants.)
+        bits = jax.lax.bitcast_convert_type(data, jnp.int8)
+        masks = (1, 2, 4, 8, 16, 32, 64, -128)
         x = jnp.concatenate(
-            [((data & (1 << l)) != 0).astype(jnp.int8) for l in range(8)],
+            [((bits & jnp.int8(m)) != 0).astype(jnp.int8) for m in masks],
             axis=0)
         # MXU: exact 0/1 arithmetic, int32 accumulation
         y = jax.lax.dot_general(

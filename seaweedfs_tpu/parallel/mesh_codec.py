@@ -4,11 +4,29 @@
 `jax.sharding.Mesh` of all visible devices: the payload axis shards
 over 'data' (stripes are independent byte positions — zero
 communication), coefficients replicate, and XLA partitions the
-bit-plane matmul (parallel/sharded_ec.py documents the math). On an
+GF(2) program (parallel/sharded_ec.py documents the math). On an
 8-chip host a volume encode therefore streams through all chips from
 the same `write_ec_files` call sites the single-chip TpuCodec uses;
 on the CPU test mesh it exercises the identical program. Outputs are
-bit-identical to every other backend (exact int32 arithmetic).
+bit-identical to every other backend (exact integer arithmetic).
+
+Two program forms, chosen by mesh platform (same split as
+ops/rs_tpu.fn_and_bitmat):
+
+  * TPU — the bit-plane int8 matmul: unpack to GF(2) bit rows, one
+    MXU dot, pack. The MXU eats the 8x lift for free.
+  * everything else (the virtual CPU test mesh) — packed AND/popcount:
+    the k*8 contraction bits packed into uint32 words, each output bit
+    a parity of popcounts. ~64x less arithmetic and no 8x intermediate;
+    this is what turned the round-5 rebuild from 2 MB/s into a usable
+    hot path on the CPU mesh.
+
+Dispatch discipline (the round-5 lesson): coefficients are lifted and
+uploaded ONCE per coefficient matrix (bounded LRU, ops/codec._ConstCache),
+chunk dispatches are issued before any output is drained (JAX dispatch is
+async — blocking np.asarray per chunk serializes compute against d2h),
+and the pipelined encode/rebuild path streams slabs through device_fn()
+with bounded in-flight depth (ops/pipeline.PipelinedMatmul).
 
 This is the serving-path face of SURVEY §2.6's device tier: the same
 sharded programs the driver dry-runs via __graft_entry__ become the
@@ -22,7 +40,9 @@ from typing import Dict, Tuple
 import numpy as np
 
 from ..ops import gf256
-from ..ops.codec import ReedSolomonCodec
+from ..ops.codec import ReedSolomonCodec, _ConstCache, small_dispatch_default
+from ..ops.rs_tpu import width_bucket
+from ..ops.telemetry import STATS
 from .mesh import make_mesh
 
 
@@ -31,11 +51,16 @@ class MeshCodec(ReedSolomonCodec):
 
     def __init__(self, data_shards: int, parity_shards: int,
                  matrix_kind: str = "vandermonde", mesh=None,
-                 chunk_bytes: int = 32 << 20):
+                 chunk_bytes: int = 32 << 20,
+                 small_dispatch_bytes: int = None):
         super().__init__(data_shards, parity_shards, matrix_kind)
         self.chunk_bytes = int(chunk_bytes)
         self._mesh = mesh  # lazy: devices may not be initialized yet
         self._fns: Dict[Tuple[int, int, int], object] = {}
+        self.small_dispatch_bytes = (
+            small_dispatch_default() if small_dispatch_bytes is None
+            else int(small_dispatch_bytes))
+        self._consts = _ConstCache()
 
     @property
     def mesh(self):
@@ -43,10 +68,14 @@ class MeshCodec(ReedSolomonCodec):
             self._mesh = make_mesh()
         return self._mesh
 
+    def _on_tpu_mesh(self) -> bool:
+        return self.mesh.devices.flat[0].platform == "tpu"
+
     def _fn(self, rows_in: int, rows_out: int, n: int):
-        """Jitted (bitmat (rows_in*8, rows_out*8) int8, data
-        (rows_in, n) uint8) -> (rows_out, n) uint8, payload sharded
-        over 'data'."""
+        """Jitted (const, data (rows_in, n) uint8) -> (rows_out, n)
+        uint8, payload sharded over 'data', const replicated. The const
+        is the int8 bit-matrix (TPU mesh) or the packed uint32 bit-
+        matrix (elsewhere) — _device_const builds the matching form."""
         key = (rows_in, rows_out, n)
         fn = self._fns.get(key)
         if fn is not None:
@@ -55,16 +84,43 @@ class MeshCodec(ReedSolomonCodec):
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        def program(bitmat, data):
-            shifts = jnp.arange(8, dtype=jnp.uint8)
-            bits = ((data[:, None, :] >> shifts[None, :, None]) & 1)
-            x = bits.reshape(rows_in * 8, n).astype(jnp.int8)
-            y = jax.lax.dot_general(
-                bitmat.T, x, dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
-            ybits = (y & 1).astype(jnp.uint8).reshape(rows_out, 8, n)
-            weights = (jnp.uint8(1) << shifts)[None, :, None]
-            return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
+        if self._on_tpu_mesh():
+            def program(bitmat, data):
+                shifts = jnp.arange(8, dtype=jnp.uint8)
+                bits = ((data[:, None, :] >> shifts[None, :, None]) & 1)
+                x = bits.reshape(rows_in * 8, n).astype(jnp.int8)
+                y = jax.lax.dot_general(
+                    bitmat.T, x,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                ybits = (y & 1).astype(jnp.uint8).reshape(rows_out, 8, n)
+                weights = (jnp.uint8(1) << shifts)[None, :, None]
+                return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
+        else:
+            nw = (rows_in * 8 + 31) // 32
+
+            def program(bmp, data):
+                d32 = data.astype(jnp.uint32)
+                words = []
+                for wi in range(nw):
+                    acc = jnp.zeros((n,), jnp.uint32)
+                    for b in range(4):
+                        j = wi * 4 + b
+                        if j < rows_in:
+                            acc = acc | (d32[j] << (8 * b))
+                    words.append(acc)
+                outs = []
+                for i in range(rows_out):
+                    byte = jnp.zeros((n,), jnp.uint32)
+                    for bit in range(8):
+                        col = i * 8 + bit
+                        ones = jnp.zeros((n,), jnp.uint32)
+                        for wi in range(nw):
+                            ones = ones + jax.lax.population_count(
+                                words[wi] & bmp[wi, col])
+                        byte = byte | ((ones & 1) << bit)
+                    outs.append(byte.astype(jnp.uint8))
+                return jnp.stack(outs)
 
         mesh = self.mesh
         fn = jax.jit(
@@ -75,6 +131,42 @@ class MeshCodec(ReedSolomonCodec):
         self._fns[key] = fn
         return fn
 
+    def _device_const(self, coeffs: np.ndarray):
+        """Device-resident replicated coefficient constant — uploaded
+        once per coefficient matrix, reused across every slab of a
+        rebuild/encode (round-5 fix: re-lifting + re-uploading per call
+        was most of the 2 MB/s)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def make():
+            if self._on_tpu_mesh():
+                host = gf256.bit_matrix(coeffs).astype(np.int8)
+            else:
+                host = gf256.pack_bit_matrix(coeffs)
+            return jax.device_put(
+                host, NamedSharding(self.mesh, P(None, None)))
+
+        return self._consts.get(coeffs.tobytes(), make)
+
+    def _put(self, data: np.ndarray):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(
+            data, NamedSharding(self.mesh, P(None, "data")))
+
+    def device_fn(self, coeffs: np.ndarray, width: int):
+        """Streaming hook for PipelinedMatmul: (fn, resident const,
+        put). `width` must come from pipeline_width_bucket (even shard
+        split over 'data')."""
+        coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
+        r, k = coeffs.shape
+        return self._fn(k, r, width), self._device_const(coeffs), self._put
+
+    def pipeline_width_bucket(self, n: int, cap: int) -> int:
+        bucket = width_bucket(n, cap)
+        return bucket + (-bucket) % self.mesh.shape["data"]
+
     def _width_bucket(self, n: int) -> int:
         """Pad widths to power-of-two buckets (compile reuse), then up to
         a multiple of the 'data' axis so the shard split is even."""
@@ -84,16 +176,18 @@ class MeshCodec(ReedSolomonCodec):
         return bucket + (-bucket) % data_ax
 
     def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
-        import jax.numpy as jnp
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         data = np.ascontiguousarray(data, dtype=np.uint8)
         r, k = coeffs.shape
         n = data.shape[1]
         if n == 0:
             return np.zeros((r, 0), dtype=np.uint8)
-        bitmat = jnp.asarray(gf256.bit_matrix(coeffs).astype(np.int8))
+        bitmat = self._device_const(coeffs)
         out = np.empty((r, n), dtype=np.uint8)
         step = self.chunk_bytes
+        # dispatch all chunks, then drain: the async dispatches overlap
+        # device compute with the d2h of earlier chunks
+        pending = []
         for off in range(0, n, step):
             end = min(off + step, n)
             w = end - off
@@ -104,5 +198,9 @@ class MeshCodec(ReedSolomonCodec):
                 padded[:, :w] = data[:, off:end]
             else:
                 padded = data[:, off:end]
-            out[:, off:end] = np.asarray(fn(bitmat, padded))[:, :w]
+            STATS.add("dispatches")
+            STATS.add("device_bytes", w * k)
+            pending.append((off, end, fn(bitmat, self._put(padded))))
+        for off, end, dev in pending:
+            out[:, off:end] = np.asarray(dev)[:, : end - off]
         return out

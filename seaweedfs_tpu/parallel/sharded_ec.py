@@ -119,7 +119,13 @@ def sharded_rebuild_fn(mesh, k: int, n_out_shards: int, n: int):
         weights = (jnp.uint8(1) << shifts)[None, :, None]
         return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
 
-    smap = jax.shard_map(
+    # jax.shard_map only exists from 0.5; fall back to the experimental
+    # home it had before that
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+    smap = shard_map(
         local, mesh=mesh,
         in_specs=(P("shard", None), P("shard", "data")),
         out_specs=P(None, "data"))
@@ -142,17 +148,13 @@ def sharded_rebuild_fn(mesh, k: int, n_out_shards: int, n: int):
 def decode_bitmat(k: int, m: int, survivor_rows, missing_rows,
                   pad_to_mult: int = 1) -> np.ndarray:
     """GF(2) lift of the decode matrix restoring missing_rows from the first
-    k survivor_rows, zero-padded on the contraction axis to pad_to_mult."""
+    k survivor_rows, zero-padded on the contraction axis to pad_to_mult.
+    The coefficient derivation is the shared fused decode plan
+    (gf256.decode_coeff_rows — same rows ReedSolomonCodec.decode_plan
+    and rebuild_ec_files dispatch in one matmul)."""
     matrix = gf256.build_matrix(k, k + m)
-    sub = matrix[list(survivor_rows)[:k], :]
-    inv = gf256.mat_inv(sub)
-    rows = []
-    for r in missing_rows:
-        if r < k:
-            rows.append(inv[r])
-        else:
-            rows.append(gf256.mat_mul(matrix[r:r + 1, :], inv)[0])
-    coeffs = np.stack(rows, axis=0)  # (len(missing), k)
+    coeffs = gf256.decode_coeff_rows(matrix, k, survivor_rows,
+                                     missing_rows)  # (len(missing), k)
     bm = gf256.bit_matrix(coeffs).astype(np.int8)  # (k*8, len(missing)*8)
     return _pad_rows(bm, pad_to_mult)
 

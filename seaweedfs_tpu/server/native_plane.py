@@ -155,10 +155,14 @@ class NativeReadPlane:
             h, volume.id, volume.dat_path.encode(), volume.version)
         if rc != 0:
             return False
-        import numpy as np
         from ..storage.compact_map import snapshot_live_items
         with volume.lock:
             entries = snapshot_live_items(volume.nm)
+        with entries:
+            return self._bulk_load(volume, entries)
+
+    def _bulk_load(self, volume, entries) -> bool:
+        import numpy as np
 
         def put_chunk(keys, offsets, sizes):
             ka = np.asarray(keys, dtype=np.uint64)

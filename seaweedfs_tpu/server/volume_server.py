@@ -679,9 +679,10 @@ class VolumeServer:
 
     def admin_ec_rebuild(self, req: Request):
         vid = int(req.query["volume"])
+        stats: dict = {}
         rebuilt = self.store.rebuild_ec_shards(
-            vid, req.query.get("collection", ""))
-        return {"volume": vid, "rebuilt": rebuilt}
+            vid, req.query.get("collection", ""), stats=stats)
+        return {"volume": vid, "rebuilt": rebuilt, "stats": stats}
 
     def admin_ec_copy(self, req: Request):
         """Pull shard files from a source server (reference
@@ -805,16 +806,19 @@ class VolumeServer:
             # offset order: the per-needle reads below then stream the
             # .dat sequentially instead of random-seeking a large volume
             snapshot = snapshot_live_items(v.nm, by_offset=True)
-        for nid, nv in snapshot:
-            checked += 1
-            try:
-                # lock per needle, not for the whole scan — a multi-GB walk
-                # must not stall reads/writes on the volume
-                with v.lock:
-                    blob = v._read_blob(nv.offset, nv.size)
-                Needle.from_bytes(blob, v.version, expected_size=nv.size)
-            except (CorruptNeedle, OSError, VolumeError):
-                errors += 1
+        with snapshot:
+            for nid, nv in snapshot:
+                checked += 1
+                try:
+                    # lock per needle, not for the whole scan — a
+                    # multi-GB walk must not stall reads/writes on the
+                    # volume
+                    with v.lock:
+                        blob = v._read_blob(nv.offset, nv.size)
+                    Needle.from_bytes(blob, v.version,
+                                      expected_size=nv.size)
+                except (CorruptNeedle, OSError, VolumeError):
+                    errors += 1
         return {"volume": vid, "checked": checked, "errors": errors}
 
     def admin_ec_to_volume(self, req: Request):

@@ -208,6 +208,14 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
         timings["compute_s"] = timings.get("compute_s", 0) + (t2 - t1)
         timings["gathered_shards"] = \
             timings.get("gathered_shards", 0) + len(to_copy)
+        # dispatch telemetry from the rebuilder (rebuild_ec_files):
+        # bench counters proving one dispatch per slab and one bitmat
+        # upload per rebuild
+        for key, val in (out.get("stats") or {}).items():
+            if isinstance(val, (int, float)):
+                timings[key] = timings.get(key, 0) + val
+            else:
+                timings[key] = val
     rebuilt = out.get("rebuilt", [])
     if rebuilt:
         t3 = _time.perf_counter()

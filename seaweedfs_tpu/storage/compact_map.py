@@ -332,7 +332,35 @@ class SortedFileNeedleMap(_SortedBase):
 NEEDLE_MAP_KINDS = {"memory", "compact", "sortedfile", "disk"}
 
 
-def snapshot_live_items(nm, by_offset: bool = False):
+class SnapshotItems:
+    """Uniform closeable handle over a live-set snapshot: either the
+    disk map's private-connection cursor or a plain in-memory list.
+    Iterate it directly, or use as a context manager / call close() in
+    a finally so the sqlite WAL snapshot connection is released the
+    moment the walk ends rather than at GC (a pinned snapshot blocks
+    checkpoint truncation for as long as it lives)."""
+
+    def __init__(self, items):
+        self._items = items
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def close(self):
+        close = getattr(self._items, "close", None)
+        self._items = ()
+        if close is not None:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def snapshot_live_items(nm, by_offset: bool = False) -> SnapshotItems:
     """Consistent live-set snapshot of ANY needle-map variant; the
     caller must hold the volume lock across this call. Disk maps
     flush pending state then stream from a pinned private-connection
@@ -340,15 +368,16 @@ def snapshot_live_items(nm, by_offset: bool = False):
     HERE so no caller can forget it); in-memory maps list-copy.
     by_offset orders by .dat offset (the vacuum merge-walk's need);
     leave it False where order doesn't matter — for the disk map that
-    skips a whole-table sort."""
+    skips a whole-table sort. Close the returned handle (context
+    manager or try/finally) when done."""
     snap = getattr(nm, "items_snapshot", None)
     if snap is not None:
         nm.flush()
-        return snap(by_offset=by_offset)
+        return SnapshotItems(snap(by_offset=by_offset))
     items = list(nm.items())
     if by_offset:
         items.sort(key=lambda kv: kv[1].offset)
-    return items
+    return SnapshotItems(items)
 
 
 def load_needle_map(idx_path: str, kind: str = "memory",

@@ -46,6 +46,43 @@ def _u64(nid: int) -> int:
     return nid + (1 << 64) if nid < 0 else nid
 
 
+class _SnapshotCursor:
+    """Closeable iterator over a pinned WAL snapshot (items_snapshot).
+    Closes the private connection on exhaustion, on close(), or on
+    context-manager exit — whichever comes first; close is idempotent.
+    __del__ is only the last-resort backstop for leaked handles."""
+
+    def __init__(self, db, cur, first):
+        self._db, self._cur, self._row = db, cur, first
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        row = self._row
+        if row is None:
+            self.close()
+            raise StopIteration
+        self._row = self._cur.fetchone()
+        return _u64(row[0]), NeedleValue(row[1], row[2])
+
+    def close(self):
+        db, self._db = self._db, None
+        self._row = None
+        if db is not None:
+            db.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        self.close()
+
+
 class DiskNeedleMap:
     """sqlite-checkpointed needle map; API-compatible with NeedleMap."""
 
@@ -315,21 +352,19 @@ class DiskNeedleMap:
         returns), so a caller holding the volume lock gets a view of
         exactly now — anything committed after the lock releases stays
         out of the snapshot and is replayed by the vacuum makeup diff
-        instead of being copied twice."""
+        instead of being copied twice.
+
+        The returned cursor closes its connection when exhausted, but a
+        caller that stops early (merge-walk break, exception) would
+        otherwise pin the WAL until GC — preventing checkpoint
+        truncation for the volume's lifetime. close() is explicit and
+        idempotent; use the cursor as a context manager (or close() in
+        a finally) for a deterministic release."""
         db = sqlite3.connect(self.db_path, check_same_thread=False)
         cur = db.execute("SELECT nid, off, size FROM needles"
                          + (" ORDER BY off" if by_offset else ""))
         first = cur.fetchone()            # pins the WAL read snapshot
-
-        def walk():
-            try:
-                row = first
-                while row is not None:
-                    yield _u64(row[0]), NeedleValue(row[1], row[2])
-                    row = cur.fetchone()
-            finally:
-                db.close()
-        return walk()
+        return _SnapshotCursor(db, cur, first)
 
     def items(self) -> Iterator[Tuple[int, NeedleValue]]:
         # NOT snapshot-consistent: this cursor shares the mutating

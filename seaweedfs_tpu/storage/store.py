@@ -202,11 +202,16 @@ class Store:
             self._changed()
         return out
 
-    def rebuild_ec_shards(self, vid: int, collection: str = "") -> List[int]:
+    def rebuild_ec_shards(self, vid: int, collection: str = "",
+                          stats: dict = None) -> List[int]:
+        """``stats``, when given, receives the rebuild's dispatch
+        telemetry (rebuild_ec_files fills it) for the admin endpoint /
+        bench counters."""
         for loc in self.locations:
             base = volume_file_prefix(loc.directory, collection, vid)
             if os.path.exists(base + ".ecx"):
-                rebuilt = ec_encoder.rebuild_ec_files(base, codec=self.codec)
+                rebuilt = ec_encoder.rebuild_ec_files(base, codec=self.codec,
+                                                      stats=stats)
                 from ..ec.decoder import read_ec_volume_superblock
                 rebuild_ecx_file(
                     base, read_ec_volume_superblock(base).offset_width)

@@ -465,7 +465,10 @@ class Volume:
             n = Needle.from_bytes(blob, self.version, verify_crc=False)
         except Exception:  # noqa: BLE001 - corrupt record: keep it
             return False
-        return bool(n.last_modified) and \
+        # needles written before the volume acquired its TTL (or via a
+        # path that never stamped the flag) carry no TTL bit — expiring
+        # them off last_modified alone would vacuum live data
+        return n.has_ttl() and bool(n.last_modified) and \
             now >= n.last_modified + ttl_seconds
 
     def _begin_compaction(self):
@@ -529,7 +532,8 @@ class Volume:
         # volume_vacuum.go:426-428)
         ttl_seconds, now = self._ttl_clock()
         try:
-            with open(cpd, "wb") as dat_out, open(cpx, "wb") as idx_out:
+            with live, open(cpd, "wb") as dat_out, \
+                    open(cpx, "wb") as idx_out:
                 dat_out.write(new_sb.to_bytes())
                 for nid, nv in live:
                     if nv.size == TOMBSTONE_FILE_SIZE or nv.offset == 0:
@@ -570,8 +574,8 @@ class Volume:
                 # lock/map-lookup round trips (mutations after this
                 # point are covered by commit's makeup diff, exactly
                 # like compact())
-                live_iter = iter(snapshot_live_items(self.nm,
-                                                     by_offset=True))
+                live = snapshot_live_items(self.nm, by_offset=True)
+                live_iter = iter(live)
             except BaseException:
                 self._compacting = False   # same guard as compact()
                 raise
@@ -610,6 +614,10 @@ class Volume:
                                                  width))
                     throttler.maybe_slowdown(len(blob))
         finally:
+            # the merge-walk usually ends before the snapshot is
+            # exhausted (.dat tail past the last live record) — close
+            # explicitly so the WAL snapshot doesn't outlive the pass
+            live.close()
             self._compacting = False
         return deleted_size
 

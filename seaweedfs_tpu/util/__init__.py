@@ -1,5 +1,17 @@
 """Cross-cutting utilities (reference weed/util/)."""
 
+import hashlib as _hashlib
+
 from .cipher import CipherError, decrypt, encrypt, gen_key  # noqa: F401
 from .compression import (gunzip_data, gzip_data,  # noqa: F401
                           is_compressible)
+
+
+def file_sha256(fileobj) -> str:
+    """hashlib.file_digest(f, "sha256").hexdigest() for Python < 3.11."""
+    if hasattr(_hashlib, "file_digest"):
+        return _hashlib.file_digest(fileobj, "sha256").hexdigest()
+    h = _hashlib.sha256()
+    for block in iter(lambda: fileobj.read(1 << 20), b""):
+        h.update(block)
+    return h.hexdigest()

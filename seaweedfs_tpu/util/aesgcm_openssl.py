@@ -1,0 +1,133 @@
+"""AES-GCM via ctypes + libcrypto — fallback when the `cryptography`
+wheel is absent from the runtime image.
+
+Exposes the same two names util/cipher.py needs (`AESGCM`, `InvalidTag`)
+with the same call shapes, backed by OpenSSL's EVP interface, which
+every Python build with an `ssl` module already links. Only what the
+cipher path uses is implemented: 16/24/32-byte keys, no AAD streaming
+beyond a single optional buffer, 16-byte tag appended to the
+ciphertext.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+
+
+class InvalidTag(Exception):
+    pass
+
+
+_EVP_CTRL_GCM_SET_IVLEN = 0x9
+_EVP_CTRL_GCM_GET_TAG = 0x10
+_EVP_CTRL_GCM_SET_TAG = 0x11
+_TAG_SIZE = 16
+
+_lib = None
+
+
+def _crypto():
+    global _lib
+    if _lib is None:
+        name = ctypes.util.find_library("crypto") or "libcrypto.so"
+        lib = ctypes.CDLL(name)
+        lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+        for f in ("EVP_aes_128_gcm", "EVP_aes_192_gcm", "EVP_aes_256_gcm"):
+            getattr(lib, f).restype = ctypes.c_void_p
+        _lib = lib
+    return _lib
+
+
+def _cipher_for(key: bytes):
+    lib = _crypto()
+    by_len = {16: lib.EVP_aes_128_gcm, 24: lib.EVP_aes_192_gcm,
+              32: lib.EVP_aes_256_gcm}
+    if len(key) not in by_len:
+        raise ValueError(f"AESGCM key must be 16/24/32 bytes, "
+                         f"got {len(key)}")
+    return by_len[len(key)]()
+
+
+class AESGCM:
+    def __init__(self, key: bytes):
+        self._key = bytes(key)
+        _cipher_for(self._key)  # validate key size eagerly
+
+    def _init_ctx(self, nonce: bytes, encrypt: bool):
+        lib = _crypto()
+        ctx = lib.EVP_CIPHER_CTX_new()
+        if not ctx:
+            raise MemoryError("EVP_CIPHER_CTX_new failed")
+        init = lib.EVP_EncryptInit_ex if encrypt else lib.EVP_DecryptInit_ex
+        if init(ctypes.c_void_p(ctx), ctypes.c_void_p(_cipher_for(self._key)),
+                None, None, None) != 1:
+            lib.EVP_CIPHER_CTX_free(ctypes.c_void_p(ctx))
+            raise RuntimeError("EVP init (cipher) failed")
+        if lib.EVP_CIPHER_CTX_ctrl(ctypes.c_void_p(ctx),
+                                   _EVP_CTRL_GCM_SET_IVLEN,
+                                   len(nonce), None) != 1 or \
+                init(ctypes.c_void_p(ctx), None, None, self._key,
+                     bytes(nonce)) != 1:
+            lib.EVP_CIPHER_CTX_free(ctypes.c_void_p(ctx))
+            raise RuntimeError("EVP init (key/iv) failed")
+        return lib, ctx
+
+    def encrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        lib, ctx = self._init_ctx(nonce, encrypt=True)
+        try:
+            outl = ctypes.c_int(0)
+            if aad:
+                if lib.EVP_EncryptUpdate(ctypes.c_void_p(ctx), None,
+                                         ctypes.byref(outl), bytes(aad),
+                                         len(aad)) != 1:
+                    raise RuntimeError("EVP aad update failed")
+            out = ctypes.create_string_buffer(len(data) + _TAG_SIZE)
+            if lib.EVP_EncryptUpdate(ctypes.c_void_p(ctx), out,
+                                     ctypes.byref(outl), bytes(data),
+                                     len(data)) != 1:
+                raise RuntimeError("EVP encrypt update failed")
+            total = outl.value
+            if lib.EVP_EncryptFinal_ex(
+                    ctypes.c_void_p(ctx),
+                    ctypes.byref(out, total), ctypes.byref(outl)) != 1:
+                raise RuntimeError("EVP encrypt final failed")
+            total += outl.value
+            tag = ctypes.create_string_buffer(_TAG_SIZE)
+            if lib.EVP_CIPHER_CTX_ctrl(ctypes.c_void_p(ctx),
+                                       _EVP_CTRL_GCM_GET_TAG,
+                                       _TAG_SIZE, tag) != 1:
+                raise RuntimeError("EVP get tag failed")
+            return out.raw[:total] + tag.raw
+        finally:
+            lib.EVP_CIPHER_CTX_free(ctypes.c_void_p(ctx))
+
+    def decrypt(self, nonce: bytes, data: bytes, aad) -> bytes:
+        if len(data) < _TAG_SIZE:
+            raise InvalidTag("ciphertext shorter than the GCM tag")
+        ct, tag = bytes(data[:-_TAG_SIZE]), bytes(data[-_TAG_SIZE:])
+        lib, ctx = self._init_ctx(nonce, encrypt=False)
+        try:
+            outl = ctypes.c_int(0)
+            if aad:
+                if lib.EVP_DecryptUpdate(ctypes.c_void_p(ctx), None,
+                                         ctypes.byref(outl), bytes(aad),
+                                         len(aad)) != 1:
+                    raise RuntimeError("EVP aad update failed")
+            out = ctypes.create_string_buffer(max(len(ct), 1))
+            if lib.EVP_DecryptUpdate(ctypes.c_void_p(ctx), out,
+                                     ctypes.byref(outl), ct, len(ct)) != 1:
+                raise InvalidTag("GCM decrypt update failed")
+            total = outl.value
+            if lib.EVP_CIPHER_CTX_ctrl(ctypes.c_void_p(ctx),
+                                       _EVP_CTRL_GCM_SET_TAG,
+                                       _TAG_SIZE, tag) != 1:
+                raise RuntimeError("EVP set tag failed")
+            if lib.EVP_DecryptFinal_ex(ctypes.c_void_p(ctx),
+                                       ctypes.byref(out, total),
+                                       ctypes.byref(outl)) != 1:
+                raise InvalidTag("GCM tag mismatch")
+            total += outl.value
+            return out.raw[:total]
+        finally:
+            lib.EVP_CIPHER_CTX_free(ctypes.c_void_p(ctx))

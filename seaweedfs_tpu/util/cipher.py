@@ -11,8 +11,13 @@ from __future__ import annotations
 
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:
+    # runtime image without the cryptography wheel: same AES-GCM via
+    # ctypes + libcrypto (which every Python with `ssl` already links)
+    from .aesgcm_openssl import AESGCM, InvalidTag
 
 KEY_SIZE = 32
 NONCE_SIZE = 12

@@ -44,6 +44,23 @@ def find_config_file(name: str,
     return None
 
 
+def _toml_module():
+    """stdlib tomllib is 3.11+; on 3.10 fall back to a tomli copy —
+    standalone if installed, else the one pip/setuptools vendor (same
+    package tomllib was adopted from, identical load() API)."""
+    try:
+        import tomllib
+        return tomllib
+    except ImportError:
+        pass
+    try:
+        import tomli
+        return tomli
+    except ImportError:
+        from pip._vendor import tomli
+        return tomli
+
+
 def load_config(name: str, dirs: Optional[List[str]] = None,
                 env: Optional[dict] = None) -> Dict[str, object]:
     """Flattened dotted-key config for <name>, {} when no file exists;
@@ -52,7 +69,7 @@ def load_config(name: str, dirs: Optional[List[str]] = None,
     path = find_config_file(name, dirs)
     if path is not None:
         if path.endswith(".toml"):
-            import tomllib
+            tomllib = _toml_module()
             with open(path, "rb") as f:
                 cfg = _flatten(tomllib.load(f))
         else:

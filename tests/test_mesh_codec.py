@@ -59,10 +59,11 @@ def test_write_ec_files_digest_parity(tmp_path):
         f.write(rng.integers(0, 256, 3 << 20, dtype=np.uint8).tobytes())
 
     def digests():
+        from seaweedfs_tpu.util import file_sha256
         out = []
         for i in range(14):
             with open(base + to_ext(i), "rb") as f:
-                out.append(hashlib.file_digest(f, "sha256").hexdigest())
+                out.append(file_sha256(f))
         return out
 
     write_ec_files(base, codec=NumpyCodec(10, 4), large_block=1 << 20,

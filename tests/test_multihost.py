@@ -61,6 +61,10 @@ def test_two_process_mesh_runs_ec_step(tmp_path):
             raise
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and \
+                "aren't implemented on the CPU backend" in out:
+            pytest.skip("this jax build has no multiprocess CPU "
+                        "collectives")
         assert p.returncode == 0, \
             f"process {pid} failed:\n{out[-2000:]}"
     results = []

@@ -109,7 +109,8 @@ class TestScaffold:
     def test_all_configs_print(self):
         from seaweedfs_tpu.command.scaffold import SCAFFOLDS, \
             print_scaffold
-        import tomllib
+        from seaweedfs_tpu.util.config import _toml_module
+        tomllib = _toml_module()
         for name in SCAFFOLDS:
             text = print_scaffold(name)
             if name == "master":        # TOML scaffold (reference master.toml)
