@@ -654,6 +654,12 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                "bitmat_uploads": timings.get("bitmat_uploads", 0),
                "rebuild_device_mbps": round(
                    survivor_bytes / stream_s / 1e6) if stream_s else 0,
+               # per-phase {name: seconds} from the rebuilder's spans
+               # (gather/plan/dispatch/drain/write) plus the trace id —
+               # the full span timeline is at the rebuilder's
+               # /admin/traces?trace=<id>
+               "phases": timings.get("phases", {}),
+               "trace_id": timings.get("trace_id"),
                "all_shards_restored": ok}
         log(f"cluster rebuild: {out}")
         return out

@@ -13,6 +13,7 @@ import shutil
 
 from ..storage.needle import get_actual_size
 from ..storage.needle_map import bytes_to_entry, entry_to_bytes
+from ..util import tracing
 from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
 from ..storage.types import NEEDLE_ENTRY_SIZE, NEEDLE_ID_SIZE, \
     TOMBSTONE_FILE_SIZE, bytes_to_needle_id
@@ -79,6 +80,13 @@ def write_dat_file(base_name: str, dat_size: int,
                    small_block: int = SMALL_BLOCK_SIZE,
                    buf_size: int = 8 << 20):
     """Interleave-copy .ec00-09 back into a .dat of dat_size bytes."""
+    with tracing.span("write", op="ec.to_volume", bytes=int(dat_size)):
+        _write_dat_file(base_name, dat_size, large_block, small_block,
+                        buf_size)
+
+
+def _write_dat_file(base_name, dat_size, large_block, small_block,
+                    buf_size):
     ins = [open(base_name + to_ext(i), "rb") for i in range(DATA_SHARDS)]
     try:
         with open(base_name + ".dat", "wb") as dat:

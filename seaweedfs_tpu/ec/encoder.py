@@ -33,6 +33,7 @@ import numpy as np
 
 from ..ops.codec import ReedSolomonCodec, get_codec
 from ..storage.needle_map import MemDb
+from ..util import tracing
 from ..util.profiling import StageTimer
 from .constants import (DATA_SHARDS, LARGE_BLOCK_SIZE, PARITY_SHARDS,
                         SMALL_BLOCK_SIZE, to_ext)
@@ -140,6 +141,9 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
         pipelined = codec.backend in ("tpu", "mesh")
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
+    # always collect stages: the per-phase spans below need them even
+    # when no caller asked for a bench breakdown
+    timer = timer if timer is not None else StageTimer()
     slabs = _dat_slabs(dat_path, dat_size, k, large_block, small_block, slab,
                        timer)
     outs = [open(base_name + to_ext(i), "wb") for i in range(k + m)]
@@ -158,13 +162,34 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                 outs[i].write(data[i].tobytes())
             for j in range(m):
                 outs[k + j].write(parity[j].tobytes())
-            if timer is not None:
-                end = time.perf_counter()
-                timer.add("shard_write", end - t0,
-                          data.nbytes + parity.nbytes, interval=(t0, end))
+            end = time.perf_counter()
+            timer.add("shard_write", end - t0,
+                      data.nbytes + parity.nbytes, interval=(t0, end))
     finally:
         for o in outs:
             o.close()
+    _record_phase_spans(timer, pipelined, op="ec.encode")
+
+
+def _phases_from_timer(timer: StageTimer, pipelined: bool) -> dict:
+    """Map StageTimer stages onto the canonical EC phase names, from
+    the consumer thread's perspective: in the pipelined path the waits
+    (read_wait / h2d / drain_wait) plus the write stage tile the stream
+    wall, so the phases sum to ~the operation time instead of
+    double-counting overlapped worker-thread work."""
+    t = timer.totals
+    return {
+        "gather": t.get("read_wait" if pipelined else "disk_read", 0.0),
+        "dispatch": t.get("h2d", 0.0),
+        "drain": t.get("drain_wait", 0.0),
+        "write": t.get("shard_write", 0.0),
+    }
+
+
+def _record_phase_spans(timer: StageTimer, pipelined: bool, op: str):
+    for name, secs in _phases_from_timer(timer, pipelined).items():
+        if secs > 0:
+            tracing.record_span(name, secs, op=op)
 
 
 def rebuild_ec_files(base_name: str,
@@ -220,18 +245,36 @@ def rebuild_ec_files(base_name: str,
 
     from ..ops import telemetry
     before = telemetry.STATS.snapshot()
+    phases = {"gather": 0.0, "plan": 0.0, "dispatch": 0.0,
+              "drain": 0.0, "write": 0.0}
     t_stream = time.perf_counter()
     try:
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
+            t0 = time.perf_counter()
             coeffs = _rebuild_coeffs(codec, present, missing)
-            pm = PipelinedMatmul(coeffs, max_width=slab, codec=codec)
+            phases["plan"] = time.perf_counter() - t0
+            ptimer = StageTimer()
+            pm = PipelinedMatmul(coeffs, max_width=slab, codec=codec,
+                                 timer=ptimer)
             for _, _, out in pm.stream(survivor_slabs()):
+                t0 = time.perf_counter()
                 for r, i in enumerate(missing):
                     outs[i].write(out[r].tobytes())
+                phases["write"] += time.perf_counter() - t0
+            # consumer-side accounting: the stream loop's time splits
+            # into waiting for survivor reads (gather), h2d puts
+            # (dispatch), waiting for device results (drain), and the
+            # writes above — overlapped worker-thread work (reader,
+            # drain pool) is deliberately NOT added on top, so the
+            # phases tile the wall instead of exceeding it
+            phases["gather"] = ptimer.totals.get("read_wait", 0.0)
+            phases["dispatch"] = ptimer.totals.get("h2d", 0.0)
+            phases["drain"] = ptimer.totals.get("drain_wait", 0.0)
         else:
             for off in range(0, shard_size, slab):
                 n = min(slab, shard_size - off)
+                t0 = time.perf_counter()
                 shards: List[Optional[np.ndarray]] = []
                 for i in range(total):
                     if ins[i] is None:
@@ -240,21 +283,39 @@ def rebuild_ec_files(base_name: str,
                         ins[i].seek(off)
                         shards.append(np.frombuffer(ins[i].read(n),
                                                     dtype=np.uint8))
+                t1 = time.perf_counter()
                 rebuilt = codec.reconstruct(shards)
+                t2 = time.perf_counter()
                 for i in missing:
                     outs[i].write(rebuilt[i].tobytes())
+                t3 = time.perf_counter()
+                phases["gather"] += t1 - t0
+                phases["dispatch"] += t2 - t1
+                phases["write"] += t3 - t2
     finally:
         for h in ins:
             if h is not None:
                 h.close()
         for h in outs.values():
             h.close()
+    stream_s = time.perf_counter() - t_stream
+    # pad/bucket copies and dispatch issuance are the only consumer-side
+    # work not bracketed above; attribute the remainder to dispatch so
+    # the phase breakdown sums to the operation wall
+    residual = stream_s - sum(phases.values())
+    if residual > 0:
+        phases["dispatch"] += residual
+    for name, secs in phases.items():
+        if secs > 0:
+            tracing.record_span(name, secs, op="ec.rebuild",
+                                backend=codec.backend)
     if stats is not None:
         stats.update(telemetry.delta(before))
         stats["survivor_bytes"] = shard_size * k
         stats["rebuilt_bytes"] = shard_size * len(missing)
-        stats["stream_s"] = round(time.perf_counter() - t_stream, 3)
+        stats["stream_s"] = round(stream_s, 3)
         stats["backend"] = codec.backend
+        stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
     return missing
 
 

@@ -195,18 +195,26 @@ class ReedSolomonCodec:
         lens = {s.shape[-1] for s in shards if s is not None}
         if len(lens) != 1:
             raise ValueError("surviving shards have differing lengths")
-        src, missing, coeffs = self.decode_plan(present, data_only)
+        from ..util import tracing
+        with tracing.span("plan", backend=self.backend):
+            src, missing, coeffs = self.decode_plan(present, data_only)
         if not missing:
             return shards
         survivors = np.stack([np.asarray(shards[i], dtype=np.uint8)
                               for i in src], axis=0)
-        if self.small_dispatch_bytes and \
-                survivors.shape[1] < self.small_dispatch_bytes:
-            from .telemetry import STATS
-            STATS.add("host_fallbacks")
-            out = host_matmul(coeffs, survivors)
-        else:
-            out = self._matmul(coeffs, survivors)
+        small = self.small_dispatch_bytes and \
+            survivors.shape[1] < self.small_dispatch_bytes
+        # the reconstruct span's (bytes, seconds, path) tags feed the
+        # SW_EC_SMALL_DISPATCH_BYTES tuner (stats.metrics.observe_span)
+        with tracing.span("reconstruct", backend=self.backend,
+                          bytes=int(survivors.nbytes),
+                          path="host" if small else "device"):
+            if small:
+                from .telemetry import STATS
+                STATS.add("host_fallbacks")
+                out = host_matmul(coeffs, survivors)
+            else:
+                out = self._matmul(coeffs, survivors)
         for r, i in enumerate(missing):
             shards[i] = out[r]
         return shards

@@ -182,25 +182,28 @@ class MeshCodec(ReedSolomonCodec):
         n = data.shape[1]
         if n == 0:
             return np.zeros((r, 0), dtype=np.uint8)
+        from ..util import tracing
         bitmat = self._device_const(coeffs)
         out = np.empty((r, n), dtype=np.uint8)
         step = self.chunk_bytes
         # dispatch all chunks, then drain: the async dispatches overlap
         # device compute with the d2h of earlier chunks
         pending = []
-        for off in range(0, n, step):
-            end = min(off + step, n)
-            w = end - off
-            bucket = self._width_bucket(w)
-            fn = self._fn(k, r, bucket)
-            if w < bucket:  # zero-pad: GF-linear, so exact
-                padded = np.zeros((k, bucket), dtype=np.uint8)
-                padded[:, :w] = data[:, off:end]
-            else:
-                padded = data[:, off:end]
-            STATS.add("dispatches")
-            STATS.add("device_bytes", w * k)
-            pending.append((off, end, fn(bitmat, self._put(padded))))
-        for off, end, dev in pending:
-            out[:, off:end] = np.asarray(dev)[:, : end - off]
+        with tracing.span("dispatch", backend="mesh", bytes=int(n * k)):
+            for off in range(0, n, step):
+                end = min(off + step, n)
+                w = end - off
+                bucket = self._width_bucket(w)
+                fn = self._fn(k, r, bucket)
+                if w < bucket:  # zero-pad: GF-linear, so exact
+                    padded = np.zeros((k, bucket), dtype=np.uint8)
+                    padded[:, :w] = data[:, off:end]
+                else:
+                    padded = data[:, off:end]
+                STATS.add("dispatches")
+                STATS.add("device_bytes", w * k)
+                pending.append((off, end, fn(bitmat, self._put(padded))))
+        with tracing.span("drain", backend="mesh", bytes=int(n * r)):
+            for off, end, dev in pending:
+                out[:, off:end] = np.asarray(dev)[:, : end - off]
         return out

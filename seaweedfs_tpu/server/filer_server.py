@@ -26,7 +26,7 @@ from ..filer.log_buffer import LogBuffer, event_notification
 from ..filer.filerstore import make_store
 from ..filer.stream import read_chunked
 from .http_util import (HttpError, HttpServer, Request, Response,
-                        Router)
+                        Router, traces_handler)
 
 CHUNK_SIZE_DEFAULT = 32 << 20  # reference -maxMB=32 autochunk default
 
@@ -56,6 +56,7 @@ class FilerServer:
         router.add("POST", "/filer/meta/delete_chunks",
                    self.meta_delete_chunks)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/admin/traces", traces_handler)
         router.set_fallback(self.data_handler)
         from ..stats.metrics import (FILER_REQUEST_COUNTER,
                                      FILER_REQUEST_HISTOGRAM)

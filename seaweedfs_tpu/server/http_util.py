@@ -19,6 +19,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..util import tracing
+
 
 class HttpError(Exception):
     def __init__(self, status: int, message: str = ""):
@@ -135,6 +137,17 @@ class Request:
 Route = Tuple[str, str, bool, Callable]
 
 
+def traces_handler(req: Request) -> dict:
+    """JSON view of the in-process trace ring, shared by every server
+    role: ``/admin/traces?n=20`` for the newest traces, or
+    ``/admin/traces?trace=<id>`` for one trace's spans."""
+    tid = req.query.get("trace")
+    if tid:
+        return {"trace_id": tid, "spans": tracing.RING.get(tid)}
+    n = int(req.query.get("n", "20"))
+    return {"traces": tracing.RING.recent(n)}
+
+
 def process_memory_stats() -> dict:
     """Peak RSS of this process (reference statsMemoryHandler).
     ru_maxrss is kilobytes on Linux but BYTES on macOS/BSD."""
@@ -164,22 +177,32 @@ class Router:
         self.fallback = fn
 
     def dispatch(self, req: Request):
-        if self.observe is None:
-            return self._dispatch(req)
         import time as _time
+        # continue a remote trace if the caller sent a traceparent; the
+        # span becomes the handler thread's current span, so spans made
+        # inside the handler (EC phases, peer fetches) link to it
+        srv_span = tracing.start_span(
+            f"{req.method} {req.path.split('?')[0]}",
+            traceparent=req.headers.get(tracing.TRACEPARENT_HEADER))
         t0 = _time.monotonic()
         label = None
         try:
             label, fn = self._route(req)
+            srv_span.name = label
             out = fn(req)
-            self.observe(label, _time.monotonic() - t0, True)
+            if self.observe is not None:
+                self.observe(label, _time.monotonic() - t0, True)
             return out
-        except Exception:
-            # label stays low-cardinality: the raw path would mint a
-            # new Prometheus series per fid/404 probe
-            self.observe(label or f"{req.method} unrouted",
-                         _time.monotonic() - t0, False)
+        except Exception as e:
+            srv_span.tags.setdefault("error", type(e).__name__)
+            if self.observe is not None:
+                # label stays low-cardinality: the raw path would mint a
+                # new Prometheus series per fid/404 probe
+                self.observe(label or f"{req.method} unrouted",
+                             _time.monotonic() - t0, False)
             raise
+        finally:
+            tracing.finish_span(srv_span)
 
     def _dispatch(self, req: Request):
         label, fn = self._route(req)
@@ -607,9 +630,20 @@ def _nodelay(conn):
             pass
 
 
+def _traced_headers(headers: Optional[dict]) -> dict:
+    """Inject the W3C ``traceparent`` on cluster-internal calls so the
+    receiving server's span continues this caller's trace (no-op when
+    the caller already set one, e.g. a redirect re-entry)."""
+    h = dict(headers) if headers else {}
+    if tracing.TRACEPARENT_HEADER not in h:
+        h[tracing.TRACEPARENT_HEADER] = tracing.outbound_traceparent()
+    return h
+
+
 def _pooled_call(method: str, url: str, body, headers: dict,
                  timeout: float, max_redirects: int = 5,
                  want_headers: bool = False):
+    headers = _traced_headers(headers)
     parsed = urllib.parse.urlsplit(url)
     netloc, scheme = parsed.netloc, parsed.scheme
     target = parsed.path or "/"
@@ -719,7 +753,8 @@ def http_download(url: str, path: str, timeout: float = 600.0) -> int:
     """Stream a GET response straight to a file (volume-sized pulls must
     not transit RAM). Returns bytes written."""
     url = _client_url(url)
-    req = urllib.request.Request(url, method="GET")
+    req = urllib.request.Request(url, method="GET",
+                                 headers=_traced_headers(None))
     try:
         with urllib.request.urlopen(req, timeout=timeout,
                                     context=_TLS["client_ctx"]) as resp, \

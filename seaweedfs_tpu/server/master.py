@@ -17,7 +17,8 @@ from ..storage.types import TTL, ReplicaPlacement
 from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
 from .http_util import (HttpError, HttpServer, Request, Response,
-                        Router, post_json, post_multipart)
+                        Router, post_json, post_multipart,
+                        traces_handler)
 
 
 class MasterServer:
@@ -63,6 +64,7 @@ class MasterServer:
         router.add("*", "/cluster/volumes", self.cluster_volumes)
         router.add("GET", "/cluster/watch", self.cluster_watch)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/", self.ui_handler)
         router.add("GET", "/ui", self.ui_handler)
         # GET /<fid> on the master redirects to a holder (reference
@@ -85,10 +87,12 @@ class MasterServer:
         from .watch_hub import WatchHub
         self.watch_hub = WatchHub(self._location_snapshot)
         self.topology.location_listener = self.watch_hub.publish
-        from ..stats.metrics import MASTER_REQUEST_COUNTER
+        from ..stats.metrics import (MASTER_REQUEST_COUNTER,
+                                     MASTER_REQUEST_HISTOGRAM)
 
         def observe(label, seconds, ok):
             MASTER_REQUEST_COUNTER.inc(label if ok else label + " error")
+            MASTER_REQUEST_HISTOGRAM.observe(seconds, label)
         router.observe = observe
         self.server = HttpServer(port, router, host)
         self.port = self.server.port

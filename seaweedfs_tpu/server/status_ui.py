@@ -45,6 +45,29 @@ def render_page(title: str, sections, footer_html: str = "") -> bytes:
                         now=time.strftime("%Y-%m-%d %H:%M:%S")).encode()
 
 
+def traces_section(n: int = 8):
+    """(heading, headers, rows) for the newest traces in the in-process
+    ring — each row is one trace: id, root span, span count, phase
+    breakdown, and the longest span's duration."""
+    from ..util import tracing
+    rows = []
+    for t in tracing.RING.recent(n):
+        phases = {}
+        for s in t["spans"]:
+            name = s.get("name")
+            if name in tracing.PHASES:
+                phases[name] = phases.get(name, 0.0) \
+                    + (s.get("duration_s") or 0.0)
+        breakdown = " ".join(f"{p}={phases[p]*1000:.0f}ms"
+                             for p in tracing.PHASES if p in phases) or "-"
+        rows.append((t["trace_id"][:16], t.get("root") or "-",
+                     t["span_count"], breakdown,
+                     f"{t['max_span_s']*1000:.1f}ms"))
+    return ("Recent traces (/admin/traces)",
+            ["trace", "root span", "spans", "ec phases", "longest span"],
+            rows)
+
+
 def master_status_page(master) -> bytes:
     topo = master.topology
     nodes = []
@@ -69,6 +92,7 @@ def master_status_page(master) -> bytes:
                             "max", "last heartbeat"], nodes),
         ("Volumes", ["id", "collection", "server", "size", "files",
                      "deleted"], vols[:200]),
+        traces_section(),
     ]
     return render_page(f"Master {master.url}", sections)
 
@@ -93,5 +117,6 @@ def volume_status_page(vs) -> bytes:
         ("Volumes", ["id", "collection", "dir", "size", "files",
                      "deleted", "mode", "index", "offw"], vols),
         ("EC volumes", ["id", "collection", "shards"], ecs),
+        traces_section(),
     ]
     return render_page(f"Volume server {vs.url}", sections)

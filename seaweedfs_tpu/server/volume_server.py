@@ -24,7 +24,7 @@ from ..storage.store import Store
 from ..storage.types import parse_file_id
 from ..storage.volume import NotFound, VolumeError, volume_file_prefix
 from .http_util import (HttpError, HttpServer, Request, Response, Router,
-                        get_json, http_call, post_json)
+                        get_json, http_call, post_json, traces_handler)
 
 
 class VolumeServer:
@@ -72,6 +72,7 @@ class VolumeServer:
         router.add("POST", "/admin/volume/tail_receive",
                    self.admin_volume_tail_receive)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/stats/disk", self.stats_disk)
         router.add("GET", "/stats/memory", self.stats_memory)
         router.add("GET", "/ui", self.ui_handler)
@@ -518,6 +519,13 @@ class VolumeServer:
                                          "redirected")
             FAST_PLANE_COUNTER.set_total(self.fast_plane.written,
                                          "written")
+        # device-codec telemetry (process-global monotonic counters)
+        # mirrors onto the scrape so dispatches / bitmat uploads / host
+        # fallbacks are visible without running a rebuild through bench
+        from ..ops import telemetry
+        from ..stats.metrics import DEVICE_TELEMETRY_COUNTER
+        for kind, total in telemetry.STATS.snapshot().items():
+            DEVICE_TELEMETRY_COUNTER.set_total(total, kind)
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -678,11 +686,13 @@ class VolumeServer:
         return {"volume": vid, "unmounted": out}
 
     def admin_ec_rebuild(self, req: Request):
+        from ..util import tracing
         vid = int(req.query["volume"])
         stats: dict = {}
         rebuilt = self.store.rebuild_ec_shards(
             vid, req.query.get("collection", ""), stats=stats)
-        return {"volume": vid, "rebuilt": rebuilt, "stats": stats}
+        return {"volume": vid, "rebuilt": rebuilt, "stats": stats,
+                "trace_id": tracing.current_trace_id()}
 
     def admin_ec_copy(self, req: Request):
         """Pull shard files from a source server (reference

@@ -173,65 +173,90 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
     survivor pulls, compute = the GF rebuild on the rebuilder, mount) —
     the benchmark's overlap accounting for BASELINE config 5."""
     import time as _time
-    # pick the node with most free slots as rebuilder (reference
-    # command_ec_rebuild.go: pick by free slot count)
-    rebuilder = _free_nodes(env)[0]["url"]
-    local = {s for s, urls in shards.items() if rebuilder in urls}
-    # copy surviving shards the rebuilder lacks — pulls from distinct
-    # sources run concurrently (reference prepareDataToRecover +
-    # goroutine fan-out); the .ecx rides along with exactly one copy
+    from ..util import tracing
     from ..util.fanout import fan_out_must_succeed
-    to_copy = [(sid, urls[0]) for sid, urls in shards.items()
-               if sid not in local]
-    copied = [sid for sid, _ in to_copy]
+    # shell-side trace root: every call below — the master free-slot
+    # query, survivor pulls, rebuild, mount — carries its traceparent,
+    # so the whole operation lands in ONE trace
+    root = tracing.start_span("ec.rebuild", volume=vid)
+    try:
+        # pick the node with most free slots as rebuilder (reference
+        # command_ec_rebuild.go: pick by free slot count)
+        rebuilder = _free_nodes(env)[0]["url"]
+        local = {s for s, urls in shards.items() if rebuilder in urls}
+        # copy surviving shards the rebuilder lacks — pulls from
+        # distinct sources run concurrently (reference
+        # prepareDataToRecover + goroutine fan-out); the .ecx rides
+        # along with exactly one copy
+        to_copy = [(sid, urls[0]) for sid, urls in shards.items()
+                   if sid not in local]
+        copied = [sid for sid, _ in to_copy]
 
-    def pull(job):
-        (sid, src), with_ecx = job
-        env.node_post(rebuilder,
-                      f"/admin/ec/copy?volume={vid}&collection={collection}"
-                      f"&source={src}&shards={sid}"
-                      f"&copy_ecx={'true' if with_ecx else 'false'}")
+        def pull(job):
+            (sid, src), with_ecx = job
+            # fan-out worker threads don't inherit the contextvar —
+            # parent each per-source gather span on the root explicitly
+            with tracing.span("gather", parent=root, shard=sid,
+                              source=src):
+                env.node_post(
+                    rebuilder,
+                    f"/admin/ec/copy?volume={vid}&collection={collection}"
+                    f"&source={src}&shards={sid}"
+                    f"&copy_ecx={'true' if with_ecx else 'false'}")
 
-    jobs = [(item, (not local) and i == 0) for i, item in enumerate(to_copy)]
-    t0 = _time.perf_counter()
-    fan_out_must_succeed(pull, jobs,
-                         what=f"survivor shard copy for volume {vid}",
-                         dedicated=True)
-    t1 = _time.perf_counter()
-    # rebuild + mount only the previously-missing shards
-    out = env.node_post(rebuilder,
-                        f"/admin/ec/rebuild?volume={vid}"
-                        f"&collection={collection}")
-    t2 = _time.perf_counter()
-    if timings is not None:
-        timings["gather_s"] = timings.get("gather_s", 0) + (t1 - t0)
-        timings["compute_s"] = timings.get("compute_s", 0) + (t2 - t1)
-        timings["gathered_shards"] = \
-            timings.get("gathered_shards", 0) + len(to_copy)
-        # dispatch telemetry from the rebuilder (rebuild_ec_files):
-        # bench counters proving one dispatch per slab and one bitmat
-        # upload per rebuild
-        for key, val in (out.get("stats") or {}).items():
-            if isinstance(val, (int, float)):
-                timings[key] = timings.get(key, 0) + val
-            else:
-                timings[key] = val
-    rebuilt = out.get("rebuilt", [])
-    if rebuilt:
-        t3 = _time.perf_counter()
-        env.node_post(rebuilder,
-                      f"/admin/ec/mount?volume={vid}"
-                      f"&collection={collection}"
-                      f"&shards={','.join(map(str, rebuilt))}")
+        jobs = [(item, (not local) and i == 0)
+                for i, item in enumerate(to_copy)]
+        t0 = _time.perf_counter()
+        fan_out_must_succeed(pull, jobs,
+                             what=f"survivor shard copy for volume {vid}",
+                             dedicated=True)
+        t1 = _time.perf_counter()
+        # rebuild + mount only the previously-missing shards
+        out = env.node_post(rebuilder,
+                            f"/admin/ec/rebuild?volume={vid}"
+                            f"&collection={collection}")
+        t2 = _time.perf_counter()
         if timings is not None:
-            timings["mount_s"] = timings.get("mount_s", 0) + \
-                (_time.perf_counter() - t3)
-    # clean up temp survivor copies (not mounted here)
-    if copied:
-        env.node_post(rebuilder,
-                      f"/admin/ec/delete_shards?volume={vid}"
-                      f"&collection={collection}"
-                      f"&shards={','.join(map(str, copied))}")
+            timings["gather_s"] = timings.get("gather_s", 0) + (t1 - t0)
+            timings["compute_s"] = timings.get("compute_s", 0) + (t2 - t1)
+            timings["gathered_shards"] = \
+                timings.get("gathered_shards", 0) + len(to_copy)
+            timings["trace_id"] = root.trace_id
+            # dispatch telemetry from the rebuilder (rebuild_ec_files):
+            # bench counters proving one dispatch per slab and one bitmat
+            # upload per rebuild
+            for key, val in (out.get("stats") or {}).items():
+                if key == "phases" and isinstance(val, dict):
+                    # per-phase {name: seconds} breakdown — sum across
+                    # volumes like the numeric timings
+                    agg = timings.setdefault("phases", {})
+                    for ph, secs in val.items():
+                        agg[ph] = round(agg.get(ph, 0.0) + secs, 6)
+                elif isinstance(val, (int, float)):
+                    timings[key] = timings.get(key, 0) + val
+                else:
+                    timings[key] = val
+        rebuilt = out.get("rebuilt", [])
+        if rebuilt:
+            t3 = _time.perf_counter()
+            env.node_post(rebuilder,
+                          f"/admin/ec/mount?volume={vid}"
+                          f"&collection={collection}"
+                          f"&shards={','.join(map(str, rebuilt))}")
+            if timings is not None:
+                timings["mount_s"] = timings.get("mount_s", 0) + \
+                    (_time.perf_counter() - t3)
+        # clean up temp survivor copies (not mounted here)
+        if copied:
+            env.node_post(rebuilder,
+                          f"/admin/ec/delete_shards?volume={vid}"
+                          f"&collection={collection}"
+                          f"&shards={','.join(map(str, copied))}")
+    except BaseException as e:
+        root.tags.setdefault("error", type(e).__name__)
+        raise
+    finally:
+        tracing.finish_span(root)
     env.write(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}")
 
 

@@ -18,10 +18,17 @@ _DEFAULT_BUCKETS = (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1,
                     0.3, 1.0, 3.0, 10.0)
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double quote,
+    and line feed must be escaped inside the quoted label value."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(label_names, label_values) -> str:
     if not label_names:
         return ""
-    pairs = ",".join(f'{k}="{v}"' for k, v in
+    pairs = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in
                      zip(label_names, label_values))
     return "{" + pairs + "}"
 
@@ -211,6 +218,104 @@ FILER_REQUEST_HISTOGRAM = FILER_GATHER.histogram(
 MASTER_REQUEST_COUNTER = MASTER_GATHER.counter(
     "SeaweedFS_master_request_total",
     "Counter of master requests.", labels=("type",))
+MASTER_REQUEST_HISTOGRAM = MASTER_GATHER.histogram(
+    "SeaweedFS_master_request_seconds",
+    "Bucketed histogram of master request processing time.",
+    labels=("type",))
+
+# -- EC phase spans (fed by util/tracing via observe_span) -------------------
+
+EC_PHASE_NAMES = ("gather", "plan", "dispatch", "drain", "write")
+
+VOLUME_EC_PHASE_HISTOGRAM = VOLUME_SERVER_GATHER.histogram(
+    "SeaweedFS_volumeServer_ec_phase_seconds",
+    "Bucketed histogram of per-phase EC span durations.",
+    labels=("phase",))
+VOLUME_EC_PHASE_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_phase_seconds_total",
+    "Cumulative seconds spent in each EC phase.",
+    labels=("phase",))
+DEVICE_TELEMETRY_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_device_telemetry_total",
+    "Process-global device codec telemetry (ops/telemetry.STATS).",
+    labels=("kind",))
+SMALL_DISPATCH_SUGGESTED_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_small_dispatch_suggested_bytes",
+    "Suggested SW_EC_SMALL_DISPATCH_BYTES fitted from the first "
+    "reconstruct spans (0 until enough samples).")
+
+
+class SmallDispatchTuner:
+    """Fits the host/device crossover from the first-N reconstruct
+    spans: device dispatch time is modeled as a + b*bytes (fixed
+    dispatch+transfer latency plus per-byte cost), the host path as a
+    flat rate, and the suggested threshold is the width where the
+    device line dips below the host line.  Published as a gauge so the
+    open SW_EC_SMALL_DISPATCH_BYTES auto-tuning item has its signal."""
+
+    MIN_SAMPLES = 4          # per path, before suggesting anything
+    MAX_SAMPLES = 64         # "first few calls" — stop learning after
+    CLAMP = (64 << 10, 8 << 20)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._host: List[Tuple[float, float]] = []    # (bytes, seconds)
+        self._device: List[Tuple[float, float]] = []
+
+    def add(self, path: str, nbytes: float, seconds: float):
+        if nbytes <= 0 or seconds <= 0:
+            return None
+        with self._lock:
+            samples = self._host if path == "host" else self._device
+            if len(samples) >= self.MAX_SAMPLES:
+                return None
+            samples.append((float(nbytes), float(seconds)))
+        return self.suggest()
+
+    def suggest(self) -> Optional[int]:
+        with self._lock:
+            host = list(self._host)
+            device = list(self._device)
+        if len(host) < self.MIN_SAMPLES or len(device) < self.MIN_SAMPLES:
+            return None
+        host_rate = sum(b for b, _ in host) / sum(s for _, s in host)
+        # least-squares fit t = a + b*x over the device samples
+        n = len(device)
+        mx = sum(b for b, _ in device) / n
+        my = sum(s for _, s in device) / n
+        sxx = sum((b - mx) ** 2 for b, _ in device)
+        if sxx <= 0:            # all widths identical — can't fit slope
+            return None
+        b_fit = sum((x - mx) * (y - my) for x, y in device) / sxx
+        a_fit = my - b_fit * mx
+        denom = 1.0 / host_rate - b_fit
+        if a_fit <= 0 or denom <= 0:
+            # device never wins (or fit degenerate) in the sampled range
+            return self.CLAMP[1]
+        cross = a_fit / denom
+        return int(min(max(cross, self.CLAMP[0]), self.CLAMP[1]))
+
+
+SMALL_DISPATCH_TUNER = SmallDispatchTuner()
+
+
+def observe_span(span_dict: Dict):
+    """Export hook called by util/tracing for every finished span."""
+    name = span_dict.get("name")
+    dur = span_dict.get("duration_s")
+    if dur is None:
+        return
+    if name in EC_PHASE_NAMES:
+        VOLUME_EC_PHASE_HISTOGRAM.observe(dur, name)
+        VOLUME_EC_PHASE_COUNTER.inc(name, amount=dur)
+    elif name == "reconstruct":
+        tags = span_dict.get("tags") or {}
+        path = tags.get("path")
+        nbytes = tags.get("bytes")
+        if path in ("host", "device") and nbytes:
+            suggestion = SMALL_DISPATCH_TUNER.add(path, nbytes, dur)
+            if suggestion:
+                SMALL_DISPATCH_SUGGESTED_GAUGE.set(suggestion)
 
 
 def start_push_loop(registry: Registry, gateway_url: str,

@@ -146,8 +146,10 @@ class Store:
         if not v.readonly:
             raise VolumeError(f"volume {vid} must be readonly for ec encode")
         base = v.file_name()
-        ec_encoder.write_sorted_file_from_idx(base)
-        ec_encoder.write_ec_files(base, codec=self.codec)
+        from ..util import tracing
+        with tracing.span("ec.encode.local", volume=vid):
+            ec_encoder.write_sorted_file_from_idx(base)
+            ec_encoder.write_ec_files(base, codec=self.codec)
         import json
         with open(base + ".vif", "w") as f:
             # offset_width must ride along: a shard receiver holding only
@@ -207,14 +209,23 @@ class Store:
         """``stats``, when given, receives the rebuild's dispatch
         telemetry (rebuild_ec_files fills it) for the admin endpoint /
         bench counters."""
+        import time as _time
+        from ..util import tracing
         for loc in self.locations:
             base = volume_file_prefix(loc.directory, collection, vid)
             if os.path.exists(base + ".ecx"):
-                rebuilt = ec_encoder.rebuild_ec_files(base, codec=self.codec,
-                                                      stats=stats)
-                from ..ec.decoder import read_ec_volume_superblock
-                rebuild_ecx_file(
-                    base, read_ec_volume_superblock(base).offset_width)
+                with tracing.span("ec.rebuild.local", volume=vid):
+                    rebuilt = ec_encoder.rebuild_ec_files(
+                        base, codec=self.codec, stats=stats)
+                    from ..ec.decoder import read_ec_volume_superblock
+                    t0 = _time.perf_counter()
+                    rebuild_ecx_file(
+                        base, read_ec_volume_superblock(base).offset_width)
+                    ecx_s = _time.perf_counter() - t0
+                    tracing.record_span("write", ecx_s, op="ec.rebuild.ecx")
+                    if stats is not None and "phases" in stats:
+                        stats["phases"]["write"] = round(
+                            stats["phases"].get("write", 0.0) + ecx_s, 6)
                 return rebuilt
         raise VolumeError(f"ec volume {vid} not found")
 

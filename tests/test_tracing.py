@@ -1,0 +1,268 @@
+"""Distributed tracing: span mechanics, traceparent propagation, the
+trace ring, per-phase EC spans, and the cluster-wide rebuild trace."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    tracing.RING.clear()
+    yield
+    tracing.RING.clear()
+
+
+class TestSpans:
+    def test_nesting_and_parent_links(self):
+        with tracing.span("root") as root:
+            assert tracing.current_span() is root
+            with tracing.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+                assert tracing.current_span() is child
+            assert tracing.current_span() is root
+        assert tracing.current_span() is None
+        assert root.duration_s is not None
+
+    def test_error_tagging(self):
+        with pytest.raises(ValueError):
+            with tracing.span("boom"):
+                raise ValueError("x")
+        spans = tracing.RING.recent(1)[0]["spans"]
+        assert spans[0]["tags"]["error"] == "ValueError"
+
+    def test_traceparent_roundtrip(self):
+        with tracing.span("root") as root:
+            header = tracing.outbound_traceparent()
+        trace_id, span_id = tracing.parse_traceparent(header)
+        assert trace_id == root.trace_id
+        assert span_id == root.span_id
+
+    def test_parse_rejects_garbage(self):
+        assert tracing.parse_traceparent(None) is None
+        assert tracing.parse_traceparent("") is None
+        assert tracing.parse_traceparent("00-short-span-01") is None
+        assert tracing.parse_traceparent(
+            "00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None  # zero id
+        assert tracing.parse_traceparent(
+            "00-" + "g" * 32 + "-" + "1" * 16 + "-01") is None  # non-hex
+
+    def test_remote_continuation(self):
+        header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        s = tracing.start_span("server", traceparent=header)
+        try:
+            assert s.trace_id == "ab" * 16
+            assert s.parent_id == "cd" * 8
+        finally:
+            tracing.finish_span(s)
+
+    def test_outbound_without_span_mints_fresh(self):
+        h1 = tracing.outbound_traceparent()
+        h2 = tracing.outbound_traceparent()
+        assert tracing.parse_traceparent(h1) is not None
+        assert h1 != h2
+
+    def test_record_span_links_to_current(self):
+        with tracing.span("op") as op:
+            d = tracing.record_span("gather", 0.25, source="peer1")
+        assert d["trace_id"] == op.trace_id
+        assert d["parent_id"] == op.span_id
+        assert d["duration_s"] == 0.25
+        assert d["tags"]["source"] == "peer1"
+
+    def test_finish_idempotent(self):
+        s = tracing.start_span("once")
+        tracing.finish_span(s)
+        first = s.duration_s
+        time.sleep(0.01)
+        tracing.finish_span(s)
+        assert s.duration_s == first
+        trace = tracing.RING.get(s.trace_id)
+        assert len(trace) == 1
+
+    def test_ring_bounds_traces(self):
+        ring = tracing.TraceRing(max_traces=3)
+        ids = []
+        for i in range(5):
+            d = tracing.record_span(f"s{i}", 0.001)
+            ring.add(d)
+            ids.append(d["trace_id"])
+        assert len(ring.recent(10)) == 3
+        assert ring.get(ids[0]) == []          # oldest evicted
+        assert ring.get(ids[-1])
+
+    def test_finish_hooks(self):
+        seen = []
+        tracing.add_finish_hook(seen.append)
+        try:
+            with tracing.span("hooked"):
+                pass
+        finally:
+            tracing.remove_finish_hook(seen.append)
+        assert [d["name"] for d in seen] == ["hooked"]
+
+
+class TestPhaseMetrics:
+    def test_phase_spans_feed_histograms(self):
+        from seaweedfs_tpu.stats.metrics import (VOLUME_EC_PHASE_COUNTER,
+                                                 VOLUME_EC_PHASE_HISTOGRAM)
+        before = VOLUME_EC_PHASE_COUNTER.value("gather")
+        tracing.record_span("gather", 0.125)
+        assert VOLUME_EC_PHASE_COUNTER.value("gather") == \
+            pytest.approx(before + 0.125)
+        text = "\n".join(VOLUME_EC_PHASE_HISTOGRAM.render())
+        assert 'phase="gather"' in text
+
+    def test_reconstruct_spans_feed_tuner(self):
+        from seaweedfs_tpu.stats.metrics import SmallDispatchTuner
+        t = SmallDispatchTuner()
+        # host: 100 MB/s flat; device: 5 ms fixed + 1000 MB/s
+        for w in (64e3, 128e3, 256e3, 512e3):
+            t.add("host", w, w / 100e6)
+            t.add("device", w, 5e-3 + w / 1000e6)
+        # crossover: 0.005 = x/1e8 - x/1e9 -> x ~ 555 KB
+        s = t.suggest()
+        assert s is not None
+        assert 300_000 < s < 1_000_000
+
+    def test_rebuild_records_phases(self, tmp_path):
+        import numpy as np
+
+        from seaweedfs_tpu.ec import encoder
+        from seaweedfs_tpu.ops.codec import get_codec
+
+        codec = get_codec(10, 4, backend="numpy")
+        base = str(tmp_path / "v1")
+        rng = np.random.default_rng(7)
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, 1 << 20).astype(
+                np.uint8).tobytes())
+        encoder.write_ec_files(base, codec=codec)
+        import os
+        os.remove(base + ".ec03")
+        os.remove(base + ".ec12")
+        with tracing.span("op") as op:
+            stats = {}
+            rebuilt = encoder.rebuild_ec_files(base, codec=codec,
+                                               stats=stats)
+        assert rebuilt == [3, 12]
+        phases = stats["phases"]
+        assert set(phases) == {"gather", "plan", "dispatch", "drain",
+                               "write"}
+        # consumer-side phases tile the stream wall
+        assert sum(phases.values()) >= 0.9 * stats["stream_s"]
+        names = {s["name"] for s in tracing.RING.get(op.trace_id)}
+        assert {"gather", "dispatch", "write"} <= names
+
+
+class TestClusterTrace:
+    def test_rebuild_produces_single_trace(self, tmp_path):
+        """A shell-initiated ec.rebuild yields ONE trace spanning the
+        master query, the rebuilder's handlers, the peer-volume shard
+        fetches, and the per-phase spans — visible at /admin/traces
+        and in the shell's {phase: seconds} timings."""
+        import io
+
+        import numpy as np
+
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.ec.constants import TOTAL_SHARDS
+        from seaweedfs_tpu.server.http_util import get_json, post_json
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.shell.command_env import CommandEnv, \
+            run_command
+        from seaweedfs_tpu.shell.command_ec import do_ec_rebuild
+
+        master = MasterServer(port=0, volume_size_limit_mb=64,
+                              pulse_seconds=1).start()
+        servers = [VolumeServer(
+            port=0, directories=[str(tmp_path / f"v{i}")],
+            master_url=master.url, pulse_seconds=1,
+            max_volume_counts=[20], ec_backend="numpy").start()
+            for i in range(3)]
+        try:
+            a = op.assign(master.url, collection="tr")
+            vid = int(a["fid"].split(",")[0])
+            rng = np.random.default_rng(3)
+            op.upload(a["url"], f"{vid},100000001",
+                      rng.integers(0, 256, 400_000).astype(
+                          np.uint8).tobytes(), filename="f1")
+            env = CommandEnv(master.url, out=io.StringIO())
+            run_command(env, f"ec.encode -volumeId {vid}")
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                              f"?volumeId={vid}")
+                if len(ec.get("shards", {})) == TOTAL_SHARDS:
+                    break
+                time.sleep(0.2)
+            shards = {int(s): u for s, u in ec["shards"].items()}
+            assert len(shards) == TOTAL_SHARDS
+            # destroy two shards on the largest holder
+            by_holder = {}
+            for sid, urls in shards.items():
+                by_holder.setdefault(urls[0], []).append(sid)
+            victim, held = max(by_holder.items(),
+                               key=lambda kv: len(kv[1]))
+            lost = sorted(held)[:2]
+            post_json(f"http://{victim}/admin/ec/unmount?volume={vid}"
+                      f"&shards={','.join(map(str, lost))}")
+            post_json(f"http://{victim}/admin/ec/delete_shards"
+                      f"?volume={vid}&collection=tr"
+                      f"&shards={','.join(map(str, lost))}")
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                              f"?volumeId={vid}")
+                shard_map = {int(s): u for s, u in
+                             ec.get("shards", {}).items()}
+                if not any(victim in shard_map.get(s, [])
+                           for s in lost):
+                    break
+                time.sleep(0.2)
+            missing = [s for s in range(TOTAL_SHARDS)
+                       if s not in shard_map]
+            assert missing
+            tracing.RING.clear()
+            timings = {}
+            do_ec_rebuild(env, vid, "tr", shard_map, missing,
+                          timings=timings)
+            tid = timings["trace_id"]
+            # one trace covers shell root -> master -> rebuilder ->
+            # peer fetches (everything is in-process, so each server's
+            # /admin/traces serves the same ring)
+            got = get_json(f"http://{servers[0].url}/admin/traces"
+                           f"?trace={tid}")
+            names = {s["name"] for s in got["spans"]}
+            assert "ec.rebuild" in names                  # shell root
+            assert "* /cluster/status" in names           # master
+            assert "POST /admin/ec/rebuild" in names      # rebuilder
+            assert "POST /admin/ec/copy" in names         # gather rpc
+            assert "GET /admin/file" in names             # peer fetch
+            assert {"gather", "dispatch", "write"} <= names
+            for s in got["spans"]:
+                assert s["trace_id"] == tid
+            # phase breakdown rode back through the rebuild response
+            phases = timings["phases"]
+            assert set(phases) == {"gather", "plan", "dispatch",
+                                   "drain", "write"}
+            assert sum(phases.values()) >= \
+                0.9 * timings["stream_s"]
+            # listed at /admin/traces (newest-first) too
+            listing = get_json(
+                f"http://{master.url}/admin/traces?n=50")
+            assert any(t["trace_id"] == tid
+                       for t in listing["traces"])
+            # and the status UI renders without blowing up
+            from seaweedfs_tpu.server.http_util import http_call
+            page = http_call(
+                "GET", f"http://{servers[0].url}/ui").decode()
+            assert "Recent traces" in page
+        finally:
+            for vs in servers:
+                vs.stop()
+            master.stop()

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Lint the metric registries against the Prometheus naming rules.
+
+Imports every per-role registry (stats/metrics.py), checks metric and
+label names against the upstream data-model rules, and renders each
+registry to confirm the exposition text parses line-by-line. Run by
+tier-1 tests (tests/test_stats.py) and usable standalone:
+
+    python tools/check_metrics.py
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# https://prometheus.io/docs/concepts/data_model/
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# exposition sample line: name{labels} value  (HELP/TYPE checked apart)
+SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' -?[0-9.eE+-]+(e[+-]?[0-9]+)?$|'
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \+?-?Inf$|'
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? NaN$')
+RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def check_registry(role: str, registry) -> list:
+    problems = []
+    seen = {}
+    for m in registry._metrics:
+        where = f"{role}:{m.name}"
+        if not METRIC_NAME_RE.match(m.name):
+            problems.append(f"{where}: invalid metric name")
+        if m.name.startswith("__"):
+            problems.append(f"{where}: reserved __ metric prefix")
+        if m.kind == "counter" and not m.name.endswith("_total"):
+            problems.append(f"{where}: counter must end in _total")
+        if m.kind == "histogram" and \
+                m.name.endswith(RESERVED_SUFFIXES):
+            problems.append(
+                f"{where}: histogram base name ends in a reserved "
+                f"series suffix")
+        prev = seen.get(m.name)
+        if prev is not None and prev != (m.kind, m.label_names):
+            problems.append(
+                f"{where}: duplicate registration with different "
+                f"kind/labels {prev} vs {(m.kind, m.label_names)}")
+        seen[m.name] = (m.kind, m.label_names)
+        for ln in m.label_names:
+            if not LABEL_NAME_RE.match(ln):
+                problems.append(f"{where}: invalid label name {ln!r}")
+            if ln.startswith("__"):
+                problems.append(f"{where}: reserved __ label {ln!r}")
+            if m.kind == "histogram" and ln == "le":
+                problems.append(
+                    f"{where}: 'le' is reserved for histogram buckets")
+    return problems
+
+
+def check_render(role: str, registry) -> list:
+    problems = []
+    for i, line in enumerate(registry.render().splitlines()):
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        if not SAMPLE_RE.match(line):
+            problems.append(
+                f"{role} render line {i + 1}: unparseable exposition "
+                f"text: {line!r}")
+    return problems
+
+
+def main() -> int:
+    from seaweedfs_tpu.stats import metrics
+
+    registries = {
+        "master": metrics.MASTER_GATHER,
+        "volume": metrics.VOLUME_SERVER_GATHER,
+        "filer": metrics.FILER_GATHER,
+    }
+    problems = []
+    for role, reg in registries.items():
+        problems += check_registry(role, reg)
+        problems += check_render(role, reg)
+    if problems:
+        for p in problems:
+            print(f"check_metrics: {p}", file=sys.stderr)
+        return 1
+    total = sum(len(r._metrics) for r in registries.values())
+    print(f"check_metrics: {total} metrics across "
+          f"{len(registries)} registries OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
