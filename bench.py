@@ -654,6 +654,17 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                "bitmat_uploads": timings.get("bitmat_uploads", 0),
                "rebuild_device_mbps": round(
                    survivor_bytes / stream_s / 1e6) if stream_s else 0,
+               # streaming-gather overlap accounting: gather_s/compute_s
+               # above are BUSY times in stream mode, so their sum
+               # estimates what the serialized copy-then-rebuild flow
+               # would have cost; overlap_frac = saved/serialized
+               "overlap_frac": round(
+                   timings.get("overlap_frac", 0.0), 3),
+               "gather_mbps": round(timings.get("gather_mbps", 0.0), 1),
+               "gather_busy_s": round(
+                   timings.get("gather_busy_s", 0.0), 2),
+               "serialized_estimate_s": round(gather_s + compute_s, 2),
+               "hedges_fired": timings.get("hedges_fired", 0),
                # per-phase {name: seconds} from the rebuilder's spans
                # (gather/plan/dispatch/drain/write) plus the trace id —
                # the full span timeline is at the rebuilder's

@@ -66,6 +66,27 @@ def mark_needle_deleted(f, record_pos: int, record_size: int = 16):
     f.flush()
 
 
+def ec_offset_width(base_name: str, default: int = 4) -> int:
+    """The volume's index offset width, preferring the .vif sidecar
+    over the .ec00 superblock. The streaming rebuilder often has NO
+    local .ec00 (it pulls survivor ranges, not whole shards), so the
+    .vif — which fetch_index_files copies over — must win."""
+    vif = base_name + ".vif"
+    if os.path.exists(vif):
+        try:
+            with open(vif) as f:
+                width = json.load(f).get("offset_width")
+            if width:
+                return int(width)
+        except (ValueError, OSError):
+            pass
+    try:
+        from .decoder import read_ec_volume_superblock
+        return read_ec_volume_superblock(base_name).offset_width
+    except Exception:  # noqa: BLE001 - no .ec00 either
+        return default
+
+
 def rebuild_ecx_file(base_name: str, offset_width: int = 4):
     """Replay .ecj tombstones into .ecx, then remove the journal."""
     ecj = base_name + ".ecj"

@@ -319,6 +319,125 @@ def rebuild_ec_files(base_name: str,
     return missing
 
 
+def rebuild_ec_files_streaming(base_name: str,
+                               present: List[bool],
+                               missing: List[int],
+                               source,
+                               codec: Optional[ReedSolomonCodec] = None,
+                               slab: int = DEFAULT_SLAB,
+                               pipelined: Optional[bool] = None,
+                               stats: Optional[dict] = None) -> List[int]:
+    """Streaming variant of rebuild_ec_files: the survivor bytes arrive
+    from ``source`` (an ec.gather.StripedGatherSource — local files and
+    remote holders mixed) instead of whole shard files on local disk,
+    and each rebuilt slab is appended to the missing shard files as the
+    decode drains. Rebuild wall approaches max(gather, compute) and the
+    rebuilder never materializes a survivor copy.
+
+    ``present``/``missing`` describe the cluster-wide shard state (the
+    decode plan), not local files. On ANY failure the partially written
+    missing-shard files are removed — callers either get complete
+    rebuilt shards or nothing."""
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    k, total = codec.k, codec.total
+    if pipelined is None:
+        pipelined = codec.backend in ("tpu", "mesh")
+    if not missing:
+        return []
+    if sum(present) < k:
+        raise ValueError(
+            f"cannot rebuild: only {sum(present)} of {total} shards")
+    from ..ops import telemetry
+    before = telemetry.STATS.snapshot()
+    phases = {"gather": 0.0, "plan": 0.0, "dispatch": 0.0,
+              "drain": 0.0, "write": 0.0}
+    t0 = time.perf_counter()
+    coeffs = _rebuild_coeffs(codec, present, missing)
+    phases["plan"] = time.perf_counter() - t0
+    outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
+    rebuilt_bytes = 0
+    t_stream = time.perf_counter()
+    try:
+        if pipelined:
+            from ..ops.pipeline import PipelinedMatmul
+            ptimer = StageTimer()
+            pm = PipelinedMatmul(coeffs, max_width=slab, codec=codec,
+                                 timer=ptimer)
+            for _, _, out in pm.stream(source.slabs()):
+                t0 = time.perf_counter()
+                for r, i in enumerate(missing):
+                    outs[i].write(out[r].tobytes())
+                    rebuilt_bytes += out[r].nbytes
+                phases["write"] += time.perf_counter() - t0
+            # consumer-side accounting, same discipline as
+            # rebuild_ec_files: read_wait is the time this thread spent
+            # blocked on stripes still in flight — the UNOVERLAPPED
+            # remainder of the gather, not its busy time
+            phases["gather"] = ptimer.totals.get("read_wait", 0.0)
+            phases["dispatch"] = ptimer.totals.get("h2d", 0.0)
+            phases["drain"] = ptimer.totals.get("drain_wait", 0.0)
+        else:
+            it = source.slabs()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    _, data = next(it)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
+                out = codec._matmul(coeffs, data)
+                t2 = time.perf_counter()
+                for r, i in enumerate(missing):
+                    outs[i].write(np.asarray(out[r],
+                                             dtype=np.uint8).tobytes())
+                    rebuilt_bytes += data.shape[1]
+                t3 = time.perf_counter()
+                phases["gather"] += t1 - t0
+                phases["dispatch"] += t2 - t1
+                phases["write"] += t3 - t2
+    except BaseException:
+        for i, h in outs.items():
+            h.close()
+            try:
+                os.remove(base_name + to_ext(i))
+            except OSError:
+                pass
+        raise
+    finally:
+        for h in outs.values():
+            h.close()
+    stream_s = time.perf_counter() - t_stream
+    residual = stream_s - (sum(phases.values()) - phases["plan"])
+    if residual > 0:
+        phases["dispatch"] += residual
+    for name, secs in phases.items():
+        if secs > 0:
+            tracing.record_span(name, secs, op="ec.rebuild",
+                                backend=codec.backend, streaming=True)
+    if stats is not None:
+        gs = source.stats
+        stats.update(telemetry.delta(before))
+        stats.update(gs.snapshot())
+        stats["survivor_bytes"] = source.shard_size * k
+        stats["rebuilt_bytes"] = rebuilt_bytes
+        stats["stream_s"] = round(stream_s, 3)
+        stats["backend"] = codec.backend
+        stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
+        gather_busy = gs.busy_s()
+        compute_busy = max(stream_s - phases["gather"], 0.0)
+        serialized = gather_busy + compute_busy
+        overlap = 0.0
+        if serialized > 0:
+            overlap = max(0.0, min(1.0,
+                                   (serialized - stream_s) / serialized))
+        stats["gather_busy_s"] = round(gather_busy, 3)
+        stats["compute_busy_s"] = round(compute_busy, 3)
+        stats["overlap_frac"] = round(overlap, 4)
+        stats["gather_mbps"] = round(gs.mbps(), 1)
+        stats["gather_remote_shards"] = gs.remote_shards
+    return list(missing)
+
+
 def _rebuild_coeffs(codec: ReedSolomonCodec, present: List[bool],
                     missing: List[int]) -> np.ndarray:
     """(len(missing), k) GF coefficients so that

@@ -1,0 +1,422 @@
+"""Streaming striped survivor gather for EC rebuild.
+
+The copy-then-rebuild flow pulls every surviving shard whole onto the
+rebuilder before the first GF byte is computed — rebuild wall is
+gather + compute and the rebuilder briefly stores a full extra copy of
+the volume. This module replaces the gather side: a slab-granular
+source that fetches slab-aligned byte ranges of each survivor straight
+from its holders (the existing ranged ``/admin/ec/shard_read``
+endpoint, over ``http_util``'s keep-alive pool) and hands each arriving
+stripe to the pipelined decode while the next stripes are still in
+flight.
+
+Shape of the stream: a *stripe* is one slab-aligned range
+``[off, off+w)`` of every chosen survivor — a ``(k, w)`` uint8 block,
+exactly what ``ops/pipeline.PipelinedMatmul`` consumes. Stripes are
+fetched with a bounded in-flight window (``SW_EC_GATHER_WINDOW``), so
+gather memory is O(window · k · slab), never O(volume), and yielded
+strictly in stripe order so the decoded slabs append to the rebuilt
+shard files in place.
+
+Straggler defenses:
+  * round-robin: when a shard has several replicas, stripe ``s`` leads
+    with holder ``s % len(holders)`` — consecutive stripes split across
+    the replicas instead of hammering one.
+  * retry: a failed range read fails over to the shard's remaining
+    holders in rotation order.
+  * hedging (``SW_EC_HEDGE_MS``, default off): if the leading holder
+    has not answered within the deadline, the same range is requested
+    from the next holder and the first response wins. The loser is NOT
+    cancelled — ``http_call`` reads its response to completion, so the
+    socket drains and parks back in the pool instead of leaking
+    mid-body.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
+                                TimeoutError as _FutureTimeout, wait)
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..util import tracing
+from ..util.profiling import StageTimer
+
+DEFAULT_WINDOW = 4
+GATHER_WINDOW_ENV = "SW_EC_GATHER_WINDOW"
+HEDGE_MS_ENV = "SW_EC_HEDGE_MS"
+
+_CONTENT_RANGE_RE = re.compile(r"bytes\s+(\d+)-(\d+)/(\d+)")
+
+
+def auto_slab(shard_size: int, default: int = 8 << 20,
+              min_slab: int = 1 << 20, target_stripes: int = 4) -> int:
+    """Slab size for a rebuild when the caller didn't pick one. The
+    default 8 MB slab is right for volume-scale shards, but a shard
+    smaller than ~one slab degenerates to a single stripe — nothing for
+    the gather to overlap with the decode. Shrink the slab (never below
+    ``min_slab``) so the stream has at least ``target_stripes`` stripes;
+    truly tiny shards keep the default (one stripe — pipelining dust
+    costs more than it saves)."""
+    if shard_size <= 2 * min_slab:
+        return default
+    per = -(-shard_size // target_stripes)
+    return max(min_slab, min(default, per))
+
+
+def gather_window() -> int:
+    try:
+        return max(1, int(os.environ.get(GATHER_WINDOW_ENV,
+                                         str(DEFAULT_WINDOW))))
+    except ValueError:
+        return DEFAULT_WINDOW
+
+
+def default_hedge_ms() -> float:
+    try:
+        return float(os.environ.get(HEDGE_MS_ENV, "0"))
+    except ValueError:
+        return 0.0
+
+
+# hedged duplicates run here rather than in the gather pool: a stripe
+# worker submitting back into its own (possibly saturated) pool could
+# deadlock the window
+_HEDGE_POOL: Optional[ThreadPoolExecutor] = None
+_HEDGE_LOCK = threading.Lock()
+
+
+def _hedge_pool() -> ThreadPoolExecutor:
+    global _HEDGE_POOL
+    with _HEDGE_LOCK:
+        if _HEDGE_POOL is None:
+            _HEDGE_POOL = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="ec-gather-hedge")
+        return _HEDGE_POOL
+
+
+class GatherStats:
+    """Counters + busy-time accounting shared by every reader of one
+    gather. Busy time is the UNION of fetch intervals (fetches overlap
+    across stripes/rows), so ``bytes / busy_s`` is the effective gather
+    bandwidth, comparable to what a serialized copy phase would need."""
+
+    def __init__(self):
+        self.timer = StageTimer()
+        self._lock = threading.Lock()
+        self.fetches = 0
+        self.bytes = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.retries = 0
+        self.stripes = 0
+        self.peak_buffered = 0
+        self.remote_shards = 0
+        self.local_shards = 0
+
+    def add_fetch(self, nbytes: int, t0: float, t1: float):
+        self.timer.add("gather", t1 - t0, nbytes, interval=(t0, t1))
+        with self._lock:
+            self.fetches += 1
+            self.bytes += nbytes
+
+    def add_hedge_fired(self):
+        with self._lock:
+            self.hedges_fired += 1
+
+    def add_hedge_won(self):
+        with self._lock:
+            self.hedges_won += 1
+
+    def add_retry(self):
+        with self._lock:
+            self.retries += 1
+
+    def busy_s(self) -> float:
+        return self.timer.busy_time("gather")
+
+    def mbps(self) -> float:
+        busy = self.busy_s()
+        if busy <= 0:
+            return 0.0
+        return self.bytes / busy / 1e6
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "gather_bytes": self.bytes,
+                "gather_fetches": self.fetches,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "gather_retries": self.retries,
+                "gather_stripes": self.stripes,
+                "peak_gather_buffer": self.peak_buffered,
+            }
+
+
+class LocalShardReader:
+    """Range reads of a survivor shard already on the rebuilder's disk.
+    Opens per call — the gather pool reads several stripes of one shard
+    concurrently, and a shared seek pointer would race."""
+
+    remote = False
+
+    def __init__(self, path: str, stats: Optional[GatherStats] = None):
+        self.path = path
+        self.stats = stats or GatherStats()
+
+    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
+        t0 = time.perf_counter()
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            data = f.read(n)
+        if len(data) != n:
+            raise IOError(f"short read of {self.path} at {off}: "
+                          f"{len(data)} < {n}")
+        self.stats.add_fetch(n, t0, time.perf_counter())
+        return data
+
+
+class RemoteShardReader:
+    """Ranged reads of one survivor shard from its holder set, with
+    round-robin striping, failover retries and optional hedging."""
+
+    remote = True
+
+    def __init__(self, vid: int, sid: int, holders: Sequence[str],
+                 stats: Optional[GatherStats] = None,
+                 timeout: float = 300.0,
+                 hedge_ms: Optional[float] = None):
+        if not holders:
+            raise ValueError(f"shard {vid}.{sid}: no holders")
+        self.vid = vid
+        self.sid = sid
+        self.holders = list(holders)
+        self.stats = stats or GatherStats()
+        self.span = None     # set by StripedGatherSource: trace parent
+        self.timeout = timeout
+        self.hedge_s = (default_hedge_ms() if hedge_ms is None
+                        else float(hedge_ms)) / 1000.0
+
+    def _url(self, holder: str, off: int, n: int) -> str:
+        return (f"http://{holder}/admin/ec/shard_read?volume={self.vid}"
+                f"&shard={self.sid}&offset={off}&size={n}")
+
+    def _read_one(self, holder: str, off: int, n: int) -> bytes:
+        from ..server.http_util import HttpError, http_call
+        # pool/hedge worker threads don't inherit the tracing
+        # contextvar — carry the rebuild span's traceparent explicitly
+        # so the holders' shard_read spans join the rebuild trace
+        hdrs = None
+        if self.span is not None:
+            hdrs = {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
+        t0 = time.perf_counter()
+        data = http_call("GET", self._url(holder, off, n),
+                         headers=hdrs, timeout=self.timeout)
+        if len(data) != n:
+            raise HttpError(
+                502, f"short shard read {self.vid}.{self.sid} from "
+                     f"{holder} at {off}: {len(data)} < {n}")
+        self.stats.add_fetch(len(data), t0, time.perf_counter())
+        return data
+
+    def _read_failover(self, order: Sequence[str], off: int,
+                       n: int) -> bytes:
+        last = None
+        for i, holder in enumerate(order):
+            if i:
+                self.stats.add_retry()
+            try:
+                return self._read_one(holder, off, n)
+            except Exception as e:  # noqa: BLE001 - try the next holder
+                last = e
+        raise last
+
+    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
+        h = self.holders
+        # rotation both spreads load (consecutive stripes of a
+        # replicated shard split across its holders) and fixes the
+        # failover/hedge order for this stripe
+        order = [h[(stripe_idx + j) % len(h)] for j in range(len(h))]
+        if self.hedge_s <= 0 or len(order) < 2:
+            return self._read_failover(order, off, n)
+        ex = _hedge_pool()
+        primary = ex.submit(self._read_one, order[0], off, n)
+        try:
+            return primary.result(timeout=self.hedge_s)
+        except _FutureTimeout:
+            pass
+        except Exception:  # noqa: BLE001 - fast failure: plain failover
+            self.stats.add_retry()
+            return self._read_failover(order[1:], off, n)
+        # leading holder is past the hedge deadline: race a duplicate on
+        # the next holder; first success wins, the loser drains its
+        # response body in the pool thread and its socket goes back to
+        # the connection pool
+        self.stats.add_hedge_fired()
+        secondary = ex.submit(self._read_one, order[1], off, n)
+        pending = {primary, secondary}
+        last = None
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                err = f.exception()
+                if err is None:
+                    if f is secondary:
+                        self.stats.add_hedge_won()
+                    return f.result()
+                last = err
+        if len(order) > 2:
+            self.stats.add_retry()
+            return self._read_failover(order[2:], off, n)
+        raise last
+
+
+def probe_shard_size(vid: int, sid: int, holders: Sequence[str],
+                     timeout: float = 30.0) -> int:
+    """Total shard size via a one-byte suffix-range read: the 206's
+    ``Content-Range: bytes a-b/total`` carries the full size without
+    transferring the shard (and exercises the ``bytes=-N`` path)."""
+    from ..server.http_util import HttpError, http_get_with_headers
+    last = None
+    for holder in holders:
+        try:
+            _, hdrs = http_get_with_headers(
+                f"http://{holder}/admin/ec/shard_read?volume={vid}"
+                f"&shard={sid}",
+                timeout=timeout, headers={"Range": "bytes=-1"})
+        except HttpError as e:
+            last = e
+            continue
+        cr = next((v for k, v in hdrs.items()
+                   if k.lower() == "content-range"), "")
+        m = _CONTENT_RANGE_RE.match(cr or "")
+        if m:
+            return int(m.group(3))
+        last = HttpError(
+            502, f"no Content-Range from {holder} for {vid}.{sid}")
+    if last is not None:
+        raise last
+    raise ValueError(f"shard {vid}.{sid}: no holders to probe")
+
+
+def fetch_index_files(base_name: str, holders: Sequence[str],
+                      timeout: float = 300.0) -> List[str]:
+    """Pull the small index sidecars onto the rebuilder: .ecx required
+    (the rebuilt .ecx tombstone replay and the mount need it), .vif and
+    .ecj best-effort. These are KB-sized — the only whole files the
+    streaming rebuild copies."""
+    from ..server.http_util import HttpError, http_call
+    name = os.path.basename(base_name)
+    fetched: List[str] = []
+    for ext, required in ((".ecx", True), (".vif", False), (".ecj", False)):
+        if os.path.exists(base_name + ext):
+            continue
+        last = None
+        data = None
+        for holder in holders:
+            try:
+                data = http_call(
+                    "GET",
+                    f"http://{holder}/admin/file?name={name}{ext}",
+                    timeout=timeout)
+                break
+            except HttpError as e:
+                last = e
+                data = None
+        if data is None:
+            if required:
+                raise last if last is not None else HttpError(
+                    404, f"{name}{ext}: no holder serves it")
+            continue
+        with open(base_name + ext, "wb") as f:
+            f.write(data)
+        fetched.append(ext)
+    return fetched
+
+
+class StripedGatherSource:
+    """The survivor stream: ``slabs()`` yields ``(meta, (k, w) uint8)``
+    stripes in order, fetching up to ``window`` stripes ahead across a
+    shared thread pool. ``readers`` are the first-k survivors in decode
+    plan order — local files and remote holders mixed freely."""
+
+    def __init__(self, readers: Sequence, shard_size: int,
+                 slab: int = 8 << 20, window: Optional[int] = None,
+                 stats: Optional[GatherStats] = None,
+                 parent_span=None):
+        if not readers:
+            raise ValueError("no survivor readers")
+        self.readers = list(readers)
+        self.shard_size = int(shard_size)
+        self.slab = max(1, int(slab))
+        self.window = max(1, int(window) if window else gather_window())
+        self.stats = stats or GatherStats()
+        self.parent_span = parent_span
+        for r in self.readers:
+            r.stats = self.stats
+            r.span = parent_span
+        self.stats.remote_shards = sum(
+            1 for r in self.readers if getattr(r, "remote", False))
+        self.stats.local_shards = len(self.readers) - \
+            self.stats.remote_shards
+        self._buffered = 0
+        self._lock = threading.Lock()
+
+    def _note_buffered(self, delta: int):
+        with self._lock:
+            self._buffered += delta
+            if self._buffered > self.stats.peak_buffered:
+                self.stats.peak_buffered = self._buffered
+
+    def slabs(self):
+        k = len(self.readers)
+        stripes: List[Tuple[int, int]] = [
+            (off, min(self.slab, self.shard_size - off))
+            for off in range(0, self.shard_size, self.slab)]
+        self.stats.stripes = len(stripes)
+        if not stripes:
+            return
+        workers = min(16, max(2, min(self.window, len(stripes)) * k))
+        pool = ThreadPoolExecutor(max_workers=workers,
+                                  thread_name_prefix="ec-gather")
+        pending: deque = deque()
+
+        def submit(idx: int):
+            off, w = stripes[idx]
+            # account BEFORE the fetches start: in-flight rows are
+            # buffered memory too, and the bound must hold even when
+            # every submitted row completes before the consumer drains
+            self._note_buffered(k * w)
+            t_sub = time.perf_counter()
+            futs = [pool.submit(self.readers[r].read, off, w, idx)
+                    for r in range(k)]
+            pending.append((idx, off, w, t_sub, futs))
+
+        try:
+            nxt = 0
+            while nxt < len(stripes) and len(pending) < self.window:
+                submit(nxt)
+                nxt += 1
+            while pending:
+                idx, off, w, t_sub, futs = pending.popleft()
+                rows = [np.frombuffer(f.result(), dtype=np.uint8)
+                        for f in futs]
+                data = np.stack(rows, axis=0)
+                tracing.record_span(
+                    "gather.stripe", time.perf_counter() - t_sub,
+                    parent=self.parent_span, op="ec.rebuild.gather",
+                    stripe=idx, offset=off, bytes=k * w)
+                self._note_buffered(-(k * w))
+                if nxt < len(stripes):
+                    submit(nxt)
+                    nxt += 1
+                yield (idx, off, w), data
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)

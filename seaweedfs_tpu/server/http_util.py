@@ -12,6 +12,7 @@ import os
 import re
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -561,11 +562,36 @@ def parse_range(rng: str, size: int) -> Optional[Tuple[int, int]]:
 
 import http.client as _httpc
 
+# pool entries are (conn, parked_at) — the park time drives idle-age
+# eviction: a peer's keep-alive timeout (or an LB's) closes connections
+# we would otherwise only discover stale at reuse, and long-lived shells
+# would pin sockets to servers they talked to once
 _POOL: Dict[Tuple[str, str], List] = {}
 _POOL_LOCK = threading.Lock()
 _POOL_MAX_PER_HOST = 32
+_POOL_MAX_IDLE_ENV = "SW_HTTP_POOL_MAX_IDLE_S"
+# churn counters, mirrored into /metrics (http_pool_churn_total{event=})
+POOL_STATS = {"created": 0, "reused": 0, "evicted_stale": 0,
+              "evicted_idle": 0, "evicted_overflow": 0}
 _RETRIABLE_STALE = (_httpc.RemoteDisconnected, _httpc.BadStatusLine,
                     ConnectionResetError, BrokenPipeError)
+
+
+def _pool_max_idle_s() -> float:
+    try:
+        return float(os.environ.get(_POOL_MAX_IDLE_ENV, "60"))
+    except ValueError:
+        return 60.0
+
+
+def _pool_count(event: str, n: int = 1):
+    with _POOL_LOCK:
+        POOL_STATS[event] += n
+
+
+def pool_stats_snapshot() -> Dict[str, int]:
+    with _POOL_LOCK:
+        return dict(POOL_STATS)
 
 
 def _new_conn(scheme: str, netloc: str, timeout: float):
@@ -588,35 +614,64 @@ def _sock_is_stale(sock) -> bool:
 
 
 def _pool_get(scheme: str, netloc: str, timeout: float):
-    """-> (conn, reused). New connections get TCP_NODELAY on connect."""
+    """-> (conn, reused). New connections get TCP_NODELAY on connect.
+    Pops newest-first (LIFO keeps hot sockets hot) and evicts entries
+    past the idle-age cap or failing the readable-peek stale check."""
+    max_idle = _pool_max_idle_s()
     while True:
         with _POOL_LOCK:
             stack = _POOL.get((scheme, netloc))
-            conn = stack.pop() if stack else None
-        if conn is None:
+            entry = stack.pop() if stack else None
+        if entry is None:
+            _pool_count("created")
             return _new_conn(scheme, netloc, timeout), False
+        conn, parked_at = entry
+        if max_idle > 0 and time.monotonic() - parked_at > max_idle:
+            conn.close()
+            _pool_count("evicted_idle")
+            continue
         if conn.sock is not None and _sock_is_stale(conn.sock):
             conn.close()
+            _pool_count("evicted_stale")
             continue
         if conn.sock is not None:
             conn.sock.settimeout(timeout)
+        _pool_count("reused")
         return conn, True
 
 
 def _pool_put(scheme: str, netloc: str, conn):
+    """Park a connection. Also sweeps aged-out entries from the bottom
+    of the stack — LIFO reuse means the oldest entries are never popped
+    under steady load, so without the sweep they'd pin sockets
+    forever."""
+    now = time.monotonic()
+    max_idle = _pool_max_idle_s()
+    aged = []
+    overflow = None
     with _POOL_LOCK:
         stack = _POOL.setdefault((scheme, netloc), [])
+        if max_idle > 0:
+            while stack and now - stack[0][1] > max_idle:
+                aged.append(stack.pop(0)[0])
         if len(stack) < _POOL_MAX_PER_HOST:
-            stack.append(conn)
-            return
-    conn.close()
+            stack.append((conn, now))
+        else:
+            overflow = conn
+        POOL_STATS["evicted_idle"] += len(aged)
+        if overflow is not None:
+            POOL_STATS["evicted_overflow"] += 1
+    for c in aged:
+        c.close()
+    if overflow is not None:
+        overflow.close()
 
 
 def clear_conn_pool():
     """Drop every pooled connection (tests; TLS reconfiguration)."""
     with _POOL_LOCK:
         for stack in _POOL.values():
-            for conn in stack:
+            for conn, _ in stack:
                 conn.close()
         _POOL.clear()
 
@@ -705,13 +760,14 @@ def _pooled_call(method: str, url: str, body, headers: dict,
     raise HttpError(503, f"{method} {url}: retries exhausted")
 
 
-def http_get_with_headers(url: str, timeout: float = 30.0):
+def http_get_with_headers(url: str, timeout: float = 30.0,
+                          headers: Optional[dict] = None):
     """Cluster GET returning (body, response headers) — for callers
     that need metadata the body doesn't carry (stored filename in
-    Content-Disposition, etags)."""
+    Content-Disposition, etags, Content-Range on ranged reads)."""
     url = _client_url(url)
     try:
-        return _pooled_call("GET", url, None, {}, timeout,
+        return _pooled_call("GET", url, None, headers or {}, timeout,
                             want_headers=True)
     except HttpError:
         raise

@@ -523,9 +523,14 @@ class VolumeServer:
         # mirrors onto the scrape so dispatches / bitmat uploads / host
         # fallbacks are visible without running a rebuild through bench
         from ..ops import telemetry
-        from ..stats.metrics import DEVICE_TELEMETRY_COUNTER
+        from ..stats.metrics import (DEVICE_TELEMETRY_COUNTER,
+                                     HTTP_POOL_CHURN_COUNTER)
         for kind, total in telemetry.STATS.snapshot().items():
             DEVICE_TELEMETRY_COUNTER.set_total(total, kind)
+        # connection-pool churn (process-global, same mirror pattern)
+        from .http_util import pool_stats_snapshot
+        for event, total in pool_stats_snapshot().items():
+            HTTP_POOL_CHURN_COUNTER.set_total(total, event)
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -686,11 +691,31 @@ class VolumeServer:
         return {"volume": vid, "unmounted": out}
 
     def admin_ec_rebuild(self, req: Request):
+        """Local rebuild from whole shard files (legacy, query-only), or
+        — when the POST body carries ``sources`` ({shard: [holders]}) —
+        the streaming striped gather: survivor ranges are pulled and
+        decoded in overlapped slabs, never landing whole on disk."""
+        from ..stats.metrics import observe_gather
         from ..util import tracing
         vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        try:
+            body = req.json()
+        except ValueError:
+            raise HttpError(400, "bad JSON body") from None
         stats: dict = {}
-        rebuilt = self.store.rebuild_ec_shards(
-            vid, req.query.get("collection", ""), stats=stats)
+        if isinstance(body, dict) and body.get("sources"):
+            hedge_ms = body.get("hedge_ms")
+            rebuilt = self.store.rebuild_ec_shards_streaming(
+                vid, collection, sources=body["sources"], stats=stats,
+                slab=int(body.get("slab") or 0) or None,
+                window=int(body.get("window") or 0) or None,
+                hedge_ms=float(hedge_ms) if hedge_ms is not None
+                else None)
+            observe_gather(stats)
+        else:
+            rebuilt = self.store.rebuild_ec_shards(
+                vid, collection, stats=stats)
         return {"volume": vid, "rebuilt": rebuilt, "stats": stats,
                 "trace_id": tracing.current_trace_id()}
 
@@ -855,14 +880,35 @@ class VolumeServer:
         return {"volume": vid, "dat_size": dat_size}
 
     def admin_ec_shard_read(self, req: Request):
+        """Ranged shard reads for the streaming gather. Two addressing
+        forms: ``offset``/``size`` query params (legacy), or a standard
+        ``Range: bytes=a-b`` / ``bytes=-N`` header — the header form
+        answers 206 with ``Content-Range`` (whose ``/total`` lets the
+        rebuilder size a shard via a 1-byte suffix probe)."""
+        from .http_util import parse_range
         vid = int(req.query["volume"])
         sid = int(req.query["shard"])
-        offset = int(req.query.get("offset", 0))
-        size = int(req.query.get("size", 0))
         ev = self.store.find_ec_volume(vid)
         if ev is None or sid not in ev.shards:
             raise HttpError(404, f"shard {vid}.{sid} not here")
-        return Response(ev.shards[sid].read_at(offset, size))
+        shard = ev.shards[sid]
+        total = shard.size
+        rng = parse_range(req.headers.get("Range", ""), total)
+        if rng is None:
+            offset = int(req.query.get("offset", 0))
+            size = int(req.query.get("size", 0))
+            return Response(shard.read_at(offset, size),
+                            headers={"Accept-Ranges": "bytes"})
+        offset, length = rng
+        if length == 0:
+            return Response(b"", headers={"Accept-Ranges": "bytes"})
+        return Response(
+            shard.read_at(offset, length), status=206,
+            headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range":
+                    f"bytes {offset}-{offset + length - 1}/{total}",
+            })
 
     def admin_tier_upload(self, req: Request):
         """Ship a readonly volume's .dat to a configured backend

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..ec.constants import DATA_SHARDS, TOTAL_SHARDS
+from ..server.http_util import HttpError
 from .command_env import CommandEnv, command, parse_flags
 
 
@@ -147,7 +148,10 @@ def do_ec_encode(env: CommandEnv, vid: int):
     env.write(f"volume {vid}: ec encoded, original removed")
 
 
-@command("ec.rebuild", "[-collection <name>] : regenerate missing shards")
+@command("ec.rebuild",
+         "[-collection <name>] [-mode stream|copy] : regenerate missing "
+         "shards (stream = ranged survivor gather overlapped with the "
+         "decode; copy = legacy whole-shard copies)")
 def ec_rebuild(env: CommandEnv, args: List[str]):
     flags = parse_flags(args)
     for vid_s, info in env.ec_volumes().items():
@@ -163,101 +167,178 @@ def ec_rebuild(env: CommandEnv, args: List[str]):
             env.write(f"volume {vid}: only {len(shards)} shards left, "
                       f"cannot rebuild")
             continue
-        do_ec_rebuild(env, vid, collection, shards, missing)
+        do_ec_rebuild(env, vid, collection, shards, missing,
+                      mode=flags.get("mode"))
+
+
+def _merge_rebuild_stats(timings: Dict, out: dict):
+    """Fold the rebuilder's stats dict into the shell timings: numbers
+    sum across volumes, the per-phase breakdown merges per key."""
+    for key, val in (out.get("stats") or {}).items():
+        if key == "phases" and isinstance(val, dict):
+            agg = timings.setdefault("phases", {})
+            for ph, secs in val.items():
+                agg[ph] = round(agg.get(ph, 0.0) + secs, 6)
+        elif isinstance(val, (int, float)):
+            timings[key] = timings.get(key, 0) + val
+        else:
+            timings[key] = val
 
 
 def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
                   shards: Dict[int, List[str]], missing: List[int],
-                  timings: Dict[str, float] = None):
-    """`timings`, when given, records the phase walls (gather = parallel
-    survivor pulls, compute = the GF rebuild on the rebuilder, mount) —
-    the benchmark's overlap accounting for BASELINE config 5."""
-    import time as _time
+                  timings: Dict[str, float] = None, mode: str = None):
+    """`timings`, when given, records the phase walls plus the
+    rebuilder's stats (gather/compute busy time, overlap_frac, dispatch
+    telemetry) — the benchmark's overlap accounting.
+
+    mode: "stream" (default; `SW_EC_GATHER_MODE` overrides) pushes the
+    survivor holder map to the rebuilder, which pulls slab ranges and
+    decodes them overlapped — no whole-shard temp copies, no trailing
+    delete_shards pass. "copy" is the legacy copy-then-rebuild flow;
+    stream mode also falls back to it if the rebuilder predates the
+    streaming endpoint."""
+    import os as _os
     from ..util import tracing
-    from ..util.fanout import fan_out_must_succeed
-    # shell-side trace root: every call below — the master free-slot
-    # query, survivor pulls, rebuild, mount — carries its traceparent,
-    # so the whole operation lands in ONE trace
-    root = tracing.start_span("ec.rebuild", volume=vid)
+    mode = (mode or _os.environ.get("SW_EC_GATHER_MODE") or
+            "stream").lower()
+    # shell-side trace root: every call below — survivor gathering, the
+    # rebuild, mount — carries its traceparent: ONE trace per operation
+    root = tracing.start_span("ec.rebuild", volume=vid, mode=mode)
     try:
         # pick the node with most free slots as rebuilder (reference
         # command_ec_rebuild.go: pick by free slot count)
         rebuilder = _free_nodes(env)[0]["url"]
-        local = {s for s, urls in shards.items() if rebuilder in urls}
-        # copy surviving shards the rebuilder lacks — pulls from
-        # distinct sources run concurrently (reference
-        # prepareDataToRecover + goroutine fan-out); the .ecx rides
-        # along with exactly one copy
-        to_copy = [(sid, urls[0]) for sid, urls in shards.items()
-                   if sid not in local]
-        copied = [sid for sid, _ in to_copy]
-
-        def pull(job):
-            (sid, src), with_ecx = job
-            # fan-out worker threads don't inherit the contextvar —
-            # parent each per-source gather span on the root explicitly
-            with tracing.span("gather", parent=root, shard=sid,
-                              source=src):
-                env.node_post(
-                    rebuilder,
-                    f"/admin/ec/copy?volume={vid}&collection={collection}"
-                    f"&source={src}&shards={sid}"
-                    f"&copy_ecx={'true' if with_ecx else 'false'}")
-
-        jobs = [(item, (not local) and i == 0)
-                for i, item in enumerate(to_copy)]
-        t0 = _time.perf_counter()
-        fan_out_must_succeed(pull, jobs,
-                             what=f"survivor shard copy for volume {vid}",
-                             dedicated=True)
-        t1 = _time.perf_counter()
-        # rebuild + mount only the previously-missing shards
-        out = env.node_post(rebuilder,
-                            f"/admin/ec/rebuild?volume={vid}"
-                            f"&collection={collection}")
-        t2 = _time.perf_counter()
+        if mode == "copy":
+            rebuilt = _rebuild_via_copy(env, vid, collection, shards,
+                                        rebuilder, root, timings)
+        else:
+            try:
+                rebuilt = _rebuild_streaming(env, vid, collection,
+                                             shards, rebuilder, root,
+                                             timings)
+            except HttpError as e:
+                env.write(f"volume {vid}: streaming rebuild failed "
+                          f"({e.status}); falling back to copy mode")
+                root.tags["fallback"] = "copy"
+                rebuilt = _rebuild_via_copy(env, vid, collection,
+                                            shards, rebuilder, root,
+                                            timings)
         if timings is not None:
-            timings["gather_s"] = timings.get("gather_s", 0) + (t1 - t0)
-            timings["compute_s"] = timings.get("compute_s", 0) + (t2 - t1)
-            timings["gathered_shards"] = \
-                timings.get("gathered_shards", 0) + len(to_copy)
             timings["trace_id"] = root.trace_id
-            # dispatch telemetry from the rebuilder (rebuild_ec_files):
-            # bench counters proving one dispatch per slab and one bitmat
-            # upload per rebuild
-            for key, val in (out.get("stats") or {}).items():
-                if key == "phases" and isinstance(val, dict):
-                    # per-phase {name: seconds} breakdown — sum across
-                    # volumes like the numeric timings
-                    agg = timings.setdefault("phases", {})
-                    for ph, secs in val.items():
-                        agg[ph] = round(agg.get(ph, 0.0) + secs, 6)
-                elif isinstance(val, (int, float)):
-                    timings[key] = timings.get(key, 0) + val
-                else:
-                    timings[key] = val
-        rebuilt = out.get("rebuilt", [])
-        if rebuilt:
-            t3 = _time.perf_counter()
-            env.node_post(rebuilder,
-                          f"/admin/ec/mount?volume={vid}"
-                          f"&collection={collection}"
-                          f"&shards={','.join(map(str, rebuilt))}")
-            if timings is not None:
-                timings["mount_s"] = timings.get("mount_s", 0) + \
-                    (_time.perf_counter() - t3)
-        # clean up temp survivor copies (not mounted here)
-        if copied:
-            env.node_post(rebuilder,
-                          f"/admin/ec/delete_shards?volume={vid}"
-                          f"&collection={collection}"
-                          f"&shards={','.join(map(str, copied))}")
     except BaseException as e:
         root.tags.setdefault("error", type(e).__name__)
         raise
     finally:
         tracing.finish_span(root)
     env.write(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}")
+
+
+def _rebuild_streaming(env: CommandEnv, vid: int, collection: str,
+                       shards: Dict[int, List[str]], rebuilder: str,
+                       root, timings: Dict = None) -> List[int]:
+    """One POST: the rebuilder pulls slab-aligned survivor ranges from
+    the holder map and feeds them straight into the pipelined decode."""
+    import time as _time
+    sources = {str(sid): urls for sid, urls in shards.items()
+               if rebuilder not in urls}
+    t0 = _time.perf_counter()
+    out = env.node_post(
+        rebuilder,
+        f"/admin/ec/rebuild?volume={vid}&collection={collection}",
+        body={"sources": sources})
+    t1 = _time.perf_counter()
+    rebuilt = out.get("rebuilt", [])
+    if timings is not None:
+        stats = out.get("stats") or {}
+        # stream mode has no serialized gather wall: report the busy
+        # times so gather_s + compute_s estimates the SERIALIZED cost
+        # the overlap saved (wall_s carries the actual elapsed time)
+        timings["gather_s"] = timings.get("gather_s", 0) + \
+            stats.get("gather_busy_s", 0.0)
+        timings["compute_s"] = timings.get("compute_s", 0) + \
+            stats.get("compute_busy_s", 0.0)
+        timings["wall_s"] = timings.get("wall_s", 0) + (t1 - t0)
+        timings["gathered_shards"] = \
+            timings.get("gathered_shards", 0) + \
+            stats.get("gather_remote_shards", len(sources))
+        _merge_rebuild_stats(timings, out)
+    if rebuilt:
+        t3 = _time.perf_counter()
+        env.node_post(rebuilder,
+                      f"/admin/ec/mount?volume={vid}"
+                      f"&collection={collection}"
+                      f"&shards={','.join(map(str, rebuilt))}")
+        if timings is not None:
+            timings["mount_s"] = timings.get("mount_s", 0) + \
+                (_time.perf_counter() - t3)
+    return rebuilt
+
+
+def _rebuild_via_copy(env: CommandEnv, vid: int, collection: str,
+                      shards: Dict[int, List[str]], rebuilder: str,
+                      root, timings: Dict = None) -> List[int]:
+    """Legacy flow: copy every survivor whole, rebuild locally, delete
+    the temp copies."""
+    import time as _time
+    from ..util import tracing
+    from ..util.fanout import fan_out_must_succeed
+    local = {s for s, urls in shards.items() if rebuilder in urls}
+    # copy surviving shards the rebuilder lacks — pulls from distinct
+    # sources run concurrently (reference prepareDataToRecover +
+    # goroutine fan-out); the .ecx rides along with exactly one copy
+    to_copy = [(sid, urls[0]) for sid, urls in shards.items()
+               if sid not in local]
+    copied = [sid for sid, _ in to_copy]
+
+    def pull(job):
+        (sid, src), with_ecx = job
+        # fan-out worker threads don't inherit the contextvar —
+        # parent each per-source gather span on the root explicitly
+        with tracing.span("gather", parent=root, shard=sid,
+                          source=src):
+            env.node_post(
+                rebuilder,
+                f"/admin/ec/copy?volume={vid}&collection={collection}"
+                f"&source={src}&shards={sid}"
+                f"&copy_ecx={'true' if with_ecx else 'false'}")
+
+    jobs = [(item, (not local) and i == 0)
+            for i, item in enumerate(to_copy)]
+    t0 = _time.perf_counter()
+    fan_out_must_succeed(pull, jobs,
+                         what=f"survivor shard copy for volume {vid}",
+                         dedicated=True)
+    t1 = _time.perf_counter()
+    # rebuild + mount only the previously-missing shards
+    out = env.node_post(rebuilder,
+                        f"/admin/ec/rebuild?volume={vid}"
+                        f"&collection={collection}")
+    t2 = _time.perf_counter()
+    if timings is not None:
+        timings["gather_s"] = timings.get("gather_s", 0) + (t1 - t0)
+        timings["compute_s"] = timings.get("compute_s", 0) + (t2 - t1)
+        timings["wall_s"] = timings.get("wall_s", 0) + (t2 - t0)
+        timings["gathered_shards"] = \
+            timings.get("gathered_shards", 0) + len(to_copy)
+        _merge_rebuild_stats(timings, out)
+    rebuilt = out.get("rebuilt", [])
+    if rebuilt:
+        t3 = _time.perf_counter()
+        env.node_post(rebuilder,
+                      f"/admin/ec/mount?volume={vid}"
+                      f"&collection={collection}"
+                      f"&shards={','.join(map(str, rebuilt))}")
+        if timings is not None:
+            timings["mount_s"] = timings.get("mount_s", 0) + \
+                (_time.perf_counter() - t3)
+    # clean up temp survivor copies (not mounted here)
+    if copied:
+        env.node_post(rebuilder,
+                      f"/admin/ec/delete_shards?volume={vid}"
+                      f"&collection={collection}"
+                      f"&shards={','.join(map(str, copied))}")
+    return rebuilt
 
 
 @command("ec.decode",

@@ -62,10 +62,11 @@ class CommandEnv:
         return post_json(f"http://{self.master_url}{path}")
 
     def node_post(self, node: str, path: str,
-                  timeout: "float | None" = None) -> dict:
+                  timeout: "float | None" = None,
+                  body: dict = None) -> dict:
         if timeout is None:
             timeout = self.admin_timeout
-        return post_json(f"http://{node}{path}", timeout=timeout)
+        return post_json(f"http://{node}{path}", body, timeout=timeout)
 
     def node_get(self, node: str, path: str) -> dict:
         return get_json(f"http://{node}{path}")

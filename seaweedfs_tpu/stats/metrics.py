@@ -244,6 +244,54 @@ SMALL_DISPATCH_SUGGESTED_GAUGE = VOLUME_SERVER_GATHER.gauge(
     "Suggested SW_EC_SMALL_DISPATCH_BYTES fitted from the first "
     "reconstruct spans (0 until enough samples).")
 
+# -- streaming gather (ec/gather.py via observe_gather) ----------------------
+
+VOLUME_EC_GATHER_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_gather_total",
+    "Streaming-rebuild gather events by kind (bytes, fetches, stripes, "
+    "retries, hedges_fired, hedges_won).",
+    labels=("kind",))
+VOLUME_EC_GATHER_SECONDS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_gather_seconds_total",
+    "Cumulative gather busy time (union of in-flight fetch intervals) "
+    "across streaming rebuilds.")
+VOLUME_EC_GATHER_MBPS_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_gather_mbps",
+    "Effective gather bandwidth of the last streaming rebuild "
+    "(fetched bytes / busy seconds).")
+VOLUME_EC_OVERLAP_FRAC_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_overlap_frac",
+    "Gather/compute overlap of the last streaming rebuild: "
+    "(serialized_estimate - wall) / serialized_estimate, 0..1.")
+HTTP_POOL_CHURN_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_http_pool_churn_total",
+    "Keep-alive connection pool events (created, reused, "
+    "evicted_stale, evicted_idle, evicted_overflow).",
+    labels=("event",))
+
+
+def observe_gather(stats: Dict):
+    """Export one streaming rebuild's gather stats (the dict filled by
+    ec.encoder.rebuild_ec_files_streaming) onto the volume registry."""
+    if not stats:
+        return
+    for kind, key in (("bytes", "gather_bytes"),
+                      ("fetches", "gather_fetches"),
+                      ("stripes", "gather_stripes"),
+                      ("retries", "gather_retries"),
+                      ("hedges_fired", "hedges_fired"),
+                      ("hedges_won", "hedges_won")):
+        n = stats.get(key)
+        if n:
+            VOLUME_EC_GATHER_COUNTER.inc(kind, amount=n)
+    busy = stats.get("gather_busy_s")
+    if busy:
+        VOLUME_EC_GATHER_SECONDS.inc(amount=busy)
+    if "gather_mbps" in stats:
+        VOLUME_EC_GATHER_MBPS_GAUGE.set(stats["gather_mbps"])
+    if "overlap_frac" in stats:
+        VOLUME_EC_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
+
 
 class SmallDispatchTuner:
     """Fits the host/device crossover from the first-N reconstruct

@@ -12,8 +12,8 @@ import threading
 from typing import Dict, List, Optional
 
 from ..ec import encoder as ec_encoder
-from ..ec.constants import TOTAL_SHARDS, to_ext
-from ..ec.ec_volume import EcVolume, rebuild_ecx_file
+from ..ec.constants import DATA_SHARDS, TOTAL_SHARDS, to_ext
+from ..ec.ec_volume import EcVolume, ec_offset_width, rebuild_ecx_file
 from ..ops.codec import ReedSolomonCodec
 from .disk_location import DiskLocation
 from .needle import Needle
@@ -228,6 +228,96 @@ class Store:
                             stats["phases"].get("write", 0.0) + ecx_s, 6)
                 return rebuilt
         raise VolumeError(f"ec volume {vid} not found")
+
+    def rebuild_ec_shards_streaming(self, vid: int, collection: str = "",
+                                    sources: Dict[int, List[str]] = None,
+                                    stats: dict = None,
+                                    slab: Optional[int] = None,
+                                    window: Optional[int] = None,
+                                    hedge_ms: Optional[float] = None
+                                    ) -> List[int]:
+        """Rebuild missing shards by streaming slab ranges of remote
+        survivors straight into the decode — no whole-shard copies on
+        this server's disks, before, during, or after. ``sources`` maps
+        shard id -> holder urls for survivors NOT local to this store;
+        shards already here are read from disk. Only the KB-scale index
+        sidecars (.ecx/.vif/.ecj) are copied whole."""
+        import time as _time
+        from ..ec import gather
+        from ..util import tracing
+        sources = {int(s): list(urls) for s, urls in
+                   (sources or {}).items() if urls}
+        holders: List[str] = []
+        for urls in sources.values():
+            for u in urls:
+                if u not in holders:
+                    holders.append(u)
+        # prefer a location that already has volume files; else the
+        # freest one — the rebuilt shards and index live there
+        loc = None
+        for cand in self.locations:
+            base = volume_file_prefix(cand.directory, collection, vid)
+            if os.path.exists(base + ".ecx") or any(
+                    os.path.exists(base + to_ext(i))
+                    for i in range(TOTAL_SHARDS)):
+                loc = cand
+                break
+        if loc is None:
+            loc = self.find_free_location() or self.locations[0]
+        base = volume_file_prefix(loc.directory, collection, vid)
+        k = self.codec.k if self.codec is not None else DATA_SHARDS
+        total = self.codec.total if self.codec is not None \
+            else TOTAL_SHARDS
+        with tracing.span("ec.rebuild.stream", volume=vid) as root:
+            if holders:
+                gather.fetch_index_files(base, holders)
+            local = [os.path.exists(base + to_ext(i))
+                     for i in range(total)]
+            present = [local[i] or i in sources for i in range(total)]
+            missing = [i for i, p in enumerate(present) if not p]
+            if not missing:
+                return []
+            if sum(present) < k:
+                raise VolumeError(
+                    f"cannot rebuild {vid}: only {sum(present)} of "
+                    f"{total} shards reachable")
+            src = [i for i, p in enumerate(present) if p][:k]
+            gstats = gather.GatherStats()
+            shard_size = None
+            readers = []
+            for i in src:
+                if local[i]:
+                    sz = os.path.getsize(base + to_ext(i))
+                    if shard_size is None:
+                        shard_size = sz
+                    elif shard_size != sz:
+                        raise VolumeError(
+                            "surviving shards differ in size")
+                    readers.append(gather.LocalShardReader(
+                        base + to_ext(i), gstats))
+                else:
+                    readers.append(gather.RemoteShardReader(
+                        vid, i, sources[i], gstats, hedge_ms=hedge_ms))
+            if shard_size is None:
+                probe = src[0]
+                shard_size = gather.probe_shard_size(
+                    vid, probe, sources[probe])
+            eff_slab = slab or gather.auto_slab(
+                shard_size, default=ec_encoder.DEFAULT_SLAB)
+            source = gather.StripedGatherSource(
+                readers, shard_size, slab=eff_slab,
+                window=window, stats=gstats, parent_span=root)
+            rebuilt = ec_encoder.rebuild_ec_files_streaming(
+                base, present, missing, source, codec=self.codec,
+                slab=eff_slab, stats=stats)
+            t0 = _time.perf_counter()
+            rebuild_ecx_file(base, ec_offset_width(base))
+            ecx_s = _time.perf_counter() - t0
+            tracing.record_span("write", ecx_s, op="ec.rebuild.ecx")
+            if stats is not None and "phases" in stats:
+                stats["phases"]["write"] = round(
+                    stats["phases"].get("write", 0.0) + ecx_s, 6)
+        return rebuilt
 
     # -- heartbeat (reference store.go:193-247 CollectHeartbeat) -----------
     def collect_heartbeat(self) -> dict:

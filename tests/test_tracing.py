@@ -241,8 +241,11 @@ class TestClusterTrace:
             assert "ec.rebuild" in names                  # shell root
             assert "* /cluster/status" in names           # master
             assert "POST /admin/ec/rebuild" in names      # rebuilder
-            assert "POST /admin/ec/copy" in names         # gather rpc
-            assert "GET /admin/file" in names             # peer fetch
+            assert "ec.rebuild.stream" in names           # rebuilder root
+            assert "gather.stripe" in names               # striped gather
+            # the gather pool's ranged peer reads carry the traceparent
+            # even though the worker threads never saw the contextvar
+            assert "GET /admin/ec/shard_read" in names
             assert {"gather", "dispatch", "write"} <= names
             for s in got["spans"]:
                 assert s["trace_id"] == tid

@@ -31,6 +31,27 @@ SAMPLE_RE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? NaN$')
 RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
 
+# families the streaming-gather observability contract depends on: the
+# dashboards/bench assertions reference them by name, so renaming or
+# dropping one must fail the lint, not silently flatline a panel
+REQUIRED_FAMILIES = {
+    "volume": (
+        "SeaweedFS_volumeServer_ec_phase_seconds_total",
+        "SeaweedFS_volumeServer_ec_gather_total",
+        "SeaweedFS_volumeServer_ec_gather_seconds_total",
+        "SeaweedFS_volumeServer_ec_gather_mbps",
+        "SeaweedFS_volumeServer_ec_overlap_frac",
+        "SeaweedFS_volumeServer_http_pool_churn_total",
+    ),
+}
+
+
+def check_required(role: str, registry) -> list:
+    names = {m.name for m in registry._metrics}
+    return [f"{role}: required metric family missing: {want}"
+            for want in REQUIRED_FAMILIES.get(role, ())
+            if want not in names]
+
 
 def check_registry(role: str, registry) -> list:
     problems = []
@@ -91,6 +112,7 @@ def main() -> int:
     for role, reg in registries.items():
         problems += check_registry(role, reg)
         problems += check_render(role, reg)
+        problems += check_required(role, reg)
     if problems:
         for p in problems:
             print(f"check_metrics: {p}", file=sys.stderr)
