@@ -152,14 +152,24 @@ def init_device(timeout_s: float):
 
 def init_device_retrying(retry_log: list):
     """VERDICT r4 weak#3: one failed probe at minute 0 must not forfeit
-    the round's device headline. Spaced re-probes, each watchdogged;
-    every attempt lands in the artifact so a still-down tunnel is
-    provable rather than assumed."""
-    attempts = int(os.environ.get("SW_BENCH_INIT_RETRIES", "5"))
+    the round's device headline. Re-probes, each watchdogged; every
+    attempt lands in the artifact so a still-down tunnel is provable
+    rather than assumed.
+
+    r05 burned ~15 min of wall on six fixed-interval timeouts before
+    falling back — attempts are now capped by SW_BENCH_DEVICE_INIT_RETRIES
+    and spaced with exponential backoff (base SW_BENCH_INIT_RETRY_SPACING,
+    doubling up to SW_BENCH_INIT_RETRY_MAX_SPACING), and the CPU-fallback
+    verdict is recorded in the log the moment the last probe fails."""
+    attempts = max(1, int(os.environ.get(
+        "SW_BENCH_DEVICE_INIT_RETRIES",
+        os.environ.get("SW_BENCH_INIT_RETRIES", "5"))))
     timeout_s = float(os.environ.get("SW_BENCH_INIT_RETRY_TIMEOUT",
                                      "120"))
     spacing_s = float(os.environ.get("SW_BENCH_INIT_RETRY_SPACING",
-                                     "45"))
+                                     "15"))
+    max_spacing_s = float(os.environ.get("SW_BENCH_INIT_RETRY_MAX_SPACING",
+                                         "120"))
     for i in range(attempts):
         t0 = time.time()
         log(f"device init retry {i + 1}/{attempts}")
@@ -170,7 +180,13 @@ def init_device_retrying(retry_log: list):
         if devices is not None:
             return devices
         if i < attempts - 1:
-            time.sleep(spacing_s)
+            backoff = min(spacing_s * (2 ** i), max_spacing_s)
+            retry_log[-1]["backoff_s"] = round(backoff, 3)
+            time.sleep(backoff)
+    retry_log.append({"fallback": "cpu", "t_unix": round(time.time()),
+                      "after_attempts": attempts})
+    log(f"device init: still down after {attempts} capped attempts; "
+        f"falling back to CPU now")
     return None
 
 
@@ -567,8 +583,10 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         # a wedged tunnel would stall the whole bench on it)
         env.admin_timeout = float(
             os.environ.get("SW_BENCH_DRILL_TIMEOUT", "900"))
+        from seaweedfs_tpu.shell.command_ec import do_ec_encode
+        enc_timings = {}
         t_encode = time.perf_counter()
-        run_command(env, f"ec.encode -volumeId {vid}")
+        do_ec_encode(env, vid, timings=enc_timings)
         encode_s = time.perf_counter() - t_encode
 
         # shard ownership reaches the master via the store-change
@@ -641,6 +659,19 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         out = {"servers": n_servers, "volume_mb": size_mb,
                "backend": backend, "lost_shards": len(lost),
                "encode_spread_s": round(encode_s, 1),
+               # streaming encode+spread split (busy times + overlap;
+               # copy mode reports its two serialized phase walls and
+               # overlap 0) — the write-path mirror of the gather
+               # accounting below
+               "encode_mode": enc_timings.get("mode", "stream"),
+               "encode_s": round(
+                   enc_timings.get("encode_busy_s", 0.0), 2),
+               "spread_s": round(
+                   enc_timings.get("spread_busy_s", 0.0), 2),
+               "encode_overlap_frac": round(
+                   enc_timings.get("overlap_frac", 0.0), 3),
+               "spread_mbps": round(
+                   enc_timings.get("spread_mbps", 0.0), 1),
                "rebuild_wall_s": round(rebuild_s, 1),
                "rebuild_mbps_volume_bytes": round(
                    (size_mb << 20) / rebuild_s / 1e6),

@@ -10,10 +10,15 @@ from .constants import (  # noqa: F401
     DATA_SHARDS, PARITY_SHARDS, TOTAL_SHARDS,
     LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext,
 )
-from .encoder import write_ec_files, write_sorted_file_from_idx, \
-    rebuild_ec_files, rebuild_ec_files_streaming  # noqa: F401
+from .encoder import write_ec_files, write_ec_files_spread, \
+    write_sorted_file_from_idx, rebuild_ec_files, \
+    rebuild_ec_files_streaming  # noqa: F401
 from .gather import (  # noqa: F401
     GatherStats, LocalShardReader, RemoteShardReader, StripedGatherSource,
     fetch_index_files, probe_shard_size,
+)
+from .spread import (  # noqa: F401
+    LocalShardWriter, RemoteShardWriter, SpreadError, SpreadStats,
+    StripedSpreadSink, spread_window,
 )
 from .locate import Interval, locate_data  # noqa: F401

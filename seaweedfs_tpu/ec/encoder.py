@@ -127,13 +127,20 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                    small_block: int = SMALL_BLOCK_SIZE,
                    slab: int = DEFAULT_SLAB,
                    pipelined: Optional[bool] = None,
-                   timer: Optional[StageTimer] = None):
+                   timer: Optional[StageTimer] = None,
+                   sink=None):
     """Encode base_name.dat into base_name.ec00 .. .ec{k+m-1}.
 
     pipelined: None = auto (pipeline when the codec is device-backed);
     True/False forces. The synchronous path and the pipelined path produce
     byte-identical shard files. ``timer`` collects a per-stage breakdown
     (disk_read / h2d / d2h+mxu / shard_write / waits) for bench/profiling.
+
+    ``sink``: when given (an ec.spread.StripedSpreadSink), the stripe
+    stream is teed into ``sink.write_stripe(data, parity)`` instead of
+    local shard files — each stripe is the next slab-aligned byte range
+    of every shard, pushed to its holder while later slabs encode. The
+    caller owns the sink lifecycle (finish/abort).
     """
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
     k, m = codec.k, codec.m
@@ -146,7 +153,8 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
     timer = timer if timer is not None else StageTimer()
     slabs = _dat_slabs(dat_path, dat_size, k, large_block, small_block, slab,
                        timer)
-    outs = [open(base_name + to_ext(i), "wb") for i in range(k + m)]
+    outs = [] if sink is not None else \
+        [open(base_name + to_ext(i), "wb") for i in range(k + m)]
     try:
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
@@ -158,10 +166,13 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                       for meta, data in slabs)
         for _, data, parity in stream:
             t0 = time.perf_counter()
-            for i in range(k):
-                outs[i].write(data[i].tobytes())
-            for j in range(m):
-                outs[k + j].write(parity[j].tobytes())
+            if sink is not None:
+                sink.write_stripe(data, parity)
+            else:
+                for i in range(k):
+                    outs[i].write(data[i].tobytes())
+                for j in range(m):
+                    outs[k + j].write(parity[j].tobytes())
             end = time.perf_counter()
             timer.add("shard_write", end - t0,
                       data.nbytes + parity.nbytes, interval=(t0, end))
@@ -169,6 +180,71 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
         for o in outs:
             o.close()
     _record_phase_spans(timer, pipelined, op="ec.encode")
+
+
+def write_ec_files_spread(base_name: str, sink,
+                          codec: Optional[ReedSolomonCodec] = None,
+                          large_block: int = LARGE_BLOCK_SIZE,
+                          small_block: int = SMALL_BLOCK_SIZE,
+                          slab: int = DEFAULT_SLAB,
+                          pipelined: Optional[bool] = None,
+                          stats: Optional[dict] = None):
+    """Streaming encode+spread: tee write_ec_files' stripe stream into
+    ``sink`` (an ec.spread.StripedSpreadSink) so each shard's slab
+    ranges reach its holder while later slabs are still encoding —
+    the write-path mirror of rebuild_ec_files_streaming. Wall
+    approaches max(encode, spread); shards bound for remote holders
+    never touch the source disk.
+
+    On ANY failure the sink is aborted (``.part`` cleanup on every
+    holder) before the exception propagates — callers either get a
+    complete finalized shard set or nothing.
+
+    ``stats``, when given, is filled with the spread counters plus
+    ``encode_busy_s`` / ``spread_busy_s`` / ``overlap_frac`` — the
+    encode-side analogue of the streaming rebuild's gather stats."""
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    if pipelined is None:
+        pipelined = codec.backend in ("tpu", "mesh")
+    from ..ops import telemetry
+    before = telemetry.STATS.snapshot()
+    timer = StageTimer()
+    t_stream = time.perf_counter()
+    try:
+        write_ec_files(base_name, codec=codec, large_block=large_block,
+                       small_block=small_block, slab=slab,
+                       pipelined=pipelined, timer=timer, sink=sink)
+        sink.finish()
+    except BaseException:
+        sink.abort()
+        raise
+    stream_s = time.perf_counter() - t_stream
+    if stats is not None:
+        ss = sink.stats
+        stats.update(telemetry.delta(before))
+        stats.update(ss.snapshot())
+        stats["shard_size"] = sink.offset
+        stats["stream_s"] = round(stream_s, 3)
+        stats["backend"] = codec.backend
+        stats["phases"] = {n: round(s, 6) for n, s in
+                           _phases_from_timer(timer, pipelined).items()}
+        # encode busy = stream wall minus the time the consumer spent
+        # blocked on full send windows; spread busy = the union of send
+        # intervals across all target workers. The overlap fraction is
+        # the same clamped serialized-vs-wall estimate the streaming
+        # rebuild reports for gather/compute.
+        spread_busy = ss.busy_s()
+        encode_busy = max(stream_s - sink.blocked_s, 0.0)
+        serialized = encode_busy + spread_busy
+        overlap = 0.0
+        if serialized > 0:
+            overlap = max(0.0, min(1.0,
+                                   (serialized - stream_s) / serialized))
+        stats["encode_busy_s"] = round(encode_busy, 3)
+        stats["spread_busy_s"] = round(spread_busy, 3)
+        stats["overlap_frac"] = round(overlap, 4)
+        stats["spread_mbps"] = round(ss.mbps(), 1)
+        stats["spread_remote_shards"] = ss.remote_shards
 
 
 def _phases_from_timer(timer: StageTimer, pipelined: bool) -> dict:

@@ -47,13 +47,45 @@ def host_matmul(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
     return out
 
 
+# Live override of the hybrid threshold, set by the auto-tuner
+# (stats.metrics.observe_span when SW_EC_SMALL_DISPATCH_AUTO=1) once it
+# has fitted the host/device crossover from the first reconstruct
+# calls. Consulted by small_dispatch_default() (new codecs) AND by
+# reconstruct() (codecs already constructed), so a suggestion applies
+# without a server restart.
+_SMALL_DISPATCH_OVERRIDE: "int | None" = None
+
+
 def small_dispatch_default() -> int:
     """Width (bytes) below which device codecs answer reconstruct() on
     the host: reconstruct-on-read serves kilobyte needle ranges
     (server/volume_server._reconstruct_shard_range) and a full device
-    round-trip per read would dominate the latency. Env-tunable."""
+    round-trip per read would dominate the latency. Env-tunable, and
+    superseded by the auto-tuner's override once one is applied."""
+    if _SMALL_DISPATCH_OVERRIDE is not None:
+        return _SMALL_DISPATCH_OVERRIDE
     return int(os.environ.get("SW_EC_SMALL_DISPATCH_BYTES",
                               str(256 << 10)))
+
+
+def small_dispatch_override() -> "int | None":
+    return _SMALL_DISPATCH_OVERRIDE
+
+
+def set_small_dispatch_override(nbytes: "int | None"):
+    """Install (or clear, with None/0) the live hybrid-threshold
+    override."""
+    global _SMALL_DISPATCH_OVERRIDE
+    _SMALL_DISPATCH_OVERRIDE = int(nbytes) if nbytes else None
+
+
+def maybe_auto_apply_small_dispatch(suggestion: int) -> bool:
+    """Apply the tuner's suggested threshold when the operator opted in
+    via SW_EC_SMALL_DISPATCH_AUTO=1. Returns whether it was applied."""
+    if os.environ.get("SW_EC_SMALL_DISPATCH_AUTO", "") != "1":
+        return False
+    set_small_dispatch_override(suggestion)
+    return True
 
 
 class _ConstCache:
@@ -202,8 +234,13 @@ class ReedSolomonCodec:
             return shards
         survivors = np.stack([np.asarray(shards[i], dtype=np.uint8)
                               for i in src], axis=0)
-        small = self.small_dispatch_bytes and \
-            survivors.shape[1] < self.small_dispatch_bytes
+        thr = self.small_dispatch_bytes
+        if thr and _SMALL_DISPATCH_OVERRIDE is not None:
+            # the auto-tuner's live override supersedes the snapshot
+            # taken at construction; host-only codecs (thr == 0) keep
+            # their never-delegate behavior
+            thr = _SMALL_DISPATCH_OVERRIDE
+        small = thr and survivors.shape[1] < thr
         # the reconstruct span's (bytes, seconds, path) tags feed the
         # SW_EC_SMALL_DISPATCH_BYTES tuner (stats.metrics.observe_span)
         with tracing.span("reconstruct", backend=self.backend,

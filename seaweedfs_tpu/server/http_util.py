@@ -46,6 +46,9 @@ class Request:
     @property
     def body(self) -> bytes:
         if self._body is None:
+            if self._chunked():
+                self._body = self._read_chunked()
+                return self._body
             try:
                 length = int(self.headers.get("Content-Length") or 0)
             except ValueError:
@@ -59,6 +62,43 @@ class Request:
             self._body = self.handler.rfile.read(length) if length else b""
         return self._body
 
+    def _chunked(self) -> bool:
+        return "chunked" in \
+            (self.headers.get("Transfer-Encoding") or "").lower()
+
+    def _read_chunked(self) -> bytes:
+        """Decode a chunked transfer-encoded body (the framing
+        post_chunked emits: streaming uploads whose size isn't known —
+        or not yet complete — when the request line goes out). Any
+        framing violation severs the connection: resynchronizing a
+        keep-alive stream after a bad chunk header is not possible."""
+        rfile = self.handler.rfile
+        out: List[bytes] = []
+        while True:
+            line = rfile.readline(1 << 16)
+            if not line or not line.endswith(b"\n"):
+                self.handler.close_connection = True
+                raise HttpError(400, "truncated chunked body")
+            size_s = line.split(b";", 1)[0].strip()
+            try:
+                size = int(size_s, 16)
+            except ValueError:
+                self.handler.close_connection = True
+                raise HttpError(400, "bad chunk size") from None
+            if size == 0:
+                # consume optional trailers up to the blank line
+                while True:
+                    t = rfile.readline(1 << 16)
+                    if t in (b"\r\n", b"\n", b""):
+                        break
+                return b"".join(out)
+            data = rfile.read(size)
+            if len(data) != size:
+                self.handler.close_connection = True
+                raise HttpError(400, "truncated chunk")
+            out.append(data)
+            rfile.read(2)  # chunk-terminating CRLF
+
     def drain(self, cap: int = 4 << 20):
         """Discard any unread request body. Keep-alive framing depends
         on this: a handler that never touches .body would otherwise
@@ -68,6 +108,12 @@ class Request:
         volume-sized upload to completion would stall the thread for
         the whole transfer (Go's http.Server draws the same line)."""
         if self._body is not None:
+            return
+        if self._chunked():
+            # unread chunked body: total size is unknowable up front, so
+            # sever instead of decoding a possibly volume-sized stream
+            self.handler.close_connection = True
+            self._body = b""
             return
         try:
             left = int(self.headers.get("Content-Length") or 0)
@@ -697,7 +743,8 @@ def _traced_headers(headers: Optional[dict]) -> dict:
 
 def _pooled_call(method: str, url: str, body, headers: dict,
                  timeout: float, max_redirects: int = 5,
-                 want_headers: bool = False):
+                 want_headers: bool = False,
+                 encode_chunked: bool = False):
     headers = _traced_headers(headers)
     parsed = urllib.parse.urlsplit(url)
     netloc, scheme = parsed.netloc, parsed.scheme
@@ -711,7 +758,8 @@ def _pooled_call(method: str, url: str, body, headers: dict,
     # Go's http.Client draws the same line. Streaming bodies cannot be
     # re-sent at all, so they always go out on a FRESH connection
     # (their transfer time dwarfs the handshake).
-    replayable = body is None or isinstance(body, (bytes, bytearray))
+    replayable = not encode_chunked and \
+        (body is None or isinstance(body, (bytes, bytearray)))
     idempotent = method in ("GET", "HEAD", "DELETE", "PUT")
     attempts = 2 if (replayable and idempotent) else 1
     for attempt in range(attempts):
@@ -723,7 +771,8 @@ def _pooled_call(method: str, url: str, body, headers: dict,
             if conn.sock is None:
                 conn.connect()
                 _nodelay(conn)
-            conn.request(method, target, body=body, headers=headers)
+            conn.request(method, target, body=body, headers=headers,
+                         encode_chunked=encode_chunked)
             resp = conn.getresponse()
             data = resp.read()
         except _RETRIABLE_STALE:
@@ -838,6 +887,25 @@ def post_json(url: str, obj=None, timeout: float = 30.0) -> dict:
     out = http_call("POST", url, body,
                     {"Content-Type": "application/json"}, timeout)
     return json.loads(out or b"{}")
+
+
+def post_chunked(url: str, chunks, headers: Optional[dict] = None,
+                 timeout: float = 300.0) -> bytes:
+    """POST an iterable of byte chunks with chunked transfer-encoding —
+    the body can start flowing before its total size is known (the EC
+    spread pushes shard ranges as the encode produces them). Chunked
+    bodies are not replayable, so the call always goes out on a fresh
+    connection and is never retried here; the spread layer owns retry."""
+    url = _client_url(url)
+    h = dict(headers or {})
+    h["Transfer-Encoding"] = "chunked"
+    try:
+        return _pooled_call("POST", url, iter(chunks), h, timeout,
+                            encode_chunked=True)
+    except HttpError:
+        raise
+    except (OSError, _httpc.HTTPException) as e:
+        raise HttpError(503, f"POST {url}: {e}") from None
 
 
 def _quote_name(name: str) -> str:

@@ -57,6 +57,8 @@ class VolumeServer:
         router.add("POST", "/admin/ec/copy", self.admin_ec_copy)
         router.add("POST", "/admin/ec/delete_shards",
                    self.admin_ec_delete_shards)
+        router.add("POST", "/admin/ec/shard_write",
+                   self.admin_ec_shard_write)
         router.add("POST", "/admin/volume/copy", self.admin_volume_copy)
         router.add("POST", "/admin/volume/verify", self.admin_volume_verify)
         router.add("POST", "/admin/ec/to_volume", self.admin_ec_to_volume)
@@ -660,10 +662,108 @@ class VolumeServer:
 
     # -- EC admin (reference volume_grpc_erasure_coding.go) ----------------
     def admin_ec_generate(self, req: Request):
+        """Encode a readonly volume into shard files. Query-only = the
+        legacy local flow (all k+m shards land on this disk). When the
+        POST body carries ``assignment`` ({shard: holder url}), the
+        streaming encode+spread runs instead: each shard's slab ranges
+        are pushed to its holder while later slabs encode, and shards
+        bound for remote holders never touch this disk."""
         vid = int(req.query["volume"])
-        base = self.store.generate_ec_shards(
-            vid, req.query.get("collection", ""))
+        collection = req.query.get("collection", "")
+        try:
+            body = req.json()
+        except ValueError:
+            raise HttpError(400, "bad JSON body") from None
+        if isinstance(body, dict) and body.get("assignment"):
+            from ..stats.metrics import observe_spread
+            from ..util import tracing
+            stats: dict = {}
+            base, final = self.store.generate_ec_shards_streaming(
+                vid, collection,
+                assignment={int(s): u
+                            for s, u in body["assignment"].items()},
+                spares=body.get("spares") or [],
+                window=int(body.get("window") or 0) or None,
+                stats=stats)
+            observe_spread(stats)
+            return {"volume": vid, "base": os.path.basename(base),
+                    "assignment": {str(s): u for s, u in final.items()},
+                    "stats": stats,
+                    "trace_id": tracing.current_trace_id()}
+        base = self.store.generate_ec_shards(vid, collection)
         return {"volume": vid, "base": os.path.basename(base)}
+
+    def _ec_stage_base(self, vid: int, collection: str) -> str:
+        """Base path for incoming shard stages: the location already
+        holding this volume's EC files if any (staged ranges, finalized
+        shards and the later sidecar copy must all land at ONE base or
+        the mount won't see them), else a free location."""
+        exts = [to_ext(s) for s in range(TOTAL_SHARDS)] + [".ecx"]
+        for loc in self.store.locations:
+            base = volume_file_prefix(loc.directory, collection, vid)
+            if any(os.path.exists(base + e) or
+                   os.path.exists(base + e + ".part") for e in exts):
+                return base
+        loc = self.store.find_free_location()
+        if loc is None:
+            raise HttpError(507, "no free disk location")
+        return volume_file_prefix(loc.directory, collection, vid)
+
+    def admin_ec_shard_write(self, req: Request):
+        """Receive one shard's ranges from a streaming encode+spread
+        (ec/spread.py): chunked POSTs append at the expected offset into
+        ``<shard>.part`` (409 carries the staged size on a mismatch, so
+        a sender that lost an ack can tell delivered from diverged);
+        ``action=finalize&size=`` verifies the stage and atomically
+        renames it into place; ``action=abort`` drops the stages —
+        failures never leave partial shard files."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        action = req.query.get("action", "append")
+        if action == "abort":
+            req.drain()
+            removed = []
+            for loc in self.store.locations:
+                base = volume_file_prefix(loc.directory, collection, vid)
+                for sid in range(TOTAL_SHARDS):
+                    p = base + to_ext(sid) + ".part"
+                    if os.path.exists(p):
+                        os.remove(p)
+                        removed.append(sid)
+            return {"volume": vid, "aborted": removed}
+        sid = int(req.query["shard"])
+        base = self._ec_stage_base(vid, collection)
+        part = base + to_ext(sid) + ".part"
+        if action == "finalize":
+            req.drain()
+            size = int(req.query["size"])
+            if not os.path.exists(part):
+                raise HttpError(404, f"no staged shard {sid} for "
+                                     f"volume {vid}")
+            staged = os.path.getsize(part)
+            if staged != size:
+                raise HttpError(409, f"shard {sid} staged={staged} "
+                                     f"expected={size}")
+            os.replace(part, base + to_ext(sid))
+            return {"volume": vid, "shard": sid, "size": size,
+                    "finalized": True}
+        off = int(req.query.get("offset", "0"))
+        staged = os.path.getsize(part) if os.path.exists(part) else 0
+        if off != staged and off != 0:
+            # consume the (window-bounded) body so the sender can read
+            # this response off a cleanly framed connection — a sender
+            # that lost an ack needs the staged size to tell delivered
+            # from diverged
+            _ = req.body
+            raise HttpError(409, f"shard {sid} offset mismatch: "
+                                 f"staged={staged} offset={off}")
+        data = req.body
+        # offset 0 truncates: a replayed first range (failover to this
+        # node, or a retry whose original died mid-body) starts clean
+        with open(part, "wb" if off == 0 else "ab") as f:
+            f.write(data)
+            staged = f.tell()
+        return {"volume": vid, "shard": sid, "staged": staged}
 
     def admin_ec_mount(self, req: Request):
         vid = int(req.query["volume"])
@@ -728,10 +828,10 @@ class VolumeServer:
         shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
                      if s != ""]
         copy_ecx = req.query.get("copy_ecx", "true") == "true"
-        loc = self.store.find_free_location()
-        if loc is None:
-            raise HttpError(507, "no free disk location")
-        base = volume_file_prefix(loc.directory, collection, vid)
+        # land next to any EC files this volume already has here (a
+        # streamed spread may have staged shards on this server; the
+        # sidecar pull must join them at the same base for the mount)
+        base = self._ec_stage_base(vid, collection)
         name = os.path.basename(base)
         exts = [to_ext(s) for s in shard_ids]
         optional = []
@@ -774,10 +874,14 @@ class VolumeServer:
         for loc in self.store.locations:
             base = volume_file_prefix(loc.directory, collection, vid)
             for sid in shard_ids:
-                p = base + to_ext(sid)
-                if os.path.exists(p):
-                    os.remove(p)
-                    removed.append(sid)
+                # drop any spread stage alongside the shard — a failed
+                # or failed-over stream must not leave .part orphans
+                for p in (base + to_ext(sid),
+                          base + to_ext(sid) + ".part"):
+                    if os.path.exists(p):
+                        os.remove(p)
+                        if not p.endswith(".part"):
+                            removed.append(sid)
             if not any(os.path.exists(base + to_ext(s))
                        for s in range(TOTAL_SHARDS)):
                 for ext in (".ecx", ".ecj", ".vif"):

@@ -77,8 +77,11 @@ def collect_volume_ids_for_ec_encode(env: CommandEnv, collection: str,
 
 
 @command("ec.encode",
-         "-volumeId <id> | -collection <name> [-fullPercent 0.95] : "
-         "erasure-code volumes and spread 14 shards across the cluster")
+         "-volumeId <id> | -collection <name> [-fullPercent 0.95] "
+         "[-mode stream|copy] : erasure-code volumes and spread 14 "
+         "shards across the cluster (stream = push shard ranges to "
+         "holders while later slabs encode; copy = legacy "
+         "generate-then-pull)")
 def ec_encode(env: CommandEnv, args: List[str]):
     flags = parse_flags(args)
     if "volumeId" in flags:
@@ -91,32 +94,187 @@ def ec_encode(env: CommandEnv, args: List[str]):
         env.write("usage: ec.encode -volumeId <id> | -collection <name>")
         return
     for vid in vids:
-        do_ec_encode(env, vid)
+        do_ec_encode(env, vid, mode=flags.get("mode"))
 
 
-def do_ec_encode(env: CommandEnv, vid: int):
+def do_ec_encode(env: CommandEnv, vid: int, mode: str = None,
+                 timings: Dict = None):
+    """Freeze -> encode+spread -> mount -> drop originals.
+
+    mode: "stream" (default; `SW_EC_SPREAD_MODE` overrides) sends the
+    shard assignment to the source, which pushes each shard's slab
+    ranges to its holder WHILE later slabs encode — remote-bound shards
+    never touch the source disk. "copy" is the legacy two-phase flow
+    (all 14 shards land on the source, then targets pull whole files);
+    stream mode also falls back to it when the source predates the
+    streaming endpoint or the spread dies mid-shard.
+
+    Any failure after the freeze unwinds: generated shard files (and
+    ``.part`` stages) are deleted cluster-wide and each replica's
+    readonly flag is restored to its own prior state — a failed encode
+    must not leave the volume frozen with orphan shards.
+
+    ``timings``, when given, records encode/spread busy seconds,
+    ``overlap_frac``, and the spread counters for bench."""
+    import os as _os
+    from ..util import tracing
+    mode = (mode or _os.environ.get("SW_EC_SPREAD_MODE") or
+            "stream").lower()
     replicas = _volume_replicas(env, vid)
     if not replicas:
         env.write(f"volume {vid} not found")
         return
     collection = replicas[0].get("collection", "")
     source = replicas[0]["url"]
+    root = tracing.start_span("ec.encode", volume=vid, mode=mode)
+    if timings is not None:
+        timings["mode"] = mode
+    try:
+        # 1. freeze every replica, recording each holder's OWN prior
+        # state (not the master's heartbeat-delayed view) so a failure
+        # thaws exactly what this command froze
+        froze: List[str] = []
+        for r in replicas:
+            out = env.node_post(r["url"],
+                                f"/admin/volume/readonly?volume={vid}")
+            if not (out or {}).get("was_readonly"):
+                froze.append(r["url"])
+        assignment = balanced_ec_distribution(_free_nodes(env))
+        by_node: Dict[str, List[int]] = {}
+        for sid, url in enumerate(assignment):
+            by_node.setdefault(url, []).append(sid)
+        try:
+            # 2+3. encode + spread + mount
+            if mode == "copy":
+                _encode_spread_copy(env, vid, collection, source,
+                                    by_node, timings)
+            else:
+                try:
+                    _encode_spread_streaming(env, vid, collection,
+                                             source, assignment,
+                                             timings)
+                except HttpError as e:
+                    env.write(f"volume {vid}: streaming encode failed "
+                              f"({e.status}); falling back to copy mode")
+                    root.tags["fallback"] = "copy"
+                    _cleanup_partial_encode(env, vid, collection,
+                                            set(assignment) | {source})
+                    _encode_spread_copy(env, vid, collection, source,
+                                        by_node, timings)
+        except BaseException as e:
+            _cleanup_partial_encode(env, vid, collection,
+                                    set(assignment) | {source})
+            for url in froze:
+                try:
+                    env.node_post(url,
+                                  f"/admin/volume/readonly?volume={vid}"
+                                  f"&readonly=false")
+                except HttpError:
+                    pass
+            root.tags.setdefault("error", type(e).__name__)
+            raise
+        # 5. drop the original volume everywhere
+        for r in replicas:
+            env.node_post(r["url"], f"/admin/delete_volume?volume={vid}")
+        if timings is not None:
+            timings["trace_id"] = root.trace_id
+    finally:
+        tracing.finish_span(root)
+    env.write(f"volume {vid}: ec encoded, original removed")
 
-    # 1. freeze every replica
-    for r in replicas:
-        env.node_post(r["url"], f"/admin/volume/readonly?volume={vid}")
-    # 2. generate shards on the source
+
+def _cleanup_partial_encode(env: CommandEnv, vid: int, collection: str,
+                            nodes):
+    """Best-effort removal of every shard file and ``.part`` stage a
+    failed encode may have left on any involved node."""
+    all_shards = ",".join(map(str, range(TOTAL_SHARDS)))
+    for url in nodes:
+        try:
+            env.node_post(url, f"/admin/ec/delete_shards?volume={vid}"
+                               f"&collection={collection}"
+                               f"&shards={all_shards}")
+        except HttpError:
+            pass
+
+
+def _encode_spread_streaming(env: CommandEnv, vid: int, collection: str,
+                             source: str, assignment: List[str],
+                             timings: Dict = None):
+    """One POST: the source encodes and pushes each shard's slab ranges
+    to its assigned holder while later slabs encode. Afterwards only
+    the KB-scale index sidecars (.ecx/.vif) are copied to remote
+    holders, then every holder mounts its shards."""
+    import time as _time
+    from ..util.fanout import fan_out_must_succeed
+    spares = [n["url"] for n in _free_nodes(env)
+              if n["url"] not in assignment]
+    t0 = _time.perf_counter()
+    out = env.node_post(
+        source, f"/admin/ec/generate?volume={vid}"
+                f"&collection={collection}",
+        body={"assignment": {str(s): u
+                             for s, u in enumerate(assignment)},
+              "spares": spares})
+    wall = _time.perf_counter() - t0
+    stats = out.get("stats") or {}
+    # re-group by the FINAL placement: failover may have moved a dead
+    # target's shards to a spare ('' = the source kept them)
+    final = {int(s): (u or source)
+             for s, u in (out.get("assignment") or {}).items()}
+    if not final:
+        final = dict(enumerate(assignment))
+    by_node: Dict[str, List[int]] = {}
+    for sid in sorted(final):
+        by_node.setdefault(final[sid], []).append(sid)
+    env.write(f"volume {vid}: streamed {len(final)} shards from "
+              f"{source} (encode {stats.get('encode_busy_s', 0.0)}s ∥ "
+              f"spread {stats.get('spread_busy_s', 0.0)}s, overlap "
+              f"{stats.get('overlap_frac', 0.0)})")
+
+    def mount(target):
+        url, shards = target
+        s = ",".join(map(str, shards))
+        if url != source:
+            # shard bytes are already there — pull only the sidecars
+            env.node_post(url, f"/admin/ec/copy?volume={vid}"
+                               f"&collection={collection}"
+                               f"&source={source}&shards="
+                               f"&copy_ecx=true")
+        env.node_post(url, f"/admin/ec/mount?volume={vid}"
+                           f"&collection={collection}&shards={s}")
+        return s
+
+    for (url, _), s in zip(
+            by_node.items(),
+            fan_out_must_succeed(mount, list(by_node.items()),
+                                 what=f"ec shard mount for volume {vid}",
+                                 dedicated=True)):
+        env.write(f"volume {vid}: shards {s} -> {url}")
+    if source not in by_node:
+        # the source kept no shards: drop its now-orphan index sidecars
+        env.node_post(source, f"/admin/ec/delete_shards?volume={vid}"
+                              f"&collection={collection}&shards=")
+    if timings is not None:
+        timings["encode_wall_s"] = \
+            timings.get("encode_wall_s", 0) + wall
+        _merge_rebuild_stats(timings, out)
+
+
+def _encode_spread_copy(env: CommandEnv, vid: int, collection: str,
+                        source: str, by_node: Dict[str, List[int]],
+                        timings: Dict = None):
+    """Legacy two-phase flow: generate all 14 shards on the source,
+    then every target pulls + mounts its shards concurrently (reference
+    parallelCopyEcShardsFromSource, command_ec_encode.go:200-235:
+    goroutine per target server)."""
+    import time as _time
+    from ..util.fanout import fan_out_must_succeed
+    t0 = _time.perf_counter()
     env.node_post(source, f"/admin/ec/generate?volume={vid}"
                           f"&collection={collection}")
-    env.write(f"volume {vid}: generated 14 shards on {source}")
-    # 3. spread — every target pulls + mounts its shards concurrently
-    # (reference parallelCopyEcShardsFromSource,
-    # command_ec_encode.go:200-235: goroutine per target server)
-    from ..util.fanout import fan_out_must_succeed
-    assignment = balanced_ec_distribution(_free_nodes(env))
-    by_node: Dict[str, List[int]] = {}
-    for sid, url in enumerate(assignment):
-        by_node.setdefault(url, []).append(sid)
+    t1 = _time.perf_counter()
+    env.write(f"volume {vid}: generated {TOTAL_SHARDS} shards on "
+              f"{source}")
 
     def spread(target):
         url, shards = target
@@ -142,10 +300,15 @@ def do_ec_encode(env: CommandEnv, vid: int):
         env.node_post(source, f"/admin/ec/delete_shards?volume={vid}"
                               f"&collection={collection}"
                               f"&shards={','.join(map(str, extra))}")
-    # 5. drop the original volume everywhere
-    for r in replicas:
-        env.node_post(r["url"], f"/admin/delete_volume?volume={vid}")
-    env.write(f"volume {vid}: ec encoded, original removed")
+    t2 = _time.perf_counter()
+    if timings is not None:
+        timings["encode_busy_s"] = \
+            timings.get("encode_busy_s", 0) + (t1 - t0)
+        timings["spread_busy_s"] = \
+            timings.get("spread_busy_s", 0) + (t2 - t1)
+        timings["encode_wall_s"] = \
+            timings.get("encode_wall_s", 0) + (t2 - t0)
+        timings.setdefault("overlap_frac", 0.0)
 
 
 @command("ec.rebuild",

@@ -293,6 +293,49 @@ def observe_gather(stats: Dict):
         VOLUME_EC_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
 
 
+# -- streaming spread (ec/spread.py via observe_spread) ----------------------
+
+VOLUME_EC_SPREAD_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_spread_total",
+    "Streaming-encode spread events by kind (bytes, sends, stripes, "
+    "retries, failovers).",
+    labels=("kind",))
+VOLUME_EC_SPREAD_SECONDS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_spread_seconds_total",
+    "Cumulative spread busy time (union of in-flight send intervals) "
+    "across streaming encodes.")
+VOLUME_EC_SPREAD_MBPS_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_spread_mbps",
+    "Effective shard placement bandwidth of the last streaming encode "
+    "(pushed bytes / busy seconds).")
+VOLUME_EC_ENCODE_OVERLAP_FRAC_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_encode_overlap_frac",
+    "Encode/spread overlap of the last streaming encode: "
+    "(serialized_estimate - wall) / serialized_estimate, 0..1.")
+
+
+def observe_spread(stats: Dict):
+    """Export one streaming encode's spread stats (the dict filled by
+    ec.encoder.write_ec_files_spread) onto the volume registry."""
+    if not stats:
+        return
+    for kind, key in (("bytes", "spread_bytes"),
+                      ("sends", "spread_sends"),
+                      ("stripes", "spread_stripes"),
+                      ("retries", "spread_retries"),
+                      ("failovers", "spread_failovers")):
+        n = stats.get(key)
+        if n:
+            VOLUME_EC_SPREAD_COUNTER.inc(kind, amount=n)
+    busy = stats.get("spread_busy_s")
+    if busy:
+        VOLUME_EC_SPREAD_SECONDS.inc(amount=busy)
+    if "spread_mbps" in stats:
+        VOLUME_EC_SPREAD_MBPS_GAUGE.set(stats["spread_mbps"])
+    if "overlap_frac" in stats:
+        VOLUME_EC_ENCODE_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
+
+
 class SmallDispatchTuner:
     """Fits the host/device crossover from the first-N reconstruct
     spans: device dispatch time is modeled as a + b*bytes (fixed
@@ -364,6 +407,11 @@ def observe_span(span_dict: Dict):
             suggestion = SMALL_DISPATCH_TUNER.add(path, nbytes, dur)
             if suggestion:
                 SMALL_DISPATCH_SUGGESTED_GAUGE.set(suggestion)
+                # opt-in auto-apply: feed the fitted crossover back
+                # into the live hybrid threshold instead of only
+                # publishing it
+                from ..ops.codec import maybe_auto_apply_small_dispatch
+                maybe_auto_apply_small_dispatch(suggestion)
 
 
 def start_push_loop(registry: Registry, gateway_url: str,

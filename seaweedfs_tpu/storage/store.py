@@ -159,6 +159,69 @@ class Store:
                        "offset_width": v.offset_width}, f)
         return base
 
+    def generate_ec_shards_streaming(self, vid: int, collection: str = "",
+                                     assignment: Dict[int, str] = None,
+                                     spares: List[str] = None,
+                                     window: Optional[int] = None,
+                                     stats: dict = None):
+        """Streaming encode+spread: encode the readonly volume and push
+        each shard's slab ranges to its assigned holder while later
+        slabs are still encoding (ec/spread.py). ``assignment`` maps
+        shard id -> holder url; shards assigned to this server (or
+        unassigned) are written locally. Returns ``(base, final)``
+        where ``final`` is the post-failover placement ({sid: url, ''
+        for local}). On ANY failure every holder's ``.part`` stage is
+        aborted and local outputs removed — no partial shards survive.
+
+        Only the shards this server keeps (plus .ecx/.vif) touch its
+        disk; remote-bound shards stream straight from the encode."""
+        from ..ec import spread
+        from ..util import tracing
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        if not v.readonly:
+            raise VolumeError(f"volume {vid} must be readonly for ec encode")
+        base = v.file_name()
+        assignment = {int(s): u for s, u in (assignment or {}).items()}
+        sstats = spread.SpreadStats()
+        total = self.codec.total if self.codec is not None else TOTAL_SHARDS
+        # same slab policy as the streaming gather: shrink the stripe
+        # so even a near-slab-sized shard gives the spread several
+        # stripes to overlap with the encode (slab only batches device
+        # columns — shard bytes are invariant under it)
+        from ..ec.gather import auto_slab
+        slab = auto_slab(ec_encoder.ec_shard_base_size(
+            os.path.getsize(base + ".dat")))
+        with tracing.span("ec.encode.stream", volume=vid) as root:
+            ec_encoder.write_sorted_file_from_idx(base)
+            sink = spread.StripedSpreadSink(
+                vid, base, assignment, total, collection=collection,
+                local_url=self.public_url, spares=spares,
+                window=window, stats=sstats, parent_span=root)
+            try:
+                ec_encoder.write_ec_files_spread(
+                    base, sink, codec=self.codec, slab=slab, stats=stats)
+            except BaseException:
+                # the sink already aborted every holder's stage; drop
+                # anything the local fast path finalized plus the index
+                for i in range(total):
+                    for p in (base + to_ext(i), base + to_ext(i) + ".part"):
+                        try:
+                            os.remove(p)
+                        except OSError:
+                            pass
+                try:
+                    os.remove(base + ".ecx")
+                except OSError:
+                    pass
+                raise
+            import json
+            with open(base + ".vif", "w") as f:
+                json.dump({"version": v.version,
+                           "offset_width": v.offset_width}, f)
+        return base, sink.assignment()
+
     def mount_ec_shards(self, vid: int, collection: str,
                         shard_ids: List[int]) -> List[int]:
         mounted = []

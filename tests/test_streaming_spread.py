@@ -1,0 +1,545 @@
+"""Streaming EC encode+spread (ISSUE: push shard stripes to their
+holders while later slabs are still encoding): the chunked
+`/admin/ec/shard_write` protocol (append-at-expected-offset, `.part`
+staging, atomic finalize), stream-vs-copy shard bit-identity across
+backends, the bounded per-target send window, all-or-nothing failure
+cleanup, dead-target failover to a spare, the end-to-end streaming
+`ec.encode -mode stream` over a live 3-server cluster, plus the
+satellites: `/admin/ec/to_volume` roundtrip, SmallDispatchTuner opt-in
+auto-apply, and the bench device-init retry cap/backoff."""
+
+import hashlib
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import to_ext, write_ec_files
+from seaweedfs_tpu.ec.encoder import write_ec_files_spread
+from seaweedfs_tpu.ec.spread import (SpreadError, SpreadStats,
+                                     StripedSpreadSink, spread_window)
+from seaweedfs_tpu.ops.codec import NumpyCodec
+from seaweedfs_tpu.server.http_util import (HttpError, HttpServer,
+                                            Router, http_call,
+                                            post_chunked, post_json)
+
+LOCAL = "src.invalid:0"   # pseudo-url of the encoding source
+
+
+# -- window env knob ---------------------------------------------------------
+
+def test_spread_window_env(monkeypatch):
+    monkeypatch.delenv("SW_EC_SPREAD_WINDOW", raising=False)
+    assert spread_window() == 4
+    monkeypatch.setenv("SW_EC_SPREAD_WINDOW", "2")
+    assert spread_window() == 2
+    monkeypatch.setenv("SW_EC_SPREAD_WINDOW", "0")
+    assert spread_window() == 1     # floor, never unbounded-at-zero
+    monkeypatch.setenv("SW_EC_SPREAD_WINDOW", "junk")
+    assert spread_window() == 4
+
+
+# -- fake target: the shard_write staging protocol ---------------------------
+
+class FakeTarget:
+    """Minimal holder implementing /admin/ec/shard_write against a flat
+    directory of {vid}.ecNN files, with injectable delay/failure for
+    the failover and abort drills. Counts every append it answers."""
+
+    def __init__(self, directory):
+        self.dir = directory
+        self.delay = 0.0
+        self.fail = False
+        self.fail_after = None      # appends accepted before dying
+        self.appends = 0
+        self.finalized = 0
+        self.aborted = 0
+        self._lock = threading.Lock()
+        router = Router()
+        router.add("POST", "/admin/ec/shard_write", self._shard_write)
+        self.server = HttpServer(0, router).start()
+        self.url = f"127.0.0.1:{self.server.port}"
+
+    def _path(self, vid, sid):
+        return os.path.join(self.dir, f"{vid}{to_ext(sid)}")
+
+    def _shard_write(self, req):
+        vid = int(req.query["volume"])
+        action = req.query.get("action", "append")
+        if action == "abort":
+            req.drain()
+            with self._lock:
+                self.aborted += 1
+            removed = []
+            for f in os.listdir(self.dir):
+                if f.endswith(".part"):
+                    os.remove(os.path.join(self.dir, f))
+                    removed.append(f)
+            return {"volume": vid, "aborted": removed}
+        sid = int(req.query["shard"])
+        part = self._path(vid, sid) + ".part"
+        if action == "finalize":
+            req.drain()
+            size = int(req.query["size"])
+            staged = os.path.getsize(part) if os.path.exists(part) else -1
+            if staged != size:
+                raise HttpError(409, f"shard {sid} staged={staged} "
+                                     f"expected={size}")
+            os.replace(part, self._path(vid, sid))
+            with self._lock:
+                self.finalized += 1
+            return {"volume": vid, "shard": sid, "finalized": True}
+        if self.delay:
+            time.sleep(self.delay)
+        with self._lock:
+            self.appends += 1
+            n_seen = self.appends
+        if self.fail or (self.fail_after is not None
+                         and n_seen > self.fail_after):
+            _ = req.body
+            raise HttpError(503, "injected target failure")
+        off = int(req.query.get("offset", "0"))
+        staged = os.path.getsize(part) if os.path.exists(part) else 0
+        if off != staged and off != 0:
+            _ = req.body
+            raise HttpError(409, f"shard {sid} offset mismatch: "
+                                 f"staged={staged} offset={off}")
+        data = req.body
+        with open(part, "wb" if off == 0 else "ab") as f:
+            f.write(data)
+            staged = f.tell()
+        return {"volume": vid, "shard": sid, "staged": staged}
+
+    def stop(self):
+        self.server.stop()
+
+
+ENC = dict(large_block=64 << 10, small_block=16 << 10, slab=16 << 10)
+
+
+def _seed_oracle(dirpath, codec, nbytes, seed=7):
+    """Write 1.dat in dirpath, encode it in a sibling oracle dir with
+    the same codec/geometry, return (base, {sid: sha256})."""
+    rng = np.random.default_rng(seed)
+    payload = rng.integers(0, 256, nbytes, dtype=np.uint8).tobytes()
+    base = os.path.join(str(dirpath), "1")
+    with open(base + ".dat", "wb") as f:
+        f.write(payload)
+    odir = str(dirpath) + ".oracle"
+    os.makedirs(odir, exist_ok=True)
+    obase = os.path.join(odir, "1")
+    shutil.copy(base + ".dat", obase + ".dat")
+    write_ec_files(obase, codec=codec, **ENC)
+    digests = {}
+    for i in range(codec.total):
+        with open(obase + to_ext(i), "rb") as f:
+            digests[i] = hashlib.sha256(f.read()).hexdigest()
+    return base, digests
+
+
+def _digest(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+# -- stream == copy, mixed local+remote, all backends ------------------------
+
+@pytest.mark.parametrize("backend", ["numpy", "tpu", "mesh"])
+def test_stream_vs_copy_bit_identical(tmp_path, backend):
+    if backend == "tpu":
+        from seaweedfs_tpu.ops.rs_tpu import TpuCodec as Codec
+    elif backend == "mesh":
+        from seaweedfs_tpu.parallel.mesh_codec import MeshCodec as Codec
+    else:
+        Codec = NumpyCodec
+    k, m = 6, 3
+    codec = Codec(k, m)
+    src = tmp_path / "src"
+    src.mkdir()
+    base, oracle = _seed_oracle(src, codec, 6 * (64 << 10) + 70_001)
+    t1dir, t2dir = tmp_path / "t1", tmp_path / "t2"
+    t1dir.mkdir()
+    t2dir.mkdir()
+    a, b = FakeTarget(str(t1dir)), FakeTarget(str(t2dir))
+    try:
+        remote = {1: a.url, 4: a.url, 7: a.url, 2: b.url, 8: b.url}
+        assignment = {sid: remote.get(sid, LOCAL) for sid in range(k + m)}
+        stats = {}
+        sink = StripedSpreadSink(1, base, assignment, k + m,
+                                 local_url=LOCAL, window=2)
+        write_ec_files_spread(base, sink, codec=codec, stats=stats,
+                              **ENC)
+        # every shard bit-identical to the copy-mode oracle, each at its
+        # holder, and remote-bound shards never touched the source disk
+        for sid in range(k + m):
+            holder = {a.url: str(t1dir), b.url: str(t2dir)}.get(
+                remote.get(sid), str(src))
+            assert _digest(os.path.join(holder, f"1{to_ext(sid)}")) \
+                == oracle[sid], f"shard {sid} diverged"
+        for sid in remote:
+            assert not os.path.exists(base + to_ext(sid))
+        for d in (str(src), str(t1dir), str(t2dir)):
+            assert not [f for f in os.listdir(d) if f.endswith(".part")]
+        assert stats["spread_remote_shards"] == len(remote)
+        assert stats["spread_stripes"] >= 4
+        assert stats["spread_bytes"] == stats["shard_size"] * (k + m)
+        assert 0.0 <= stats["overlap_frac"] <= 1.0
+        assert sink.assignment()[1] == a.url
+        assert sink.assignment()[0] == ""
+    finally:
+        a.stop()
+        b.stop()
+
+
+# -- bounded send window (satellite: memory stays O(window*slab)) ------------
+
+def test_bounded_send_window(tmp_path):
+    k, m, window = 6, 3, 1
+    codec = NumpyCodec(k, m)
+    src = tmp_path / "src"
+    src.mkdir()
+    n_stripes = 10
+    base, oracle = _seed_oracle(src, codec, k * (16 << 10) * n_stripes)
+    tdir = tmp_path / "t"
+    tdir.mkdir()
+    tgt = FakeTarget(str(tdir))
+    tgt.delay = 0.02        # slow holder: the encode must wait, not buffer
+    try:
+        assignment = {sid: tgt.url for sid in range(k + m)}
+        stats = {}
+        sink = StripedSpreadSink(1, base, assignment, k + m,
+                                 local_url=LOCAL, window=window)
+        write_ec_files_spread(base, sink, codec=codec, stats=stats,
+                              **ENC)
+        for sid in range(k + m):
+            assert _digest(os.path.join(str(tdir), f"1{to_ext(sid)}")) \
+                == oracle[sid]
+        # queued + in-hand batch + the stripe being routed — never the
+        # whole volume (which is n_stripes windows deep)
+        slab = ENC["slab"]
+        assert stats["peak_spread_buffer"] <= \
+            (2 * window + 1) * (k + m) * slab
+        assert stats["peak_spread_buffer"] < stats["spread_bytes"] // 2
+        assert stats["spread_stripes"] == n_stripes
+        # a stalled spread shows up as encode-side blocked time, not as
+        # phantom encode work: busy encode <= wall
+        assert sink.blocked_s > 0
+    finally:
+        tgt.stop()
+
+
+# -- all-or-nothing on mid-stream death --------------------------------------
+
+def test_midstream_failure_leaves_no_partials(tmp_path):
+    k, m = 6, 3
+    codec = NumpyCodec(k, m)
+    src = tmp_path / "src"
+    src.mkdir()
+    base, _ = _seed_oracle(src, codec, k * (16 << 10) * 8)
+    tdir = tmp_path / "t"
+    tdir.mkdir()
+    tgt = FakeTarget(str(tdir))
+    tgt.fail_after = 2      # dies after acking two appends: unreplayable
+    try:
+        assignment = {sid: tgt.url if sid in (3, 5) else LOCAL
+                      for sid in range(k + m)}
+        sink = StripedSpreadSink(1, base, assignment, k + m,
+                                 local_url=LOCAL, window=1)
+        with pytest.raises(SpreadError):
+            write_ec_files_spread(base, sink, codec=codec, **ENC)
+        # no finalized shards and no .part stages anywhere — the failed
+        # spread is invisible on every disk
+        for d in (str(src), str(tdir)):
+            leftovers = [f for f in os.listdir(d)
+                         if ".ec" in f or f.endswith(".part")]
+            assert leftovers == [], f"{d}: {leftovers}"
+        assert tgt.aborted >= 1
+    finally:
+        tgt.stop()
+
+
+# -- failover: dead-at-first-contact target -> spare -------------------------
+
+def test_failover_reassigns_dead_target(tmp_path):
+    k, m = 6, 3
+    codec = NumpyCodec(k, m)
+    src = tmp_path / "src"
+    src.mkdir()
+    base, oracle = _seed_oracle(src, codec, k * (16 << 10) * 6)
+    ddir, sdir = tmp_path / "dead", tmp_path / "spare"
+    ddir.mkdir()
+    sdir.mkdir()
+    dead, spare = FakeTarget(str(ddir)), FakeTarget(str(sdir))
+    dead.fail = True
+    try:
+        assignment = {sid: dead.url if sid in (7, 8) else LOCAL
+                      for sid in range(k + m)}
+        stats = {}
+        sink = StripedSpreadSink(1, base, assignment, k + m,
+                                 local_url=LOCAL,
+                                 spares=[spare.url], window=2)
+        write_ec_files_spread(base, sink, codec=codec, stats=stats,
+                              **ENC)
+        # the dead target's shards landed complete on the spare, and the
+        # final placement reports the move
+        for sid in (7, 8):
+            assert _digest(os.path.join(str(sdir), f"1{to_ext(sid)}")) \
+                == oracle[sid]
+            assert sink.assignment()[sid] == spare.url
+        assert stats["spread_failovers"] == 1
+        assert not os.listdir(str(ddir))
+    finally:
+        dead.stop()
+        spare.stop()
+
+
+# -- the real endpoint: append / 409 / finalize / abort ----------------------
+
+def test_shard_write_endpoint(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = VolumeServer(port=0, directories=[str(tmp_path / "v")],
+                      master_url=master.url, pulse_seconds=1,
+                      max_volume_counts=[5], ec_backend="numpy").start()
+    try:
+        import json
+        url = f"http://{vs.url}/admin/ec/shard_write?volume=77&shard=0"
+        p1, p2 = b"x" * 70_000, b"y" * 30_000
+        out = json.loads(post_chunked(f"{url}&offset=0",
+                                      [p1[:40_000], p1[40_000:]]))
+        assert out["staged"] == len(p1)
+        # offset mismatch: staged size comes back in the 409 message
+        with pytest.raises(HttpError) as ei:
+            post_chunked(f"{url}&offset=10", [b"z"])
+        assert ei.value.status == 409
+        assert "staged=70000" in str(ei.value)
+        post_chunked(f"{url}&offset={len(p1)}", [p2])
+        # finalize with the wrong size refuses; right size renames
+        with pytest.raises(HttpError) as ei:
+            http_call("POST", f"{url}&action=finalize&size=1")
+        assert ei.value.status == 409
+        http_call("POST",
+                  f"{url}&action=finalize&size={len(p1) + len(p2)}")
+        loc = vs.store.locations[0].directory
+        final = os.path.join(loc, f"77{to_ext(0)}")
+        assert os.path.getsize(final) == len(p1) + len(p2)
+        with open(final, "rb") as f:
+            assert f.read() == p1 + p2
+        # offset 0 truncates: a replayed first range starts clean
+        post_chunked(f"{url.replace('shard=0', 'shard=1')}&offset=0",
+                     [b"a" * 100])
+        post_chunked(f"{url.replace('shard=0', 'shard=1')}&offset=0",
+                     [b"b" * 60])
+        part1 = os.path.join(loc, f"77{to_ext(1)}.part")
+        assert os.path.getsize(part1) == 60
+        # abort drops every stage, leaves finalized shards alone
+        http_call("POST", f"http://{vs.url}/admin/ec/shard_write"
+                          f"?volume=77&action=abort")
+        assert not os.path.exists(part1)
+        assert os.path.exists(final)
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_observe_spread_metrics():
+    from seaweedfs_tpu.stats import metrics
+    before = metrics.VOLUME_EC_SPREAD_COUNTER.value("bytes")
+    metrics.observe_spread({
+        "spread_bytes": 1 << 20, "spread_sends": 9, "spread_stripes": 3,
+        "spread_retries": 1, "spread_failovers": 1,
+        "spread_busy_s": 0.5, "spread_mbps": 88.5,
+        "overlap_frac": 0.61})
+    assert metrics.VOLUME_EC_SPREAD_COUNTER.value("bytes") - before \
+        == 1 << 20
+    assert metrics.VOLUME_EC_ENCODE_OVERLAP_FRAC_GAUGE.value() == 0.61
+    assert metrics.VOLUME_EC_SPREAD_MBPS_GAUGE.value() == 88.5
+    render = metrics.VOLUME_SERVER_GATHER.render()
+    assert 'ec_spread_total{kind="bytes"}' in render
+    assert "ec_encode_overlap_frac" in render
+
+
+# -- end-to-end: streaming ec.encode over a live cluster ---------------------
+
+@pytest.fixture
+def cluster3(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    servers = [
+        VolumeServer(port=0, directories=[str(tmp_path / f"v{i}")],
+                     master_url=master.url, pulse_seconds=1,
+                     max_volume_counts=[30], ec_backend="numpy").start()
+        for i in range(3)]
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _cluster_shard_files(servers):
+    """{sid: [paths]} of every .ecNN file across the cluster."""
+    out = {}
+    for vs in servers:
+        for loc in vs.store.locations:
+            for fname in os.listdir(loc.directory):
+                for sid in range(14):
+                    if fname.endswith(to_ext(sid)):
+                        out.setdefault(sid, []).append(
+                            os.path.join(loc.directory, fname))
+    return out
+
+
+def test_cluster_streaming_encode_end_to_end(cluster3, tmp_path):
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.shell.command_env import CommandEnv
+    from seaweedfs_tpu.shell.command_ec import do_ec_encode
+    import io
+    master, servers = cluster3
+    rng = np.random.default_rng(11)
+    fid = None
+    for i in range(12):
+        data = rng.integers(0, 256, 150_000).astype(np.uint8).tobytes()
+        fid = op.upload_data(master.url, data, filename=f"f{i}",
+                             collection="sp")
+    vid = int(fid.split(",")[0])
+    env = CommandEnv(master.url, out=io.StringIO())
+
+    # numpy oracle BEFORE the encode (the original volume is deleted
+    # after): encode a copy of the source .dat with the same geometry
+    src_vs = next(vs for vs in servers
+                  if vs.store.find_volume(vid) is not None)
+    src_base = src_vs.store.find_volume(vid).file_name()
+    odir = tmp_path / "oracle"
+    odir.mkdir()
+    obase = str(odir / "o")
+    shutil.copy(src_base + ".dat", obase + ".dat")
+    write_ec_files(obase, codec=NumpyCodec(10, 4), pipelined=False)
+    oracle = {sid: _digest(obase + to_ext(sid)) for sid in range(14)}
+
+    timings = {}
+    do_ec_encode(env, vid, mode="stream", timings=timings)
+    shell_log = env.out.getvalue()
+    assert "streamed 14 shards" in shell_log
+    assert timings["mode"] == "stream"
+    assert "overlap_frac" in timings
+    assert timings["spread_stripes"] >= 1
+    assert timings["spread_bytes"] > 0
+    assert "trace_id" in timings
+
+    # every shard exists EXACTLY once cluster-wide, bit-identical to the
+    # oracle, spread across all 3 nodes, with no .part stages left
+    files = _cluster_shard_files(servers)
+    assert sorted(files) == list(range(14))
+    for sid, paths in files.items():
+        assert len(paths) == 1, f"shard {sid} on several nodes: {paths}"
+        assert _digest(paths[0]) == oracle[sid], f"shard {sid} diverged"
+    holders = {os.path.dirname(p) for paths in files.values()
+               for p in paths}
+    assert len(holders) == 3
+    for vs in servers:
+        for loc in vs.store.locations:
+            assert not [f for f in os.listdir(loc.directory)
+                        if f.endswith(".part")]
+        # the original volume is gone everywhere
+        assert vs.store.find_volume(vid) is None
+
+    # overlap telemetry is exported on /metrics
+    body = http_call("GET", f"http://{src_vs.url}/metrics").decode()
+    assert "ec_encode_overlap_frac" in body
+    assert 'ec_spread_total{kind="bytes"}' in body
+
+    # the cluster serves the data through EC reads
+    assert http_call("GET", f"http://{servers[0].url}/{fid}") == data
+
+    # decode satellite: pull all data shards onto one node and turn the
+    # streamed shards back into a normal volume
+    target = servers[0]
+    info = env.ec_volumes()[str(vid)]
+    shard_urls = {int(s): urls for s, urls in info["shards"].items()}
+    held = set(target.store.find_ec_volume(vid).shard_ids()
+               if target.store.find_ec_volume(vid) else [])
+    for sid in range(10):
+        if sid not in held:
+            post_json(f"http://{target.url}/admin/ec/copy?volume={vid}"
+                      f"&collection=sp&source={shard_urls[sid][0]}"
+                      f"&shards={sid}")
+    post_json(f"http://{target.url}/admin/ec/mount?volume={vid}"
+              f"&collection=sp&shards="
+              f"{','.join(str(s) for s in range(10) if s not in held)}")
+    out = post_json(f"http://{target.url}/admin/ec/to_volume?volume={vid}"
+                    f"&collection=sp")
+    assert out["volume"] == vid
+    assert target.store.find_volume(vid) is not None
+    assert http_call("GET", f"http://{target.url}/{fid}") == data
+
+
+# -- satellite: SmallDispatchTuner opt-in auto-apply -------------------------
+
+def test_small_dispatch_auto_apply(monkeypatch):
+    from seaweedfs_tpu.ops import codec as codec_mod
+    from seaweedfs_tpu.stats import metrics
+
+    def feed_spans():
+        # fresh tuner: the global one may be saturated by other tests
+        monkeypatch.setattr(metrics, "SMALL_DISPATCH_TUNER",
+                            metrics.SmallDispatchTuner())
+        for b in (1e4, 2e4, 3e4, 4e4):      # host: flat 1e8 B/s
+            metrics.observe_span({"name": "reconstruct",
+                                  "duration_s": b / 1e8,
+                                  "tags": {"path": "host", "bytes": b}})
+        for b in (1e6, 2e6, 4e6, 8e6):      # device: 1ms fixed + 1e-10/B
+            metrics.observe_span({"name": "reconstruct",
+                                  "duration_s": 1e-3 + 1e-10 * b,
+                                  "tags": {"path": "device",
+                                           "bytes": b}})
+
+    codec_mod.set_small_dispatch_override(None)
+    try:
+        # without the opt-in the suggestion is published but NOT applied
+        monkeypatch.delenv("SW_EC_SMALL_DISPATCH_AUTO", raising=False)
+        feed_spans()
+        assert metrics.SMALL_DISPATCH_SUGGESTED_GAUGE.value() > 0
+        assert codec_mod.small_dispatch_override() is None
+
+        monkeypatch.setenv("SW_EC_SMALL_DISPATCH_AUTO", "1")
+        feed_spans()
+        applied = codec_mod.small_dispatch_override()
+        assert applied is not None
+        # the fitted crossover (~1e-3 / (1e-8 - 1e-10) ~ 101kB) landed
+        # inside the clamp and now IS the live threshold
+        assert (64 << 10) <= applied <= (8 << 20)
+        assert codec_mod.small_dispatch_default() == applied
+    finally:
+        codec_mod.set_small_dispatch_override(None)
+
+
+# -- satellite: bench device-init retries are capped + backed off ------------
+
+def test_bench_device_init_retry_cap(monkeypatch):
+    import bench
+    monkeypatch.setenv("SW_BENCH_DEVICE_INIT_RETRIES", "3")
+    monkeypatch.setenv("SW_BENCH_INIT_RETRY_SPACING", "0.01")
+    monkeypatch.setenv("SW_BENCH_INIT_RETRY_MAX_SPACING", "0.02")
+    monkeypatch.setattr(bench, "init_device", lambda timeout_s: None)
+    retry_log = []
+    assert bench.init_device_retrying(retry_log) is None
+    attempts = [e for e in retry_log if "attempt" in e]
+    assert len(attempts) == 3           # capped, not the old fixed six
+    assert all(not e["ok"] for e in attempts)
+    # exponential backoff, clamped at the max, and NOT slept after the
+    # final attempt
+    assert [e.get("backoff_s") for e in attempts] == [0.01, 0.02, None]
+    # the CPU-fallback verdict is in the artifact immediately
+    assert retry_log[-1]["fallback"] == "cpu"
+    assert retry_log[-1]["after_attempts"] == 3
+
+    monkeypatch.setattr(bench, "init_device",
+                        lambda timeout_s: ["dev0"])
+    retry_log = []
+    assert bench.init_device_retrying(retry_log) == ["dev0"]
+    assert len(retry_log) == 1 and retry_log[0]["ok"]

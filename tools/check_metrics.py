@@ -42,8 +42,38 @@ REQUIRED_FAMILIES = {
         "SeaweedFS_volumeServer_ec_gather_mbps",
         "SeaweedFS_volumeServer_ec_overlap_frac",
         "SeaweedFS_volumeServer_http_pool_churn_total",
+        "SeaweedFS_volumeServer_ec_spread_total",
+        "SeaweedFS_volumeServer_ec_spread_seconds_total",
+        "SeaweedFS_volumeServer_ec_spread_mbps",
+        "SeaweedFS_volumeServer_ec_encode_overlap_frac",
     ),
 }
+
+# every EC admin route registered on the volume server must appear as a
+# literal path in at least one test: an unexercised route is dead code
+# at best and an untested failure mode at worst
+EC_ROUTE_RE = re.compile(
+    r'router\.add\(\s*"(?:GET|POST|\*)"\s*,\s*\n?\s*"(/admin/ec/[^"]+)"')
+
+
+def check_route_coverage(repo_root: str) -> list:
+    vs_py = os.path.join(repo_root, "seaweedfs_tpu", "server",
+                         "volume_server.py")
+    with open(vs_py, encoding="utf-8") as f:
+        routes = EC_ROUTE_RE.findall(f.read())
+    if not routes:
+        return [f"route-coverage: no /admin/ec/ routes found in {vs_py}"]
+    tests_dir = os.path.join(repo_root, "tests")
+    corpus = []
+    for name in sorted(os.listdir(tests_dir)):
+        if name.endswith(".py"):
+            with open(os.path.join(tests_dir, name),
+                      encoding="utf-8") as f:
+                corpus.append(f.read())
+    blob = "\n".join(corpus)
+    return [f"route-coverage: {route} is registered in "
+            f"volume_server.py but no test references it"
+            for route in routes if route not in blob]
 
 
 def check_required(role: str, registry) -> list:
@@ -113,6 +143,8 @@ def main() -> int:
         problems += check_registry(role, reg)
         problems += check_render(role, reg)
         problems += check_required(role, reg)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    problems += check_route_coverage(repo_root)
     if problems:
         for p in problems:
             print(f"check_metrics: {p}", file=sys.stderr)
