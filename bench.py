@@ -656,6 +656,36 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         # two-dispatch slabs shows here before it shows in wall time
         stream_s = timings.get("stream_s", 0.0)
         survivor_bytes = timings.get("survivor_bytes", 0)
+
+        # -- single-shard repair drill: the overwhelmingly common
+        # failure at fleet scale. Destroy exactly ONE shard and rebuild
+        # with -repair auto — the trace path ships projected sub-shard
+        # symbols from all survivors, so repair_bytes_frac must land
+        # well under 1.0 (the k*shard full-gather baseline).
+        shard_map2 = poll(
+            lambda: (lambda m: m if set(m) == set(range(TOTAL))
+                     else None)(lookup_shards()),
+            "all shards back at the master before the repair drill")
+        lone_sid = sorted(shard_map2)[0]
+        lone_holder = shard_map2[lone_sid][0]
+        post_json(f"http://{lone_holder}/admin/ec/unmount?volume={vid}"
+                  f"&shards={lone_sid}")
+        post_json(f"http://{lone_holder}/admin/ec/delete_shards"
+                  f"?volume={vid}&collection=bench&shards={lone_sid}")
+        shard_map2 = poll(
+            lambda: (lambda m: m if lone_holder not in
+                     m.get(lone_sid, [lone_holder]) else None)(
+                lookup_shards()),
+            "single-shard loss at the master")
+        repair_timings = {}
+        t_repair = time.perf_counter()
+        do_ec_rebuild(env, vid, "bench", shard_map2, [lone_sid],
+                      timings=repair_timings, repair="auto")
+        repair_wall_s = time.perf_counter() - t_repair
+        ok = ok and set(poll(
+            lambda: (lambda m: m if set(m) == set(range(TOTAL))
+                     else None)(lookup_shards()),
+            "all shards back after the repair drill")) == set(range(TOTAL))
         out = {"servers": n_servers, "volume_mb": size_mb,
                "backend": backend, "lost_shards": len(lost),
                "encode_spread_s": round(encode_s, 1),
@@ -702,6 +732,17 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                # /admin/traces?trace=<id>
                "phases": timings.get("phases", {}),
                "trace_id": timings.get("trace_id"),
+               # single-shard repair drill (trace repair vs the k*shard
+               # full-gather baseline; repair_bytes_frac < 1.0 iff the
+               # trace path was taken and paid off)
+               "repair_mode": repair_timings.get("repair_mode", "?"),
+               "repair_bytes_frac": round(
+                   repair_timings.get("repair_bytes_frac", 1.0), 3),
+               "repair_mbps": round(
+                   repair_timings.get("repair_mbps", 0.0), 1),
+               "repair_wall_s": round(repair_wall_s, 2),
+               "repair_helpers": repair_timings.get("repair_helpers", 0),
+               "repair_fallback": repair_timings.get("repair_fallback"),
                "all_shards_restored": ok}
         log(f"cluster rebuild: {out}")
         return out

@@ -1,4 +1,5 @@
-"""EC shard files -> volume (.ec00-09 -> .dat, .ecx+.ecj -> .idx).
+"""EC shard files -> volume (.ec00-09 -> .dat, .ecx+.ecj -> .idx),
+plus the trace-repair combine for single-lost-shard rebuild.
 
 Reference ec_decoder.go: decoding back to a volume is a pure interleave
 copy (no GF math — data shards hold the original bytes); the .idx is the
@@ -10,10 +11,15 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
+from typing import List, Optional
+
+import numpy as np
 
 from ..storage.needle import get_actual_size
 from ..storage.needle_map import bytes_to_entry, entry_to_bytes
 from ..util import tracing
+from ..util.profiling import StageTimer
 from ..storage.super_block import SUPER_BLOCK_SIZE, SuperBlock
 from ..storage.types import NEEDLE_ENTRY_SIZE, NEEDLE_ID_SIZE, \
     TOMBSTONE_FILE_SIZE, bytes_to_needle_id
@@ -128,3 +134,135 @@ def _copy_block(src, offset: int, length: int, dst, buf_size: int):
             return
         dst.write(chunk)
         left -= len(chunk)
+
+
+# ---------------------------------------------------------------------------
+# Trace-repair combine: the rebuilder side of bandwidth-optimal
+# single-shard repair (ops/codec.repair_plan has the scheme math).
+# ---------------------------------------------------------------------------
+
+def rebuild_ec_file_repair(base_name: str, lost_sid: int, source, plan,
+                           codec=None, slab: int = 8 << 20,
+                           pipelined: Optional[bool] = None,
+                           stats: Optional[dict] = None) -> List[int]:
+    """Rebuild ONE lost shard from the trace-repair symbol stream.
+
+    ``source`` is an ec.gather.RepairGatherSource: each stripe arrives
+    as the concatenated packed symbol planes of every helper —
+    ``(plan.total_bits, ceil(w/8))`` uint8. The combine matrix
+    ``plan.combine`` has {0,1} coefficients, and in GF(2^8) multiplying
+    by 1 is the identity while addition is XOR — so the combine IS a
+    GF(2^8) matmul and the existing device kernels (PipelinedMatmul
+    over the codec's device_fn) run it unchanged: one fused dispatch
+    per slab, same as the full-RS decode. The 8 output planes are
+    interleaved back into shard bytes on the host (a packbits
+    transpose) and appended to the lost shard file.
+
+    All-or-nothing like rebuild_ec_files_streaming: any failure removes
+    the partial shard file before propagating, so the caller can fall
+    back to the full streaming gather with a clean slate."""
+    from ..ops import telemetry
+    from ..ops.codec import combine_planes_to_bytes, get_codec
+    from .constants import PARITY_SHARDS
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    if pipelined is None:
+        pipelined = codec.backend in ("tpu", "mesh")
+    if lost_sid != plan.lost:
+        raise ValueError(f"plan repairs shard {plan.lost}, not {lost_sid}")
+    before = telemetry.STATS.snapshot()
+    phases = {"gather": 0.0, "plan": 0.0, "dispatch": 0.0,
+              "drain": 0.0, "write": 0.0}
+    out_path = base_name + to_ext(lost_sid)
+    out = open(out_path, "wb")
+    rebuilt_bytes = 0
+    # plane widths are byte strides: an 8 MB slab arrives as
+    # total_bits x 1 MB planes, so the pipeline buckets on the stride
+    stride_cap = (max(1, int(slab)) + 7) // 8
+    t_stream = time.perf_counter()
+    try:
+        if pipelined:
+            from ..ops.pipeline import PipelinedMatmul
+            ptimer = StageTimer()
+            pm = PipelinedMatmul(plan.combine, max_width=stride_cap,
+                                 codec=codec, timer=ptimer)
+            for meta, _, planes in pm.stream(source.slabs()):
+                _, _, w = meta
+                t0 = time.perf_counter()
+                out.write(combine_planes_to_bytes(planes, w).tobytes())
+                rebuilt_bytes += w
+                phases["write"] += time.perf_counter() - t0
+            phases["gather"] = ptimer.totals.get("read_wait", 0.0)
+            phases["dispatch"] = ptimer.totals.get("h2d", 0.0)
+            phases["drain"] = ptimer.totals.get("drain_wait", 0.0)
+        else:
+            it = source.slabs()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    meta, planes = next(it)
+                except StopIteration:
+                    break
+                _, _, w = meta
+                t1 = time.perf_counter()
+                combined = codec._matmul(plan.combine, planes)
+                t2 = time.perf_counter()
+                out.write(combine_planes_to_bytes(
+                    np.asarray(combined, dtype=np.uint8), w).tobytes())
+                rebuilt_bytes += w
+                t3 = time.perf_counter()
+                phases["gather"] += t1 - t0
+                phases["dispatch"] += t2 - t1
+                phases["write"] += t3 - t2
+    except BaseException:
+        out.close()
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
+        raise
+    finally:
+        if not out.closed:
+            out.close()
+    stream_s = time.perf_counter() - t_stream
+    residual = stream_s - (sum(phases.values()) - phases["plan"])
+    if residual > 0:
+        phases["dispatch"] += residual
+    for name, secs in phases.items():
+        if secs > 0:
+            tracing.record_span(name, secs, op="ec.rebuild",
+                                backend=codec.backend, repair="trace")
+    if stats is not None:
+        gs = source.stats
+        baseline = plan.k * source.shard_size
+        stats.update(telemetry.delta(before))
+        stats.update(gs.snapshot())
+        stats["rebuilt_bytes"] = rebuilt_bytes
+        stats["stream_s"] = round(stream_s, 3)
+        stats["backend"] = codec.backend
+        stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
+        gather_busy = gs.busy_s()
+        compute_busy = max(stream_s - phases["gather"], 0.0)
+        serialized = gather_busy + compute_busy
+        overlap = 0.0
+        if serialized > 0:
+            overlap = max(0.0, min(1.0,
+                                   (serialized - stream_s) / serialized))
+        stats["gather_busy_s"] = round(gather_busy, 3)
+        stats["compute_busy_s"] = round(compute_busy, 3)
+        stats["overlap_frac"] = round(overlap, 4)
+        stats["gather_mbps"] = round(gs.mbps(), 1)
+        stats["gather_remote_shards"] = gs.remote_shards
+        # the repair story: symbol bytes moved vs the k*shard baseline
+        # the full-RS gather would have pulled for the same rebuild
+        stats["repair_mode"] = "trace"
+        stats["repair_helpers"] = len(plan.helpers)
+        stats["repair_total_bits"] = plan.total_bits
+        stats["repair_bits"] = {int(s): plan.bits_for(s)
+                                for s in plan.helpers}
+        stats["repair_bytes"] = gs.bytes
+        stats["repair_remote_bytes"] = gs.remote_bytes
+        stats["repair_baseline_bytes"] = baseline
+        stats["repair_bytes_frac"] = round(
+            gs.bytes / baseline, 4) if baseline else 0.0
+        stats["repair_mbps"] = round(gs.mbps(), 1)
+    return [lost_sid]

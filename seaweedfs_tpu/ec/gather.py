@@ -112,6 +112,7 @@ class GatherStats:
         self._lock = threading.Lock()
         self.fetches = 0
         self.bytes = 0
+        self.remote_bytes = 0
         self.hedges_fired = 0
         self.hedges_won = 0
         self.retries = 0
@@ -120,11 +121,14 @@ class GatherStats:
         self.remote_shards = 0
         self.local_shards = 0
 
-    def add_fetch(self, nbytes: int, t0: float, t1: float):
+    def add_fetch(self, nbytes: int, t0: float, t1: float,
+                  remote: bool = False):
         self.timer.add("gather", t1 - t0, nbytes, interval=(t0, t1))
         with self._lock:
             self.fetches += 1
             self.bytes += nbytes
+            if remote:
+                self.remote_bytes += nbytes
 
     def add_hedge_fired(self):
         with self._lock:
@@ -151,6 +155,7 @@ class GatherStats:
         with self._lock:
             return {
                 "gather_bytes": self.bytes,
+                "gather_remote_bytes": self.remote_bytes,
                 "gather_fetches": self.fetches,
                 "hedges_fired": self.hedges_fired,
                 "hedges_won": self.hedges_won,
@@ -204,9 +209,18 @@ class RemoteShardReader:
         self.hedge_s = (default_hedge_ms() if hedge_ms is None
                         else float(hedge_ms)) / 1000.0
 
+    # transport hooks — RemoteRepairReader overrides to hit the
+    # projected-read route with a different method/response size while
+    # inheriting rotation, failover and hedging unchanged
+    _method = "GET"
+
     def _url(self, holder: str, off: int, n: int) -> str:
         return (f"http://{holder}/admin/ec/shard_read?volume={self.vid}"
                 f"&shard={self.sid}&offset={off}&size={n}")
+
+    def _expect_len(self, n: int) -> int:
+        """Response bytes expected for an n-byte shard range."""
+        return n
 
     def _read_one(self, holder: str, off: int, n: int) -> bytes:
         from ..server.http_util import HttpError, http_call
@@ -216,14 +230,16 @@ class RemoteShardReader:
         hdrs = None
         if self.span is not None:
             hdrs = {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
+        expect = self._expect_len(n)
         t0 = time.perf_counter()
-        data = http_call("GET", self._url(holder, off, n),
+        data = http_call(self._method, self._url(holder, off, n),
                          headers=hdrs, timeout=self.timeout)
-        if len(data) != n:
+        if len(data) != expect:
             raise HttpError(
                 502, f"short shard read {self.vid}.{self.sid} from "
-                     f"{holder} at {off}: {len(data)} < {n}")
-        self.stats.add_fetch(len(data), t0, time.perf_counter())
+                     f"{holder} at {off}: {len(data)} < {expect}")
+        self.stats.add_fetch(len(data), t0, time.perf_counter(),
+                             remote=True)
         return data
 
     def _read_failover(self, order: Sequence[str], off: int,
@@ -282,16 +298,49 @@ def probe_shard_size(vid: int, sid: int, holders: Sequence[str],
                      timeout: float = 30.0) -> int:
     """Total shard size via a one-byte suffix-range read: the 206's
     ``Content-Range: bytes a-b/total`` carries the full size without
-    transferring the shard (and exercises the ``bytes=-N`` path)."""
-    from ..server.http_util import HttpError, http_get_with_headers
+    transferring the shard (and exercises the ``bytes=-N`` path).
+
+    A holder that rejects the suffix form with 416 (strict servers do
+    for some edge encodings) falls back to sizing the shard with
+    1-byte ``offset=`` reads — double the offset until EOF, then
+    binary-search the boundary: ~2*log2(size) tiny requests instead of
+    transferring (or asking the holder to buffer) the whole shard."""
+    from ..server.http_util import HttpError, http_call, \
+        http_get_with_headers
+
+    def _size_by_tiny_reads(url: str) -> int:
+        def has_byte(off: int) -> bool:
+            data = http_call("GET", url + f"&offset={off}&size=1",
+                             timeout=timeout)
+            return len(data) > 0
+
+        if not has_byte(0):
+            return 0
+        lo, hi = 0, 1
+        while has_byte(hi):
+            lo, hi = hi, hi * 2
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            if has_byte(mid):
+                lo = mid
+            else:
+                hi = mid
+        return lo + 1
+
     last = None
     for holder in holders:
+        url = (f"http://{holder}/admin/ec/shard_read?volume={vid}"
+               f"&shard={sid}")
         try:
             _, hdrs = http_get_with_headers(
-                f"http://{holder}/admin/ec/shard_read?volume={vid}"
-                f"&shard={sid}",
-                timeout=timeout, headers={"Range": "bytes=-1"})
+                url, timeout=timeout, headers={"Range": "bytes=-1"})
         except HttpError as e:
+            if e.status == 416:
+                try:
+                    return _size_by_tiny_reads(url)
+                except HttpError as e2:
+                    last = e2
+                    continue
             last = e
             continue
         cr = next((v for k, v in hdrs.items()
@@ -304,6 +353,92 @@ def probe_shard_size(vid: int, sid: int, holders: Sequence[str],
     if last is not None:
         raise last
     raise ValueError(f"shard {vid}.{sid}: no holders to probe")
+
+
+class ShardSizeCache:
+    """Per-rebuild memo of ``probe_shard_size`` keyed by (vid, sid).
+
+    Trace repair sizes the lost shard off whichever survivor answers
+    first, and a multi-volume rebuild touches the same survivors
+    repeatedly — one suffix probe per shard per rebuild is enough.
+    ``probes`` counts actual wire probes so tests can assert the memo
+    held."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self.probes = 0
+        self._sizes: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+
+    def get(self, vid: int, sid: int, holders: Sequence[str]) -> int:
+        key = (int(vid), int(sid))
+        with self._lock:
+            if key in self._sizes:
+                return self._sizes[key]
+        size = probe_shard_size(vid, sid, holders, timeout=self.timeout)
+        with self._lock:
+            self.probes += 1
+            self._sizes[key] = size
+        return size
+
+
+class RemoteRepairReader(RemoteShardReader):
+    """Projected reads for trace repair: asks the holder to apply this
+    survivor's GF(2^8) trace masks server-side and ship only the packed
+    symbol planes — ``len(masks) * ceil(n/8)`` bytes for an n-byte
+    range. Rotation, failover and hedging come from the base class."""
+
+    _method = "POST"
+
+    def __init__(self, vid: int, sid: int, holders: Sequence[str],
+                 masks: Sequence[int],
+                 stats: Optional[GatherStats] = None,
+                 timeout: float = 300.0,
+                 hedge_ms: Optional[float] = None):
+        super().__init__(vid, sid, holders, stats=stats, timeout=timeout,
+                         hedge_ms=hedge_ms)
+        if not masks:
+            raise ValueError(f"shard {vid}.{sid}: no repair masks")
+        self.masks = [int(x) for x in masks]
+
+    def _url(self, holder: str, off: int, n: int) -> str:
+        m = ",".join(str(x) for x in self.masks)
+        return (f"http://{holder}/admin/ec/shard_repair_read"
+                f"?volume={self.vid}&shard={self.sid}"
+                f"&offset={off}&size={n}&masks={m}")
+
+    def _expect_len(self, n: int) -> int:
+        return len(self.masks) * ((n + 7) // 8)
+
+
+class LocalRepairReader:
+    """Trace projection of a survivor shard already on the rebuilder's
+    disk: read the range locally, project, and account only the symbol
+    bytes (the range itself never crossed the network)."""
+
+    remote = False
+
+    def __init__(self, path: str, masks: Sequence[int],
+                 stats: Optional[GatherStats] = None):
+        if not masks:
+            raise ValueError(f"{path}: no repair masks")
+        self.path = path
+        self.masks = [int(x) for x in masks]
+        self.stats = stats or GatherStats()
+
+    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
+        from ..ops.codec import project_slab
+        t0 = time.perf_counter()
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            data = f.read(n)
+        if len(data) != n:
+            raise IOError(f"short read of {self.path} at {off}: "
+                          f"{len(data)} < {n}")
+        planes = project_slab(np.frombuffer(data, dtype=np.uint8),
+                              self.masks)
+        self.stats.add_fetch(planes.nbytes, t0, time.perf_counter())
+        return planes.tobytes()
 
 
 def fetch_index_files(base_name: str, holders: Sequence[str],
@@ -375,6 +510,17 @@ class StripedGatherSource:
             if self._buffered > self.stats.peak_buffered:
                 self.stats.peak_buffered = self._buffered
 
+    # stream-shape hooks — RepairGatherSource reshapes both without
+    # touching the window/pool/ordering machinery
+    def _stripe_nbytes(self, w: int) -> int:
+        """Buffered bytes one in-flight stripe accounts for."""
+        return len(self.readers) * w
+
+    def _assemble(self, bufs: List[bytes], w: int) -> np.ndarray:
+        """Row buffers of one stripe -> the block the consumer wants."""
+        rows = [np.frombuffer(b, dtype=np.uint8) for b in bufs]
+        return np.stack(rows, axis=0)
+
     def slabs(self):
         k = len(self.readers)
         stripes: List[Tuple[int, int]] = [
@@ -393,7 +539,7 @@ class StripedGatherSource:
             # account BEFORE the fetches start: in-flight rows are
             # buffered memory too, and the bound must hold even when
             # every submitted row completes before the consumer drains
-            self._note_buffered(k * w)
+            self._note_buffered(self._stripe_nbytes(w))
             t_sub = time.perf_counter()
             futs = [pool.submit(self.readers[r].read, off, w, idx)
                     for r in range(k)]
@@ -406,17 +552,48 @@ class StripedGatherSource:
                 nxt += 1
             while pending:
                 idx, off, w, t_sub, futs = pending.popleft()
-                rows = [np.frombuffer(f.result(), dtype=np.uint8)
-                        for f in futs]
-                data = np.stack(rows, axis=0)
+                data = self._assemble([f.result() for f in futs], w)
                 tracing.record_span(
                     "gather.stripe", time.perf_counter() - t_sub,
                     parent=self.parent_span, op="ec.rebuild.gather",
-                    stripe=idx, offset=off, bytes=k * w)
-                self._note_buffered(-(k * w))
+                    stripe=idx, offset=off,
+                    bytes=self._stripe_nbytes(w))
+                self._note_buffered(-self._stripe_nbytes(w))
                 if nxt < len(stripes):
                     submit(nxt)
                     nxt += 1
                 yield (idx, off, w), data
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
+
+
+class RepairGatherSource(StripedGatherSource):
+    """Trace-repair symbol stream: the readers are one projection
+    reader per plan helper (``ops/codec.RepairPlan`` order), each
+    returning its packed symbol planes for the stripe range. ``slabs()``
+    yields ``(meta, (total_bits, ceil(w/8)) uint8)`` blocks — the
+    concatenated planes in helper-then-mask order, ready for the fused
+    combine matmul. The bounded window, round-robin rotation, failover
+    and hedging all come from the base source; only the stripe shape
+    and memory accounting differ."""
+
+    def __init__(self, readers: Sequence, shard_size: int, plan,
+                 slab: int = 8 << 20, window: Optional[int] = None,
+                 stats: Optional[GatherStats] = None,
+                 parent_span=None):
+        if len(readers) != len(plan.helpers):
+            raise ValueError(
+                f"need one reader per helper: {len(readers)} != "
+                f"{len(plan.helpers)}")
+        super().__init__(readers, shard_size, slab=slab, window=window,
+                         stats=stats, parent_span=parent_span)
+        self.plan = plan
+
+    def _stripe_nbytes(self, w: int) -> int:
+        return self.plan.total_bits * ((w + 7) // 8)
+
+    def _assemble(self, bufs: List[bytes], w: int) -> np.ndarray:
+        stride = (w + 7) // 8
+        rows = [np.frombuffer(b, dtype=np.uint8).reshape(-1, stride)
+                for b in bufs]
+        return np.concatenate(rows, axis=0)

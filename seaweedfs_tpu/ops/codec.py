@@ -14,9 +14,11 @@ built, else numpy. All backends produce bit-identical output.
 
 from __future__ import annotations
 
+import itertools
 import os
 from collections import OrderedDict
-from typing import List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -337,3 +339,203 @@ def get_codec(data_shards: int, parity_shards: int,
         from ..parallel.mesh_codec import MeshCodec
         return MeshCodec(data_shards, parity_shards, matrix_kind)
     raise ValueError(f"unknown backend {backend!r}")
+
+
+# ---------------------------------------------------------------------------
+# Trace repair of a single lost shard (arxiv 2205.11015).
+#
+# A dual codeword g satisfies sum_i g[i]*c_i = 0 over every stripe, so
+#     Tr(g[lost]*c_lost) = sum_{i != lost} Tr(g[i]*c_i).
+# Pick 8 dual codewords whose values at the lost position are
+# GF(2)-independent and every bit of c_lost is a GF(2) combination of
+# the trace bits Tr(g_j[i]*c_i).  Helper i only has to ship
+# t_i = dim_2 span{g_j[i]} bits per byte — its projection onto a
+# reduced basis of that span — instead of all 8, which is where the
+# sub-k*slab repair bandwidth comes from.  The rebuilder's combine is a
+# {0,1}-coefficient GF(2^8) matmul (XOR of bit-planes), so the existing
+# pipelined device kernels run it unchanged: one dispatch per slab.
+# ---------------------------------------------------------------------------
+
+REPAIR_MAX_SUBSETS = 400   # cap on vanish-subset enumeration (RS(20,4))
+REPAIR_RESTARTS = 3        # greedy restarts with shuffled candidate order
+
+
+@dataclass(frozen=True, eq=False)
+class RepairPlan:
+    """Single-lost-shard trace-repair scheme for one geometry.
+
+    helpers lists the shard ids that must be contacted (t_i > 0 only);
+    masks[sid] are the GF(2^8) projection masks that holder applies
+    (one packed bit-plane per mask); combine is the (8, total_bits)
+    {0,1} matrix that XORs the concatenated symbol planes back into
+    the lost shard's 8 bit-planes, in helpers-then-mask order.
+    """
+
+    k: int
+    m: int
+    lost: int
+    helpers: Tuple[int, ...]
+    masks: Dict[int, Tuple[int, ...]] = field(hash=False)
+    combine: np.ndarray = field(hash=False)
+    matrix_kind: str = "vandermonde"
+
+    @property
+    def total_bits(self) -> int:
+        return sum(len(v) for v in self.masks.values())
+
+    @property
+    def frac(self) -> float:
+        """Repair symbol bits per stripe byte vs the k-byte baseline."""
+        return self.total_bits / (8.0 * self.k)
+
+    def bits_for(self, sid: int) -> int:
+        return len(self.masks[sid])
+
+    def wire_bytes(self, width: int) -> int:
+        """Bytes on the wire for a width-byte slab range (all helpers,
+        packed planes; excludes HTTP framing)."""
+        return self.total_bits * ((width + 7) // 8)
+
+
+def project_slab(data: np.ndarray, masks) -> np.ndarray:
+    """Holder-side projection: trace bits Tr(mask * data) packed
+    little-bit-first per mask. data (w,) uint8 -> (len(masks),
+    ceil(w/8)) uint8. One LUT gather + packbits — cheap enough to run
+    on the volume server's host CPU."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m = np.asarray(list(masks), dtype=np.uint8)
+    bits = gf256.TRACE_MUL[m[:, None], data[None, :]]
+    return np.packbits(bits, axis=1, bitorder="little")
+
+
+def combine_planes_to_bytes(planes: np.ndarray, width: int) -> np.ndarray:
+    """Rebuilder-side interleave: 8 packed output bit-planes (8,
+    ceil(width/8)) -> the lost shard's bytes (width,). Plane b holds
+    bit b of every output byte."""
+    bits = np.unpackbits(np.ascontiguousarray(planes, dtype=np.uint8),
+                         axis=1, count=width, bitorder="little")
+    return np.packbits(bits, axis=0, bitorder="little").reshape(-1)
+
+
+_REPAIR_PLAN_CACHE: dict = {}
+
+
+def repair_plan(k: int, m: int, lost_sid: int, survivors=None,
+                matrix_kind: str = "vandermonde",
+                matrix: "np.ndarray | None" = None,
+                seed: int = 0) -> RepairPlan:
+    """Build (and cache) the trace-repair scheme for one lost shard.
+
+    survivors: iterable of reachable shard ids (default: all others).
+    Unreachable positions are handled by forcing every dual codeword to
+    vanish there, which needs n - 1 - len(survivors) <= m - 1; with
+    fewer survivors than k the code cannot repair at all and this
+    raises ValueError.
+
+    The scheme search enumerates dual codewords supported off an
+    (m-1)-subset of positions (nullspace of the transposed generator
+    restricted to the complement), scales each by all 255 nonzero
+    constants, and greedily picks 8 equations minimizing the total
+    per-helper GF(2) span growth — deterministic for a given seed, so
+    every process derives the identical plan.
+    """
+    n = k + m
+    if not (0 <= lost_sid < n):
+        raise ValueError(f"lost shard {lost_sid} outside 0..{n - 1}")
+    if survivors is None:
+        survivors = [i for i in range(n) if i != lost_sid]
+    helpers = sorted(set(int(s) for s in survivors) - {lost_sid})
+    unavailable = [i for i in range(n) if i != lost_sid and i not in helpers]
+    if len(unavailable) > m - 1:
+        raise ValueError(
+            f"too few survivors: {len(helpers)} reachable, need >= {k}")
+    key = (k, m, lost_sid, tuple(helpers), matrix_kind,
+           None if matrix is None else matrix.tobytes(), seed)
+    hit = _REPAIR_PLAN_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if matrix is None:
+        matrix = gf256.build_matrix(k, n, matrix_kind)
+
+    # -- candidate dual codewords: vanish on unavailable + an
+    #    (m-1-|unavailable|)-subset of helpers ---------------------------
+    free = m - 1 - len(unavailable)
+    subsets = list(itertools.combinations(helpers, free))
+    rng = np.random.default_rng(seed)
+    if len(subsets) > REPAIR_MAX_SUBSETS:
+        idx = rng.choice(len(subsets), size=REPAIR_MAX_SUBSETS,
+                         replace=False)
+        subsets = [subsets[i] for i in sorted(idx)]
+    base = []
+    for sub in subsets:
+        vanish = set(unavailable) | set(sub)
+        support = [i for i in range(n) if i not in vanish]
+        g_u = gf256.gf_nullspace(matrix[support, :].T)
+        if g_u is None:
+            continue
+        g = np.zeros(n, dtype=np.uint8)
+        g[support] = g_u
+        if g[lost_sid] == 0:
+            continue
+        base.append(g)
+    if not base:
+        raise ValueError("no usable dual codewords for this geometry")
+    base = np.stack(base, axis=0)
+    betas = np.arange(1, 256, dtype=np.uint8)
+    cand = gf256.MUL_TABLE[betas[None, :, None], base[:, None, :]]
+    cand = cand.reshape(-1, n)
+
+    # -- greedy scheme selection (restarts keep the best) ----------------
+    best = None
+    for r in range(REPAIR_RESTARTS):
+        order = rng.permutation(cand.shape[0]) if r else \
+            np.arange(cand.shape[0])
+        cv = cand[order]
+        chosen = []
+        star_basis: list = []
+        pos_basis = {i: [] for i in helpers}
+        total = 0
+        for _ in range(8):
+            ok = gf256.gf2_reduce(cv[:, lost_sid], star_basis) != 0
+            cost = np.zeros(cv.shape[0], dtype=np.int32)
+            for i in helpers:
+                cost += (gf256.gf2_reduce(cv[:, i], pos_basis[i]) != 0
+                         ).astype(np.int32)
+            c = int(np.argmin(np.where(ok, cost, np.int32(1 << 20))))
+            chosen.append(cv[c].copy())
+            gf256.gf2_insert(star_basis, int(cv[c, lost_sid]))
+            for i in helpers:
+                if gf256.gf2_insert(pos_basis[i], int(cv[c, i])):
+                    total += 1
+        if best is None or total < best[0]:
+            best = (total, chosen, {i: list(pos_basis[i]) for i in helpers})
+
+    _, chosen, bases = best
+    active = [i for i in helpers if bases[i]]
+    masks = {i: tuple(bases[i]) for i in active}
+
+    # -- combine matrix: bits(c_lost) = inv(A) @ Lambda @ sigma ----------
+    a = np.zeros((8, 8), dtype=np.uint8)
+    for j, g in enumerate(chosen):
+        for b in range(8):
+            a[j, b] = gf256.TRACE_MUL[int(g[lost_sid]), 1 << b]
+    lam = np.zeros((8, sum(len(masks[i]) for i in active)), dtype=np.uint8)
+    for j, g in enumerate(chosen):
+        col = 0
+        for i in active:
+            coords = gf256.gf2_decompose(int(g[i]), masks[i])
+            lam[j, col:col + len(coords)] = coords
+            col += len(coords)
+    combine = (gf256.gf2_mat_inv(a).astype(np.int32) @
+               lam.astype(np.int32)) % 2
+    plan = RepairPlan(k=k, m=m, lost=lost_sid, helpers=tuple(active),
+                      masks=masks, combine=combine.astype(np.uint8),
+                      matrix_kind=matrix_kind)
+    _REPAIR_PLAN_CACHE[key] = plan
+    return plan
+
+
+def repair_gain(plan: RepairPlan) -> float:
+    """Fraction of the k*slab baseline saved by trace repair
+    (0 = no gain; ec.rebuild -repair auto requires > 0)."""
+    return 1.0 - plan.frac

@@ -63,6 +63,8 @@ class VolumeServer:
         router.add("POST", "/admin/volume/verify", self.admin_volume_verify)
         router.add("POST", "/admin/ec/to_volume", self.admin_ec_to_volume)
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
+        router.add("POST", "/admin/ec/shard_repair_read",
+                   self.admin_ec_shard_repair_read)
         router.add("GET", "/admin/file", self.admin_file)
         router.add("POST", "/admin/volume/tier_upload",
                    self.admin_tier_upload)
@@ -795,7 +797,7 @@ class VolumeServer:
         — when the POST body carries ``sources`` ({shard: [holders]}) —
         the streaming striped gather: survivor ranges are pulled and
         decoded in overlapped slabs, never landing whole on disk."""
-        from ..stats.metrics import observe_gather
+        from ..stats.metrics import observe_gather, observe_repair
         from ..util import tracing
         vid = int(req.query["volume"])
         collection = req.query.get("collection", "")
@@ -811,8 +813,10 @@ class VolumeServer:
                 slab=int(body.get("slab") or 0) or None,
                 window=int(body.get("window") or 0) or None,
                 hedge_ms=float(hedge_ms) if hedge_ms is not None
-                else None)
+                else None,
+                repair=str(body.get("repair") or "auto"))
             observe_gather(stats)
+            observe_repair(stats)
         else:
             rebuilt = self.store.rebuild_ec_shards(
                 vid, collection, stats=stats)
@@ -1012,6 +1016,43 @@ class VolumeServer:
                 "Accept-Ranges": "bytes",
                 "Content-Range":
                     f"bytes {offset}-{offset + length - 1}/{total}",
+            })
+
+    def admin_ec_shard_repair_read(self, req: Request):
+        """Projected shard read for single-shard trace repair: read the
+        ``offset``/``size`` range of a local shard, apply the caller's
+        GF(2^8) trace masks locally (one LUT gather + packbits), and
+        return only the packed repair-symbol bit-planes — ``len(masks)``
+        planes of ``ceil(size/8)`` bytes each, concatenated. This is
+        where the sub-k*slab byte reduction happens: the full range is
+        read off disk but never leaves the holder."""
+        from ..ops import codec as ops_codec
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or sid not in ev.shards:
+            raise HttpError(404, f"shard {vid}.{sid} not here")
+        shard = ev.shards[sid]
+        try:
+            offset = int(req.query.get("offset", 0))
+            size = int(req.query["size"])
+            masks = [int(x) for x in req.query["masks"].split(",")]
+        except (KeyError, ValueError):
+            raise HttpError(400, "need offset/size/masks query params")
+        if offset < 0 or size <= 0:
+            raise HttpError(400, f"bad range {offset}+{size}")
+        if not masks or any(not (0 < x < 256) for x in masks):
+            raise HttpError(400, f"masks must be 1..255, got {masks}")
+        if offset + size > shard.size:
+            raise HttpError(
+                416, f"range {offset}+{size} beyond shard size {shard.size}")
+        data = np.frombuffer(shard.read_at(offset, size), dtype=np.uint8)
+        planes = ops_codec.project_slab(data, masks)
+        return Response(
+            planes.tobytes(),
+            headers={
+                "X-Repair-Planes": str(planes.shape[0]),
+                "X-Repair-Stride": str(planes.shape[1]),
             })
 
     def admin_tier_upload(self, req: Request):

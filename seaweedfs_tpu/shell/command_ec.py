@@ -312,9 +312,12 @@ def _encode_spread_copy(env: CommandEnv, vid: int, collection: str,
 
 
 @command("ec.rebuild",
-         "[-collection <name>] [-mode stream|copy] : regenerate missing "
-         "shards (stream = ranged survivor gather overlapped with the "
-         "decode; copy = legacy whole-shard copies)")
+         "[-collection <name>] [-mode stream|copy] "
+         "[-repair auto|trace|full] : regenerate missing shards "
+         "(stream = ranged survivor gather overlapped with the decode; "
+         "copy = legacy whole-shard copies; repair = single-shard "
+         "strategy — trace ships projected sub-shard symbols from all "
+         "survivors, full pulls k whole ranges, auto picks)")
 def ec_rebuild(env: CommandEnv, args: List[str]):
     flags = parse_flags(args)
     for vid_s, info in env.ec_volumes().items():
@@ -331,7 +334,8 @@ def ec_rebuild(env: CommandEnv, args: List[str]):
                       f"cannot rebuild")
             continue
         do_ec_rebuild(env, vid, collection, shards, missing,
-                      mode=flags.get("mode"))
+                      mode=flags.get("mode"),
+                      repair=flags.get("repair"))
 
 
 def _merge_rebuild_stats(timings: Dict, out: dict):
@@ -350,7 +354,8 @@ def _merge_rebuild_stats(timings: Dict, out: dict):
 
 def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
                   shards: Dict[int, List[str]], missing: List[int],
-                  timings: Dict[str, float] = None, mode: str = None):
+                  timings: Dict[str, float] = None, mode: str = None,
+                  repair: str = None):
     """`timings`, when given, records the phase walls plus the
     rebuilder's stats (gather/compute busy time, overlap_frac, dispatch
     telemetry) — the benchmark's overlap accounting.
@@ -360,14 +365,22 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
     decodes them overlapped — no whole-shard temp copies, no trailing
     delete_shards pass. "copy" is the legacy copy-then-rebuild flow;
     stream mode also falls back to it if the rebuilder predates the
-    streaming endpoint."""
+    streaming endpoint.
+
+    repair: "auto" (default; `SW_EC_REPAIR_MODE` overrides) lets the
+    rebuilder use trace repair — projected sub-shard symbols from all
+    survivors — when exactly one shard is lost; "trace" forces it,
+    "full" forces the k-survivor gather. Stream mode only."""
     import os as _os
     from ..util import tracing
     mode = (mode or _os.environ.get("SW_EC_GATHER_MODE") or
             "stream").lower()
+    repair = (repair or _os.environ.get("SW_EC_REPAIR_MODE") or
+              "auto").lower()
     # shell-side trace root: every call below — survivor gathering, the
     # rebuild, mount — carries its traceparent: ONE trace per operation
-    root = tracing.start_span("ec.rebuild", volume=vid, mode=mode)
+    root = tracing.start_span("ec.rebuild", volume=vid, mode=mode,
+                              repair=repair)
     try:
         # pick the node with most free slots as rebuilder (reference
         # command_ec_rebuild.go: pick by free slot count)
@@ -379,7 +392,7 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
             try:
                 rebuilt = _rebuild_streaming(env, vid, collection,
                                              shards, rebuilder, root,
-                                             timings)
+                                             timings, repair=repair)
             except HttpError as e:
                 env.write(f"volume {vid}: streaming rebuild failed "
                           f"({e.status}); falling back to copy mode")
@@ -399,9 +412,12 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
 
 def _rebuild_streaming(env: CommandEnv, vid: int, collection: str,
                        shards: Dict[int, List[str]], rebuilder: str,
-                       root, timings: Dict = None) -> List[int]:
+                       root, timings: Dict = None,
+                       repair: str = "auto") -> List[int]:
     """One POST: the rebuilder pulls slab-aligned survivor ranges from
-    the holder map and feeds them straight into the pipelined decode."""
+    the holder map and feeds them straight into the pipelined decode
+    (or, single-shard loss with ``repair`` auto/trace, pulls projected
+    repair symbols from ALL survivors)."""
     import time as _time
     sources = {str(sid): urls for sid, urls in shards.items()
                if rebuilder not in urls}
@@ -409,7 +425,7 @@ def _rebuild_streaming(env: CommandEnv, vid: int, collection: str,
     out = env.node_post(
         rebuilder,
         f"/admin/ec/rebuild?volume={vid}&collection={collection}",
-        body={"sources": sources})
+        body={"sources": sources, "repair": repair})
     t1 = _time.perf_counter()
     rebuilt = out.get("rebuilt", [])
     if timings is not None:

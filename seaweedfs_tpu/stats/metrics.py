@@ -293,6 +293,54 @@ def observe_gather(stats: Dict):
         VOLUME_EC_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
 
 
+# -- trace repair (ec/decoder.rebuild_ec_file_repair via observe_repair) -----
+
+VOLUME_EC_REPAIR_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_repair_total",
+    "Single-shard repair events by kind (trace_rebuilds, "
+    "full_rebuilds, fallbacks, symbol_bytes, baseline_bytes).",
+    labels=("kind",))
+VOLUME_EC_REPAIR_SECONDS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_repair_seconds_total",
+    "Cumulative symbol-gather busy time across trace repairs.")
+VOLUME_EC_REPAIR_BYTES_FRAC_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_repair_bytes_frac",
+    "Repair traffic of the last trace repair as a fraction of the "
+    "k*shard baseline the full gather would move (lower is better; "
+    "1.0 means no gain).")
+VOLUME_EC_REPAIR_SYMBOL_BITS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_repair_symbol_bits_total",
+    "Per-survivor repair symbol widths: how many survivors shipped "
+    "each bits-per-byte projection width across trace repairs.",
+    labels=("bits",))
+
+
+def observe_repair(stats: Dict):
+    """Export one rebuild's repair-mode stats (the dict filled by
+    ec.decoder.rebuild_ec_file_repair, or the fallback markers left by
+    storage/store) onto the volume registry."""
+    if not stats or "repair_mode" not in stats:
+        return
+    if stats.get("repair_fallback"):
+        VOLUME_EC_REPAIR_COUNTER.inc("fallbacks")
+    if stats["repair_mode"] != "trace":
+        VOLUME_EC_REPAIR_COUNTER.inc("full_rebuilds")
+        return
+    VOLUME_EC_REPAIR_COUNTER.inc("trace_rebuilds")
+    for kind, key in (("symbol_bytes", "repair_bytes"),
+                      ("baseline_bytes", "repair_baseline_bytes")):
+        n = stats.get(key)
+        if n:
+            VOLUME_EC_REPAIR_COUNTER.inc(kind, amount=n)
+    busy = stats.get("gather_busy_s")
+    if busy:
+        VOLUME_EC_REPAIR_SECONDS.inc(amount=busy)
+    if "repair_bytes_frac" in stats:
+        VOLUME_EC_REPAIR_BYTES_FRAC_GAUGE.set(stats["repair_bytes_frac"])
+    for bits in (stats.get("repair_bits") or {}).values():
+        VOLUME_EC_REPAIR_SYMBOL_BITS.inc(str(bits), amount=bits)
+
+
 # -- streaming spread (ec/spread.py via observe_spread) ----------------------
 
 VOLUME_EC_SPREAD_COUNTER = VOLUME_SERVER_GATHER.counter(

@@ -297,14 +297,24 @@ class Store:
                                     stats: dict = None,
                                     slab: Optional[int] = None,
                                     window: Optional[int] = None,
-                                    hedge_ms: Optional[float] = None
+                                    hedge_ms: Optional[float] = None,
+                                    repair: str = "auto"
                                     ) -> List[int]:
         """Rebuild missing shards by streaming slab ranges of remote
         survivors straight into the decode — no whole-shard copies on
         this server's disks, before, during, or after. ``sources`` maps
         shard id -> holder urls for survivors NOT local to this store;
         shards already here are read from disk. Only the KB-scale index
-        sidecars (.ecx/.vif/.ecj) are copied whole."""
+        sidecars (.ecx/.vif/.ecj) are copied whole.
+
+        ``repair`` picks the single-shard repair strategy: ``trace``
+        gathers per-survivor projected symbols over
+        ``/admin/ec/shard_repair_read`` (sub-k*slab network bytes, see
+        ops/codec.repair_plan), ``full`` is the k-survivor streaming
+        gather, ``auto`` (default) tries trace whenever exactly one
+        shard is lost and the scheme has gain, and falls back to full —
+        bit-identically — for multi-shard loss, no-gain geometries, or
+        holders that predate the repair route."""
         import time as _time
         from ..ec import gather
         from ..util import tracing
@@ -344,35 +354,63 @@ class Store:
                 raise VolumeError(
                     f"cannot rebuild {vid}: only {sum(present)} of "
                     f"{total} shards reachable")
-            src = [i for i, p in enumerate(present) if p][:k]
-            gstats = gather.GatherStats()
-            shard_size = None
-            readers = []
-            for i in src:
-                if local[i]:
-                    sz = os.path.getsize(base + to_ext(i))
-                    if shard_size is None:
-                        shard_size = sz
-                    elif shard_size != sz:
-                        raise VolumeError(
-                            "surviving shards differ in size")
-                    readers.append(gather.LocalShardReader(
-                        base + to_ext(i), gstats))
-                else:
-                    readers.append(gather.RemoteShardReader(
-                        vid, i, sources[i], gstats, hedge_ms=hedge_ms))
-            if shard_size is None:
-                probe = src[0]
-                shard_size = gather.probe_shard_size(
-                    vid, probe, sources[probe])
-            eff_slab = slab or gather.auto_slab(
-                shard_size, default=ec_encoder.DEFAULT_SLAB)
-            source = gather.StripedGatherSource(
-                readers, shard_size, slab=eff_slab,
-                window=window, stats=gstats, parent_span=root)
-            rebuilt = ec_encoder.rebuild_ec_files_streaming(
-                base, present, missing, source, codec=self.codec,
-                slab=eff_slab, stats=stats)
+            mode = (repair or "auto").lower()
+            if mode not in ("auto", "trace", "full"):
+                raise VolumeError(f"unknown repair mode {mode!r}")
+            # one wire probe per (vid, sid) for this whole rebuild, no
+            # matter how many paths need a size below
+            size_cache = gather.ShardSizeCache()
+
+            def sized(candidates) -> int:
+                sz = None
+                for i in candidates:
+                    if local[i]:
+                        s = os.path.getsize(base + to_ext(i))
+                        if sz is None:
+                            sz = s
+                        elif sz != s:
+                            raise VolumeError(
+                                "surviving shards differ in size")
+                if sz is not None:
+                    return sz
+                last = None
+                for i in candidates:
+                    if i in sources:
+                        try:
+                            return size_cache.get(vid, i, sources[i])
+                        except Exception as e:  # noqa: BLE001
+                            last = e
+                raise last if last is not None else VolumeError(
+                    f"cannot size shards of volume {vid}")
+
+            rebuilt = None
+            if mode != "full":
+                rebuilt = self._rebuild_streaming_trace(
+                    vid, base, local, present, missing, sources, sized,
+                    stats, slab, window, hedge_ms, root, mode)
+            if rebuilt is None:
+                src = [i for i, p in enumerate(present) if p][:k]
+                gstats = gather.GatherStats()
+                readers = []
+                for i in src:
+                    if local[i]:
+                        readers.append(gather.LocalShardReader(
+                            base + to_ext(i), gstats))
+                    else:
+                        readers.append(gather.RemoteShardReader(
+                            vid, i, sources[i], gstats,
+                            hedge_ms=hedge_ms))
+                shard_size = sized(src)
+                eff_slab = slab or gather.auto_slab(
+                    shard_size, default=ec_encoder.DEFAULT_SLAB)
+                source = gather.StripedGatherSource(
+                    readers, shard_size, slab=eff_slab,
+                    window=window, stats=gstats, parent_span=root)
+                rebuilt = ec_encoder.rebuild_ec_files_streaming(
+                    base, present, missing, source, codec=self.codec,
+                    slab=eff_slab, stats=stats)
+                if stats is not None:
+                    stats["repair_mode"] = "full"
             t0 = _time.perf_counter()
             rebuild_ecx_file(base, ec_offset_width(base))
             ecx_s = _time.perf_counter() - t0
@@ -380,6 +418,76 @@ class Store:
             if stats is not None and "phases" in stats:
                 stats["phases"]["write"] = round(
                     stats["phases"].get("write", 0.0) + ecx_s, 6)
+        return rebuilt
+
+    def _rebuild_streaming_trace(self, vid, base, local, present,
+                                 missing, sources, sized, stats, slab,
+                                 window, hedge_ms, root, mode):
+        """Attempt the trace-repair path; returns the rebuilt shard list
+        or None to signal 'use the full streaming gather instead'.
+        Forced mode ('trace') converts every fallback into an error;
+        'auto' records the reason in stats and lets the caller fall
+        through bit-identically."""
+        from ..ec import decoder as ec_decoder
+        from ..ec import gather
+        from ..ops import codec as ops_codec
+        from ..server.http_util import HttpError
+
+        def bail(reason: str):
+            if mode == "trace":
+                raise VolumeError(f"-repair trace: {reason}")
+            if stats is not None:
+                stats["repair_fallback"] = reason
+            return None
+
+        if len(missing) != 1:
+            return bail(f"{len(missing)} shards lost, trace repairs one")
+        lost = missing[0]
+        k = self.codec.k if self.codec is not None else DATA_SHARDS
+        m = (self.codec.m if self.codec is not None
+             else TOTAL_SHARDS - DATA_SHARDS)
+        helpers = [i for i, p in enumerate(present) if p and i != lost]
+        try:
+            plan = ops_codec.repair_plan(
+                k, m, lost, survivors=helpers,
+                matrix_kind=(self.codec.matrix_kind
+                             if self.codec is not None else "vandermonde"),
+                matrix=(self.codec.matrix
+                        if self.codec is not None else None))
+        except ValueError as e:
+            return bail(f"no repair scheme: {e}")
+        if mode == "auto" and plan.frac >= 1.0:
+            return bail(f"no trace gain (frac={plan.frac:.3f})")
+        shard_size = sized(plan.helpers)
+        gstats = gather.GatherStats()
+        readers = []
+        for i in plan.helpers:
+            if local[i]:
+                readers.append(gather.LocalRepairReader(
+                    base + to_ext(i), plan.masks[i], gstats))
+            else:
+                readers.append(gather.RemoteRepairReader(
+                    vid, i, sources[i], plan.masks[i], gstats,
+                    hedge_ms=hedge_ms))
+        eff_slab = slab or gather.auto_slab(
+            shard_size, default=ec_encoder.DEFAULT_SLAB)
+        source = gather.RepairGatherSource(
+            readers, shard_size, plan, slab=eff_slab,
+            window=window, stats=gstats, parent_span=root)
+        rstats: dict = {}
+        try:
+            rebuilt = ec_decoder.rebuild_ec_file_repair(
+                base, lost, source, plan, codec=self.codec,
+                slab=eff_slab, stats=rstats)
+        except HttpError as e:
+            if e.status in (404, 405, 501):
+                # a holder predates /admin/ec/shard_repair_read (or
+                # never had the shard): the repair output was already
+                # cleaned up, rerun as a plain streaming gather
+                return bail(f"holder refused repair read ({e.status})")
+            raise
+        if stats is not None:
+            stats.update(rstats)
         return rebuilt
 
     # -- heartbeat (reference store.go:193-247 CollectHeartbeat) -----------

@@ -1,0 +1,624 @@
+"""Bandwidth-optimal single-shard repair (ISSUE: trace-repair gather
+with per-survivor projection matmuls): GF(2^8) trace-repair schemes as
+per-survivor GF(2) projection masks, the `/admin/ec/shard_repair_read`
+projected-read protocol (ranged offset= form, 416/404/400 errors), the
+RepairGatherSource symbol stream staying bit-identical to the full
+decode on numpy/tpu/mesh, the measured sub-k*shard byte counts, the
+ShardSizeCache + 416 probe fallback, and the `-repair auto` cluster
+drill selecting trace for one lost shard and falling back to the full
+streaming gather — bit-identically — for multi-shard loss and holders
+that predate the repair route.
+
+Note on the bandwidth bound: linear repair of THIS fixed RS code
+cannot reach the 0.5x cut-set ideal; the schemes the search finds move
+~0.69-0.74x of the k*shard baseline (see DESIGN.md), so that is the
+bound the tests assert — plus the strict "beats the full gather" check
+that is the actual contract of `-repair auto`."""
+
+import hashlib
+import http.client
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import to_ext, write_ec_files
+from seaweedfs_tpu.ec.decoder import rebuild_ec_file_repair
+from seaweedfs_tpu.ec.gather import (GatherStats, LocalRepairReader,
+                                     RemoteRepairReader,
+                                     RepairGatherSource, ShardSizeCache,
+                                     probe_shard_size)
+from seaweedfs_tpu.ops.codec import (NumpyCodec, combine_planes_to_bytes,
+                                     project_slab, repair_gain,
+                                     repair_plan)
+from seaweedfs_tpu.server.http_util import (HttpError, HttpServer,
+                                            Response, Router, http_call,
+                                            parse_range)
+
+GEOMETRIES = [(10, 4), (6, 3), (20, 4)]
+
+
+def _pick_lost(k, m):
+    """Random-but-seeded lost shard (data or parity) per geometry."""
+    return int(np.random.default_rng(k * 31 + m).integers(0, k + m))
+
+
+def _seed_shards(dirpath, k, m, nbytes, seed=11):
+    """RS(k,m) shard files for volume 1 in dirpath; returns (base,
+    shard digests, shard size)."""
+    rng = np.random.default_rng(seed)
+    base = os.path.join(str(dirpath), "1")
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, nbytes, dtype=np.uint8).tobytes())
+    write_ec_files(base, codec=NumpyCodec(k, m), large_block=64 << 10,
+                   small_block=8 << 10, slab=32 << 10, pipelined=False)
+    os.remove(base + ".dat")
+    digests = {}
+    for i in range(k + m):
+        with open(base + to_ext(i), "rb") as f:
+            digests[i] = hashlib.sha256(f.read()).hexdigest()
+    return base, digests, os.path.getsize(base + to_ext(0))
+
+
+def _symbol_bytes(plan, shard_size, slab):
+    """Exact symbol bytes the repair gather moves for this plan."""
+    return plan.total_bits * sum(
+        (min(slab, shard_size - off) + 7) // 8
+        for off in range(0, shard_size, slab))
+
+
+# -- repair plan: scheme search properties ----------------------------------
+
+@pytest.mark.parametrize("k,m", GEOMETRIES)
+def test_repair_plan_properties(k, m):
+    lost = _pick_lost(k, m)
+    plan = repair_plan(k, m, lost)
+    assert plan.lost == lost
+    assert plan.helpers == tuple(
+        i for i in range(k + m) if i != lost)
+    # the combine is a {0,1}-coefficient matrix: in GF(2^8) that means
+    # mult-by-identity + XOR, so the existing device kernels run it
+    assert plan.combine.shape == (8, plan.total_bits)
+    assert set(np.unique(plan.combine)) <= {0, 1}
+    assert sum(plan.bits_for(s) for s in plan.helpers) == plan.total_bits
+    for s, masks in plan.masks.items():
+        assert s in plan.helpers
+        assert len(masks) == plan.bits_for(s)
+        assert all(0 < x < 256 for x in masks)
+    # real gain over the 8k-bit full gather, but honest about the
+    # floor: linear repair of this code lands ~0.69-0.74, never 0.5
+    assert 0.0 < plan.frac < 1.0
+    assert plan.frac <= 0.75
+    assert repair_gain(plan) == pytest.approx(1.0 - plan.frac)
+    # deterministic + cached: same args give the same object
+    assert repair_plan(k, m, lost) is plan
+
+
+def test_repair_plan_restricted_survivors():
+    # one helper unreachable: the plan must exclude it and still gain
+    k, m, lost = 10, 4, 2
+    down = 7
+    helpers = [i for i in range(k + m) if i not in (lost, down)]
+    plan = repair_plan(k, m, lost, survivors=helpers)
+    assert down not in plan.helpers
+    assert set(plan.helpers) <= set(helpers)
+    assert plan.frac < 1.0
+    # fewer reachable shards than k: no linear repair exists at all
+    with pytest.raises(ValueError):
+        repair_plan(6, 3, 0, survivors=range(1, 6))
+
+
+# -- ops-level roundtrip: project + combine == the lost shard ---------------
+
+@pytest.mark.parametrize("k,m", GEOMETRIES)
+def test_project_combine_roundtrip(k, m):
+    w = 1009  # deliberately not divisible by 8: tail bits must pad out
+    rng = np.random.default_rng(k + m)
+    codec = NumpyCodec(k, m)
+    shards = codec.encode_to_all(
+        rng.integers(0, 256, (k, w), dtype=np.uint8))
+    lost = _pick_lost(k, m)
+    plan = repair_plan(k, m, lost)
+    planes = np.concatenate(
+        [project_slab(shards[i], plan.masks[i]) for i in plan.helpers],
+        axis=0)
+    assert planes.shape == (plan.total_bits, (w + 7) // 8)
+    combined = codec._matmul(plan.combine, planes)
+    out = combine_planes_to_bytes(
+        np.asarray(combined, dtype=np.uint8), w)
+    assert np.array_equal(out, shards[lost])
+
+
+# -- file-level bit identity on every backend -------------------------------
+
+@pytest.mark.parametrize("backend", ["numpy", "tpu", "mesh"])
+@pytest.mark.parametrize("k,m", GEOMETRIES)
+def test_trace_repair_bit_identical(tmp_path, k, m, backend):
+    if backend == "numpy":
+        Codec = NumpyCodec
+    elif backend == "tpu":
+        from seaweedfs_tpu.ops.rs_tpu import TpuCodec as Codec
+    else:
+        from seaweedfs_tpu.parallel.mesh_codec import MeshCodec as Codec
+    base, ref, shard_size = _seed_shards(tmp_path, k, m,
+                                         k * 24_000 + 53, seed=k * m)
+    lost = _pick_lost(k, m)
+    os.remove(base + to_ext(lost))
+    plan = repair_plan(k, m, lost)
+    slab = 7_001  # divides neither the shard nor a byte boundary
+    gs = GatherStats()
+    readers = [LocalRepairReader(base + to_ext(i), plan.masks[i], gs)
+               for i in plan.helpers]
+    source = RepairGatherSource(readers, shard_size, plan, slab=slab,
+                                window=2, stats=gs)
+    stats = {}
+    rebuilt = rebuild_ec_file_repair(base, lost, source, plan,
+                                     codec=Codec(k, m), slab=slab,
+                                     stats=stats)
+    assert rebuilt == [lost]
+    with open(base + to_ext(lost), "rb") as f:
+        assert hashlib.sha256(f.read()).hexdigest() == ref[lost], \
+            f"shard {lost} diverged on {backend}"
+    # byte accounting: exactly the packed symbol planes, nothing more,
+    # and strictly less than the k*shard full gather would have moved
+    expect = _symbol_bytes(plan, shard_size, slab)
+    assert stats["repair_bytes"] == expect
+    assert stats["repair_baseline_bytes"] == k * shard_size
+    assert stats["repair_bytes"] < k * shard_size
+    assert stats["repair_bytes_frac"] < 0.80
+    assert stats["repair_mode"] == "trace"
+    assert stats["repair_helpers"] == k + m - 1
+    assert stats["rebuilt_bytes"] == shard_size
+
+
+# -- fake holder speaking both shard_read and shard_repair_read -------------
+
+class RepairHolder:
+    """Minimal holder with the full repair protocol: ranged
+    /admin/ec/shard_read plus projected /admin/ec/shard_repair_read,
+    with injectable failure for the failover drill."""
+
+    def __init__(self, directory):
+        self.dir = directory
+        self.fail = False
+        self.calls = 0
+        self._lock = threading.Lock()
+        router = Router()
+        router.add("GET", "/admin/ec/shard_read", self._shard_read)
+        router.add("POST", "/admin/ec/shard_repair_read",
+                   self._repair_read)
+        self.server = HttpServer(0, router).start()
+        self.url = f"127.0.0.1:{self.server.port}"
+
+    def _path(self, req):
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        path = os.path.join(self.dir, f"{vid}{to_ext(sid)}")
+        if not os.path.exists(path):
+            raise HttpError(404, f"shard {vid}.{sid} not here")
+        return path
+
+    def _shard_read(self, req):
+        path = self._path(req)
+        total = os.path.getsize(path)
+        rng = parse_range(req.headers.get("Range", ""), total)
+        with open(path, "rb") as f:
+            if rng is None:
+                f.seek(int(req.query.get("offset", 0)))
+                return Response(f.read(int(req.query.get("size", 0))),
+                                headers={"Accept-Ranges": "bytes"})
+            off, n = rng
+            f.seek(off)
+            return Response(
+                f.read(n), status=206,
+                headers={"Accept-Ranges": "bytes",
+                         "Content-Range":
+                             f"bytes {off}-{off + n - 1}/{total}"})
+
+    def _repair_read(self, req):
+        with self._lock:
+            self.calls += 1
+        if self.fail:
+            raise HttpError(503, "injected failure")
+        path = self._path(req)
+        off = int(req.query["offset"])
+        n = int(req.query["size"])
+        masks = [int(x) for x in req.query["masks"].split(",")]
+        if off + n > os.path.getsize(path):
+            raise HttpError(416, "beyond shard")
+        with open(path, "rb") as f:
+            f.seek(off)
+            data = np.frombuffer(f.read(n), dtype=np.uint8)
+        planes = project_slab(data, masks)
+        return Response(planes.tobytes(),
+                        headers={"X-Repair-Planes": str(planes.shape[0]),
+                                 "X-Repair-Stride": str(planes.shape[1])})
+
+    def stop(self):
+        self.server.stop()
+
+
+def test_remote_repair_symbol_bytes_and_failover(tmp_path):
+    k, m, lost = 6, 3, 4
+    holder_dir = tmp_path / "holder"
+    holder_dir.mkdir()
+    _, ref, shard_size = _seed_shards(holder_dir, k, m, 120_000)
+    rebuild_dir = tmp_path / "rebuilder"
+    rebuild_dir.mkdir()
+    base = str(rebuild_dir / "1")
+    a, b = RepairHolder(str(holder_dir)), RepairHolder(str(holder_dir))
+    try:
+        a.fail = True  # first holder down: failover must still repair
+        plan = repair_plan(k, m, lost)
+        slab = 16 << 10
+        gs = GatherStats()
+        readers = [RemoteRepairReader(1, i, [a.url, b.url],
+                                      plan.masks[i], gs, hedge_ms=0)
+                   for i in plan.helpers]
+        source = RepairGatherSource(readers, shard_size, plan,
+                                    slab=slab, window=2, stats=gs)
+        stats = {}
+        rebuilt = rebuild_ec_file_repair(base, lost, source, plan,
+                                         codec=NumpyCodec(k, m),
+                                         slab=slab, stats=stats)
+        assert rebuilt == [lost]
+        with open(base + to_ext(lost), "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == ref[lost]
+        # only the packed symbol planes crossed the wire — every byte
+        # remote, and strictly under the full-gather baseline
+        expect = _symbol_bytes(plan, shard_size, slab)
+        assert gs.remote_bytes == expect
+        assert stats["repair_remote_bytes"] == expect
+        assert gs.remote_bytes < k * shard_size
+        assert gs.retries >= 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_old_holder_404_cleans_partial_output(tmp_path):
+    """A holder that predates /admin/ec/shard_repair_read answers 404;
+    the repair attempt must propagate it and leave no partial file —
+    the clean slate the store's full-gather fallback relies on."""
+    k, m, lost = 6, 3, 1
+    holder_dir = tmp_path / "holder"
+    holder_dir.mkdir()
+    _seed_shards(holder_dir, k, m, 60_000)
+    rebuild_dir = tmp_path / "rebuilder"
+    rebuild_dir.mkdir()
+    base = str(rebuild_dir / "1")
+    router = Router()  # shard_read only: an "old" holder
+    old = HttpServer(0, router).start()
+    try:
+        shard_size = os.path.getsize(
+            os.path.join(str(holder_dir), f"1{to_ext(0)}"))
+        plan = repair_plan(k, m, lost)
+        gs = GatherStats()
+        readers = [RemoteRepairReader(1, i, [f"127.0.0.1:{old.port}"],
+                                      plan.masks[i], gs, hedge_ms=0)
+                   for i in plan.helpers]
+        source = RepairGatherSource(readers, shard_size, plan,
+                                    slab=16 << 10, stats=gs)
+        with pytest.raises(HttpError) as ei:
+            rebuild_ec_file_repair(base, lost, source, plan,
+                                   codec=NumpyCodec(k, m), slab=16 << 10)
+        assert ei.value.status == 404
+        assert not os.path.exists(base + to_ext(lost))
+    finally:
+        old.stop()
+
+
+# -- store fallback contract: auto falls through, trace refuses -------------
+
+def test_store_trace_fallback_contract(tmp_path):
+    from seaweedfs_tpu.storage.store import Store, VolumeError
+    k, m = 6, 3
+    holder_dir = tmp_path / "holder"
+    holder_dir.mkdir()
+    _, _, shard_size = _seed_shards(holder_dir, k, m, 60_000)
+    store_dir = tmp_path / "store"
+    store_dir.mkdir()
+    store = Store([str(store_dir)], codec=NumpyCodec(k, m))
+    base = os.path.join(str(store_dir), "1")
+    router = Router()  # old holder again: no repair route -> 404
+    old = HttpServer(0, router).start()
+    try:
+        n = k + m
+        lost = 2
+        local = [False] * n
+        present = [i != lost for i in range(n)]
+        sources = {i: [f"127.0.0.1:{old.port}"]
+                   for i in range(n) if i != lost}
+
+        def sized(candidates):
+            return shard_size
+
+        # auto: the 404 becomes a recorded fallback, not an error
+        stats = {}
+        out = store._rebuild_streaming_trace(
+            1, base, local, present, [lost], sources, sized, stats,
+            16 << 10, None, 0, None, "auto")
+        assert out is None
+        assert "holder refused repair read" in stats["repair_fallback"]
+        assert not os.path.exists(base + to_ext(lost))
+        # forced trace: the same 404 is a hard error
+        with pytest.raises(VolumeError):
+            store._rebuild_streaming_trace(
+                1, base, local, present, [lost], sources, sized, {},
+                16 << 10, None, 0, None, "trace")
+        # multi-shard loss: trace repairs exactly one shard
+        stats2 = {}
+        present2 = [i not in (2, 5) for i in range(n)]
+        out2 = store._rebuild_streaming_trace(
+            1, base, local, present2, [2, 5], sources, sized, stats2,
+            16 << 10, None, 0, None, "auto")
+        assert out2 is None
+        assert "2 shards lost" in stats2["repair_fallback"]
+        with pytest.raises(VolumeError):
+            store._rebuild_streaming_trace(
+                1, base, local, present2, [2, 5], sources, sized, {},
+                16 << 10, None, 0, None, "trace")
+    finally:
+        old.stop()
+
+
+# -- shard size cache + 416 probe fallback ----------------------------------
+
+class Strict416Holder:
+    """Holder that refuses every Range header with 416 but still
+    serves the query offset=/size= form (clamped at EOF) — the probe
+    must fall back to a full read to size the shard."""
+
+    def __init__(self, directory):
+        self.dir = directory
+        self.calls = 0
+        router = Router()
+        router.add("GET", "/admin/ec/shard_read", self._shard_read)
+        self.server = HttpServer(0, router).start()
+        self.url = f"127.0.0.1:{self.server.port}"
+
+    def _shard_read(self, req):
+        self.calls += 1
+        if req.headers.get("Range"):
+            raise HttpError(416, "no suffix ranges here")
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        path = os.path.join(self.dir, f"{vid}{to_ext(sid)}")
+        if not os.path.exists(path):
+            raise HttpError(404, "not here")
+        with open(path, "rb") as f:
+            f.seek(int(req.query.get("offset", 0)))
+            return Response(f.read(int(req.query.get("size", 0))))
+
+    def stop(self):
+        self.server.stop()
+
+
+def test_probe_416_fallback_and_size_cache(tmp_path):
+    _, _, shard_size = _seed_shards(tmp_path, 6, 3, 80_000)
+    h = Strict416Holder(str(tmp_path))
+    try:
+        assert probe_shard_size(1, 0, [h.url]) == shard_size
+        cache = ShardSizeCache()
+        assert cache.get(1, 3, [h.url]) == shard_size
+        assert cache.probes == 1
+        wire_calls = h.calls
+        # the memo holds: same (vid, sid) never probes the wire again
+        for _ in range(3):
+            assert cache.get(1, 3, [h.url]) == shard_size
+        assert h.calls == wire_calls
+        assert cache.probes == 1
+        # a different shard is a fresh probe
+        assert cache.get(1, 4, [h.url]) == shard_size
+        assert cache.probes == 2
+    finally:
+        h.stop()
+
+
+# -- metrics export ----------------------------------------------------------
+
+def test_observe_repair_metrics():
+    from seaweedfs_tpu.stats import metrics
+    c = metrics.VOLUME_EC_REPAIR_COUNTER
+    before = {k: c.value(k) for k in
+              ("trace_rebuilds", "full_rebuilds", "fallbacks",
+               "symbol_bytes", "baseline_bytes")}
+    metrics.observe_repair({
+        "repair_mode": "trace", "repair_bytes": 700_000,
+        "repair_baseline_bytes": 1_000_000, "repair_bytes_frac": 0.7,
+        "gather_busy_s": 0.2, "repair_bits": {0: 5, 1: 4}})
+    assert c.value("trace_rebuilds") - before["trace_rebuilds"] == 1
+    assert c.value("symbol_bytes") - before["symbol_bytes"] == 700_000
+    assert c.value("baseline_bytes") - before["baseline_bytes"] \
+        == 1_000_000
+    assert metrics.VOLUME_EC_REPAIR_BYTES_FRAC_GAUGE.value() == 0.7
+    metrics.observe_repair({"repair_mode": "full",
+                            "repair_fallback": "2 shards lost"})
+    assert c.value("full_rebuilds") - before["full_rebuilds"] == 1
+    assert c.value("fallbacks") - before["fallbacks"] == 1
+    render = metrics.VOLUME_SERVER_GATHER.render()
+    assert 'ec_repair_total{kind="trace_rebuilds"}' in render
+    assert "ec_repair_bytes_frac" in render
+    assert "ec_repair_symbol_bits_total" in render
+
+
+# -- live cluster: protocol + `-repair auto` drill + full fallback ----------
+
+@pytest.fixture
+def cluster3(tmp_path):
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    servers = [
+        VolumeServer(port=0, directories=[str(tmp_path / f"v{i}")],
+                     master_url=master.url, pulse_seconds=1,
+                     max_volume_counts=[30], ec_backend="numpy").start()
+        for i in range(3)]
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _cluster_shard_files(servers):
+    out = {}
+    for vs in servers:
+        for loc in vs.store.locations:
+            for fname in os.listdir(loc.directory):
+                for sid in range(14):
+                    if fname.endswith(to_ext(sid)):
+                        out.setdefault(sid, []).append(
+                            os.path.join(loc.directory, fname))
+    return out
+
+
+def _lose_shards(env, victim, vid, to_lose):
+    victim.store.unmount_ec_shards(vid, to_lose)
+    for loc in victim.store.locations:
+        for sid in to_lose:
+            for f in os.listdir(loc.directory):
+                if f.endswith(to_ext(sid)):
+                    os.remove(os.path.join(loc.directory, f))
+    victim.heartbeat_once()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        info = env.ec_volumes().get(str(vid)) or {"shards": {}}
+        shards = {int(s): urls for s, urls in info["shards"].items()}
+        if all(s not in shards or victim.url not in shards[s]
+               for s in to_lose):
+            return shards
+        time.sleep(0.2)
+    raise AssertionError(f"master never dropped shards {to_lose}")
+
+
+def test_cluster_trace_repair_end_to_end(cluster3):
+    import io
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.shell.command_env import CommandEnv, run_command
+    from seaweedfs_tpu.shell.command_ec import do_ec_rebuild
+    master, servers = cluster3
+    rng = np.random.default_rng(9)
+    fid = None
+    for i in range(12):
+        data = rng.integers(0, 256, 150_000).astype(np.uint8).tobytes()
+        fid = op.upload_data(master.url, data, filename=f"t{i}",
+                             collection="tr")
+    vid = int(fid.split(",")[0])
+    env = CommandEnv(master.url, out=io.StringIO())
+    assert run_command(env, f"ec.encode -volumeId {vid}")
+
+    files = _cluster_shard_files(servers)
+    assert sorted(files) == list(range(14))
+    oracle = {}
+    for sid, paths in files.items():
+        with open(paths[0], "rb") as f:
+            oracle[sid] = hashlib.sha256(f.read()).hexdigest()
+
+    # -- shard_repair_read protocol against a REAL holder ------------------
+    holder_vs = next(vs for vs in servers
+                     if vs.store.find_ec_volume(vid) is not None)
+    ev = holder_vs.store.find_ec_volume(vid)
+    some_sid = ev.shard_ids()[0]
+    total = ev.shards[some_sid].size
+    shard_path = next(p for p in files[some_sid])
+    with open(shard_path, "rb") as f:
+        shard_head = np.frombuffer(f.read(56), dtype=np.uint8)
+    conn = http.client.HTTPConnection("127.0.0.1", holder_vs.port)
+    try:
+        # ranged projected read: offset= + masks -> packed bit planes
+        conn.request("POST", f"/admin/ec/shard_repair_read?volume={vid}"
+                             f"&shard={some_sid}&offset=16&size=40"
+                             f"&masks=3,5")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200
+        assert resp.getheader("X-Repair-Planes") == "2"
+        assert resp.getheader("X-Repair-Stride") == "5"
+        expect = project_slab(shard_head[16:56], [3, 5])
+        assert body == expect.tobytes()
+        # beyond the shard -> 416
+        conn.request("POST", f"/admin/ec/shard_repair_read?volume={vid}"
+                             f"&shard={some_sid}&offset={total - 4}"
+                             f"&size=64&masks=3")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 416
+        # out-of-field mask -> 400
+        conn.request("POST", f"/admin/ec/shard_repair_read?volume={vid}"
+                             f"&shard={some_sid}&offset=0&size=8"
+                             f"&masks=0,3")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 400
+        # missing size -> 400
+        conn.request("POST", f"/admin/ec/shard_repair_read?volume={vid}"
+                             f"&shard={some_sid}&masks=3")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 400
+        # a shard this holder does not have -> 404
+        not_held = next(s for s in range(14) if s not in ev.shards)
+        conn.request("POST", f"/admin/ec/shard_repair_read?volume={vid}"
+                             f"&shard={not_held}&offset=0&size=8"
+                             f"&masks=3")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 404
+    finally:
+        conn.close()
+
+    # -- single-shard loss: `-repair auto` must pick trace ------------------
+    victim = max(servers,
+                 key=lambda vs: len(vs.store.find_ec_volume(vid).shards)
+                 if vs.store.find_ec_volume(vid) else 0)
+    lone = victim.store.find_ec_volume(vid).shard_ids()[0]
+    shards = _lose_shards(env, victim, vid, [lone])
+    assert lone not in shards
+    timings = {}
+    do_ec_rebuild(env, vid, "tr", shards, [lone], timings=timings,
+                  repair="auto")
+    assert timings["repair_mode"] == "trace"
+    assert "repair_fallback" not in timings
+    assert timings["repair_helpers"] == 13
+    # the whole point: fewer bytes gathered than the k-survivor full
+    # gather, with the measured ~0.69 frac for RS(10,4)
+    assert timings["repair_bytes"] < timings["repair_baseline_bytes"]
+    assert timings["repair_bytes_frac"] < 0.80
+    assert timings["repair_mbps"] >= 0
+    files_after = _cluster_shard_files(servers)
+    assert sorted(files_after) == list(range(14))
+    for sid, paths in files_after.items():
+        assert len(paths) == 1, f"shard {sid} duplicated: {paths}"
+        with open(paths[0], "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == oracle[sid], \
+                f"shard {sid} diverged after trace repair"
+
+    # -- multi-shard loss: auto falls back to the full gather ---------------
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        info = env.ec_volumes().get(str(vid)) or {"shards": {}}
+        if len(info["shards"]) == 14:
+            break
+        time.sleep(0.2)
+    victim2 = max(servers,
+                  key=lambda vs: len(vs.store.find_ec_volume(vid).shards)
+                  if vs.store.find_ec_volume(vid) else 0)
+    to_lose = victim2.store.find_ec_volume(vid).shard_ids()[:2]
+    shards2 = _lose_shards(env, victim2, vid, to_lose)
+    timings2 = {}
+    do_ec_rebuild(env, vid, "tr", shards2,
+                  sorted(set(range(14)) - set(shards2)),
+                  timings=timings2, repair="auto")
+    assert timings2["repair_mode"] == "full"
+    assert "2 shards lost" in timings2["repair_fallback"]
+    files_final = _cluster_shard_files(servers)
+    assert sorted(files_final) == list(range(14))
+    for sid, paths in files_final.items():
+        with open(paths[0], "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest() == oracle[sid], \
+                f"shard {sid} diverged after full-gather fallback"
+
+    # the data still reads back through the EC path
+    assert http_call("GET", f"http://{servers[0].url}/{fid}") == data

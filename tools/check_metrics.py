@@ -46,6 +46,10 @@ REQUIRED_FAMILIES = {
         "SeaweedFS_volumeServer_ec_spread_seconds_total",
         "SeaweedFS_volumeServer_ec_spread_mbps",
         "SeaweedFS_volumeServer_ec_encode_overlap_frac",
+        "SeaweedFS_volumeServer_ec_repair_total",
+        "SeaweedFS_volumeServer_ec_repair_seconds_total",
+        "SeaweedFS_volumeServer_ec_repair_bytes_frac",
+        "SeaweedFS_volumeServer_ec_repair_symbol_bits_total",
     ),
 }
 
@@ -71,9 +75,26 @@ def check_route_coverage(repo_root: str) -> list:
                       encoding="utf-8") as f:
                 corpus.append(f.read())
     blob = "\n".join(corpus)
-    return [f"route-coverage: {route} is registered in "
-            f"volume_server.py but no test references it"
-            for route in routes if route not in blob]
+    problems = [f"route-coverage: {route} is registered in "
+                f"volume_server.py but no test references it"
+                for route in routes if route not in blob]
+    # the repair-read route carries a mini-protocol (ranged projected
+    # reads, 416 beyond-shard, 400 bad masks/range, 404 wrong shard) —
+    # a test must exercise the ranged form AND the error responses, not
+    # just mention the path
+    repair_route = "/admin/ec/shard_repair_read"
+    if repair_route in routes and repair_route in blob:
+        repair_files = [c for c in corpus if repair_route in c]
+        if not any("offset=" in c for c in repair_files):
+            problems.append(
+                f"route-coverage: no test exercises {repair_route} "
+                f"with a ranged (offset=) request")
+        for status in ("416", "404", "400"):
+            if not any(status in c for c in repair_files):
+                problems.append(
+                    f"route-coverage: no test covering {repair_route} "
+                    f"asserts a {status} error response")
+    return problems
 
 
 def check_required(role: str, registry) -> list:
