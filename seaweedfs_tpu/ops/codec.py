@@ -210,6 +210,19 @@ class ReedSolomonCodec:
         self._plan_cache[key] = plan
         return plan
 
+    def lost_row_coeffs(self, present: tuple, sid: int) -> tuple:
+        """Single-shard slice of the fused decode plan: (src_rows,
+        coeffs) with coeffs (1, k) such that shard[sid] = coeffs @
+        shards[src_rows]. Degraded reads regenerate exactly one lost
+        row — the full plan's other missing rows would be wasted
+        compute per request — while still riding the _plan_cache, so
+        repeated reads of the same loss pattern pay zero GF planning."""
+        src, missing, coeffs = self.decode_plan(tuple(present))
+        if sid not in missing:
+            raise ValueError(f"shard {sid} is not missing in {present}")
+        r = missing.index(sid)
+        return src, np.ascontiguousarray(coeffs[r:r + 1])
+
     def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
                     data_only: bool = False) -> List[np.ndarray]:
         """Fill in missing (None) shards. Mirrors reference Reconstruct /

@@ -127,6 +127,21 @@ class VolumeServer:
         from ..ec.shard_cache import EcShardLocationCache
         self._ec_loc_cache = EcShardLocationCache(
             self._fetch_ec_shard_locations)
+        # batched degraded-read serving tier: reconstruct-on-read with
+        # request coalescing, exactly-k survivor gather and a
+        # reconstructed-slab LRU (ec/degraded.py)
+        from ..ec.degraded import DegradedReadEngine
+        from ..stats.metrics import DEGRADED_READ_HISTOGRAM
+        self.degraded = DegradedReadEngine(
+            store=self.store,
+            locations=self._ec_shard_locations,
+            codec=lambda: self.store.codec or get_codec(DATA_SHARDS, 4),
+            loc_cache=self._ec_loc_cache,
+            self_url=lambda: self.url,
+            on_read=lambda s: DEGRADED_READ_HISTOGRAM.observe(s))
+        # a shard (re-)registered after rebuild must win over cached
+        # reconstructions immediately
+        self.store.on_ec_mount = self.degraded.invalidate
         self._stop = threading.Event()
         # immediate delta-push (reference store.go:40-64 change channels,
         # consumed by volume_grpc_client_to_master.go:57-185): volume
@@ -396,6 +411,7 @@ class VolumeServer:
 
     def status(self, req: Request):
         out = self.store.status()
+        out["ec_degraded"] = self.degraded.snapshot()
         if self.fast_plane is not None:
             out["fast_plane"] = {
                 "url": self.fast_url,
@@ -535,6 +551,11 @@ class VolumeServer:
         from .http_util import pool_stats_snapshot
         for event, total in pool_stats_snapshot().items():
             HTTP_POOL_CHURN_COUNTER.set_total(total, event)
+        # degraded-read engine counters (engine-global, same mirror
+        # pattern; the per-read latency histogram streams in live via
+        # the engine's on_read hook)
+        from ..stats.metrics import observe_degraded
+        observe_degraded(self.degraded.snapshot())
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -820,6 +841,10 @@ class VolumeServer:
         else:
             rebuilt = self.store.rebuild_ec_shards(
                 vid, collection, stats=stats)
+        if rebuilt:
+            # rebuilt shards serve from disk now; cached reconstructions
+            # of them are dead weight
+            self.degraded.invalidate(vid, rebuilt)
         return {"volume": vid, "rebuilt": rebuilt, "stats": stats,
                 "trace_id": tracing.current_trace_id()}
 
@@ -1590,7 +1615,12 @@ class VolumeServer:
     def _read_shard_from_holders(self, vid: int, sid: int, offset: int,
                                  size: int) -> Optional[bytes]:
         """Try each cached holder of one shard; forget holders that fail
-        (reference forgetShardId, store_ec.go:211)."""
+        (reference forgetShardId, store_ec.go:211). The per-holder
+        budget is SW_EC_DEGRADED_READ_TIMEOUT_S — the old hardcoded 30 s
+        let one dead holder eat the whole request deadline — and a
+        socket timeout forgets the holder exactly like an HTTP error."""
+        from ..ec.degraded import degraded_read_timeout_s
+        timeout = degraded_read_timeout_s()
         for holder in self._ec_shard_locations(vid).get(sid, []):
             if holder == self.url:
                 continue
@@ -1598,47 +1628,77 @@ class VolumeServer:
                 return http_call(
                     "GET",
                     f"http://{holder}/admin/ec/shard_read?volume={vid}"
-                    f"&shard={sid}&offset={offset}&size={size}", timeout=30)
-            except HttpError:
+                    f"&shard={sid}&offset={offset}&size={size}",
+                    timeout=timeout)
+            except (HttpError, OSError):
                 self._ec_loc_cache.forget(vid, sid, holder)
                 continue
         return None
 
     def _reconstruct_shard_range(self, vid, sid, offset, size) -> bytes:
-        """Fetch the same range of sibling shards — all remote fetches in
-        parallel, one RTT total (reference store_ec.go:329-362 launches a
-        goroutine per sibling) — and decode the missing shard."""
+        """Reconstruct-on-read of one lost shard's range (reference
+        store_ec.go:329-362). Served by the batched DegradedReadEngine
+        — coalesced fused-dispatch decode, exactly-k survivor gather,
+        slab LRU — unless SW_EC_DEGRADED_MODE=naive selects the
+        unbatched per-read path below (kept for A/B benching)."""
+        from ..ec.degraded import degraded_mode
+        if degraded_mode() == "naive":
+            return self._reconstruct_shard_range_naive(
+                vid, sid, offset, size)
+        return self.degraded.read(vid, sid, offset, size)
+
+    def _reconstruct_shard_range_naive(self, vid, sid, offset,
+                                       size) -> bytes:
+        """Per-read fallback. Still fixed relative to the original loop:
+        fetches only the first-k survivors the decode plan needs (never
+        all TOTAL_SHARDS-1 siblings) and decodes only the lost shard's
+        row (codec.lost_row_coeffs) instead of regenerating the full
+        stripe with codec.reconstruct."""
         from ..util.fanout import fan_out
         ev = self.store.find_ec_volume(vid)
-        shards: List[Optional[np.ndarray]] = [None] * TOTAL_SHARDS
+        locations = self._ec_shard_locations(vid)
+        codec = self.store.codec or get_codec(DATA_SHARDS, 4)
+
+        present = []
+        for other in range(codec.total):
+            if other == sid:
+                present.append(False)
+            elif ev is not None and other in ev.shards:
+                present.append(True)
+            else:
+                present.append(any(h != self.url
+                                   for h in locations.get(other, [])))
+        if sum(present) < DATA_SHARDS:
+            raise HttpError(
+                503, f"cannot reconstruct {vid}.{sid}: "
+                     f"{sum(present)} shards")
+        src, row = codec.lost_row_coeffs(tuple(present), sid)
 
         def pad(data: bytes) -> np.ndarray:
             if len(data) < size:  # shard tail: zero-pad like local reads
                 data = data + b"\x00" * (size - len(data))
             return np.frombuffer(data, dtype=np.uint8)
 
+        rows: List[Optional[np.ndarray]] = [None] * len(src)
         remote = []
-        for other in range(TOTAL_SHARDS):
-            if other == sid:
-                continue
+        for pos, other in enumerate(src):
             if ev is not None and other in ev.shards:
-                shards[other] = pad(ev.shards[other].read_at(offset, size))
+                rows[pos] = pad(ev.shards[other].read_at(offset, size))
             else:
-                remote.append(other)
-        have = sum(s is not None for s in shards)
-        if have < DATA_SHARDS:
-            for other, data, exc in fan_out(
-                    lambda o: self._read_shard_from_holders(
-                        vid, o, offset, size), remote, dedicated=True):
-                if exc is None and data is not None:
-                    shards[other] = pad(data)
-        have = sum(s is not None for s in shards)
-        if have < DATA_SHARDS:
+                remote.append(pos)
+        for pos, data, exc in fan_out(
+                lambda p: self._read_shard_from_holders(
+                    vid, src[p], offset, size), remote, dedicated=True):
+            if exc is None and data is not None:
+                rows[pos] = pad(data)
+        if any(r is None for r in rows):
+            have = sum(r is not None for r in rows)
             raise HttpError(
-                503, f"cannot reconstruct {vid}.{sid}: {have} shards")
-        codec = self.store.codec or get_codec(DATA_SHARDS, 4)
-        out = codec.reconstruct(shards)
-        return out[sid].tobytes()
+                503, f"cannot reconstruct {vid}.{sid}: {have} of "
+                     f"{len(src)} survivors answered")
+        from ..ops.codec import host_matmul
+        out = host_matmul(row, np.stack(rows, axis=0))
+        return out[0].tobytes()
 
     def _delete_ec_needle(self, req: Request, ev, vid, key):
         """EC delete: tombstone + journal locally, then broadcast to every

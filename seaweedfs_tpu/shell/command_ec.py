@@ -693,3 +693,36 @@ def ec_balance(env: CommandEnv, args: List[str]):
         moves += _balance_one_ec_volume(env, vid, collection, shards,
                                         node_rack)
     env.write(f"ec.balance: {moves} shard moves")
+
+
+@command("volume.ec.degraded",
+         ": per-server degraded-read engine status (reconstruct-on-read "
+         "batching, slab cache, survivor traffic)")
+def volume_ec_degraded(env: CommandEnv, args: List[str]):
+    nodes = env.cluster_nodes()
+    if not nodes:
+        env.write("no volume servers")
+        return
+    for node in nodes:
+        url = node["url"]
+        try:
+            snap = env.node_get(url, "/status").get("ec_degraded") or {}
+        except HttpError as e:
+            env.write(f"{url}  unreachable: {e}")
+            continue
+        reads = int(snap.get("reads", 0))
+        batches = int(snap.get("batches", 0))
+        coalesced = int(snap.get("batched_requests", 0))
+        avg_w = coalesced / batches if batches else 0.0
+        env.write(
+            f"{url}  reads={reads} batches={batches} "
+            f"width(avg/max)={avg_w:.1f}/{int(snap.get('max_batch_requests', 0))} "
+            f"hit_ratio={snap.get('cache_hit_ratio', 0.0):.2f} "
+            f"cache={int(snap.get('cache_bytes', 0)) >> 10}KB/"
+            f"{int(snap.get('cache_entries', 0))} slabs "
+            f"survivor={int(snap.get('survivor_bytes', 0)) >> 10}KB "
+            f"(remote {int(snap.get('remote_bytes', 0)) >> 10}KB) "
+            f"dispatch(host/dev)={int(snap.get('host_dispatches', 0))}/"
+            f"{int(snap.get('device_dispatches', 0))} "
+            f"p99={snap.get('p99_ms', 0.0):.1f}ms "
+            f"errors={int(snap.get('errors', 0))}")

@@ -384,6 +384,43 @@ def observe_spread(stats: Dict):
         VOLUME_EC_ENCODE_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
 
 
+# -- degraded reads (ec/degraded.py via observe_degraded) --------------------
+
+VOLUME_EC_DEGRADED_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_degraded_total",
+    "Degraded-read engine events by kind (reads, batches, "
+    "batched_requests, cache_hits, cache_misses, survivor_bytes, "
+    "remote_bytes, host_dispatches, device_dispatches, errors).",
+    labels=("kind",))
+DEGRADED_READ_HISTOGRAM = VOLUME_SERVER_GATHER.histogram(
+    "SeaweedFS_volumeServer_ec_degraded_read_seconds",
+    "Bucketed latency of reconstruct-on-read requests (the degraded "
+    "p99 lives here).")
+VOLUME_EC_DEGRADED_BATCH_WIDTH_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_degraded_batch_width",
+    "Concurrent reconstruct requests coalesced into the most recent "
+    "fused degraded-read dispatch.")
+VOLUME_EC_DEGRADED_HIT_RATIO_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_degraded_cache_hit_ratio",
+    "Reconstructed-slab LRU hit ratio since process start, 0..1.")
+
+
+def observe_degraded(snap: Dict):
+    """Mirror one DegradedReadEngine snapshot onto the volume registry
+    (engine counters are process-monotonic, so set_total like the
+    telemetry/pool-churn mirrors)."""
+    if not snap:
+        return
+    for kind in ("reads", "batches", "batched_requests", "cache_hits",
+                 "cache_misses", "survivor_bytes", "remote_bytes",
+                 "host_dispatches", "device_dispatches", "errors"):
+        VOLUME_EC_DEGRADED_COUNTER.set_total(snap.get(kind, 0), kind)
+    VOLUME_EC_DEGRADED_BATCH_WIDTH_GAUGE.set(
+        snap.get("last_batch_requests", 0))
+    VOLUME_EC_DEGRADED_HIT_RATIO_GAUGE.set(
+        snap.get("cache_hit_ratio", 0.0))
+
+
 class SmallDispatchTuner:
     """Fits the host/device crossover from the first-N reconstruct
     spans: device dispatch time is modeled as a + b*bytes (fixed

@@ -43,6 +43,11 @@ class Store:
         # NewEcShardsChan/DeletedEcShardsChan): lets the volume server
         # push a heartbeat delta immediately instead of waiting a pulse.
         self.on_change = None
+        # fired with (vid, mounted_shard_ids) after mount_ec_shards
+        # registers shards: the degraded-read engine drops its cached
+        # reconstructions of them — a shard back on disk (e.g. after
+        # rebuild) must be served from disk, not from the slab LRU.
+        self.on_ec_mount = None
         self.lock = threading.RLock()
         for loc in self.locations:
             loc.load_existing_volumes()
@@ -245,6 +250,9 @@ class Store:
                     ev.close()
             break
         if mounted:
+            cb = self.on_ec_mount
+            if cb is not None:
+                cb(vid, mounted)
             self._changed()
         return mounted
 

@@ -50,6 +50,10 @@ REQUIRED_FAMILIES = {
         "SeaweedFS_volumeServer_ec_repair_seconds_total",
         "SeaweedFS_volumeServer_ec_repair_bytes_frac",
         "SeaweedFS_volumeServer_ec_repair_symbol_bits_total",
+        "SeaweedFS_volumeServer_ec_degraded_total",
+        "SeaweedFS_volumeServer_ec_degraded_read_seconds",
+        "SeaweedFS_volumeServer_ec_degraded_batch_width",
+        "SeaweedFS_volumeServer_ec_degraded_cache_hit_ratio",
     ),
 }
 
@@ -94,6 +98,22 @@ def check_route_coverage(repo_root: str) -> list:
                 problems.append(
                     f"route-coverage: no test covering {repair_route} "
                     f"asserts a {status} error response")
+    # the degraded-read engine has no route of its own — reads enter
+    # through the public needle GET and fall through
+    # _reconstruct_shard_range — so the route scan above can't see it.
+    # Require tests to exercise the engine, the serving fallthrough and
+    # its metric families by name, like the repair mini-protocol above.
+    degraded_py = os.path.join(repo_root, "seaweedfs_tpu", "ec",
+                               "degraded.py")
+    if os.path.exists(degraded_py):
+        for token, what in (
+                ("DegradedReadEngine", "the engine"),
+                ("_reconstruct_shard_range", "the serving fallthrough"),
+                ("ec_degraded_", "the ec_degraded_* metric families")):
+            if token not in blob:
+                problems.append(
+                    f"degraded-coverage: no test under tests/ "
+                    f"references {token} ({what})")
     return problems
 
 
