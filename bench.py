@@ -534,6 +534,19 @@ def measure_batched_small_needles(n_volumes: int = 4,
         _shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _cluster_holder_health(master_url: str) -> dict:
+    """Per-holder {holder: score} from the master's /cluster/health
+    fold (forcing a scrape so the drill's fetches are in the EWMAs);
+    empty on any failure — health reporting must never fail a bench."""
+    from seaweedfs_tpu.server.http_util import get_json
+    try:
+        view = get_json(f"http://{master_url}/cluster/health?refresh=1")
+        return {holder: h.get("score")
+                for holder, h in (view.get("holders") or {}).items()}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                             backend: str = None) -> dict:
     """BASELINE config 5 (scaled): EC volume spread over a live cluster,
@@ -726,6 +739,16 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                    timings.get("gather_busy_s", 0.0), 2),
                "serialized_estimate_s": round(gather_s + compute_s, 2),
                "hedges_fired": timings.get("hedges_fired", 0),
+               # hedge-loss attribution + per-holder health (fleet
+               # health plane): which holders lost hedge races, how
+               # many range reads each holder served, and the cluster
+               # /cluster/health worst-observer scores — snapshots of
+               # slow-holder detection over time
+               "hedges_won": timings.get("hedges_won", 0),
+               "hedges_lost": timings.get("hedges_lost", 0),
+               "holder_fetches": timings.get("holder_fetches", {}),
+               "holder_errors": timings.get("holder_errors", {}),
+               "holder_health": _cluster_holder_health(master.url),
                # per-phase {name: seconds} from the rebuilder's spans
                # (gather/plan/dispatch/drain/write) plus the trace id —
                # the full span timeline is at the rebuilder's

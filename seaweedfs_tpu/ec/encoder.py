@@ -519,13 +519,20 @@ def _rebuild_coeffs(codec: ReedSolomonCodec, present: List[bool],
     """(len(missing), k) GF coefficients so that
     missing_rows = coeffs @ stack(first k surviving shards).
 
+    ``missing`` may be a subset of the shards absent from ``present``:
+    health-aware survivor selection masks surplus slow-holder shards
+    out of the presence vector without wanting them rebuilt, so only
+    the requested rows are sliced from the fused plan.
+
     Delegates to the codec's fused decode-plan cache (the same plan
     reconstruct() uses per-slab), so the derivation exists once —
     ops/gf256.decode_coeff_rows."""
     _, plan_missing, coeffs = codec.decode_plan(tuple(bool(p)
                                                       for p in present))
-    assert plan_missing == list(missing)
-    return coeffs
+    if plan_missing == list(missing):
+        return coeffs
+    rows = [plan_missing.index(i) for i in missing]
+    return np.ascontiguousarray(coeffs[rows])
 
 
 def ec_shard_base_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..stats import health as _health
 from ..util import tracing
 from ..util.profiling import StageTimer
 
@@ -115,20 +116,34 @@ class GatherStats:
         self.remote_bytes = 0
         self.hedges_fired = 0
         self.hedges_won = 0
+        self.hedges_lost = 0
         self.retries = 0
         self.stripes = 0
         self.peak_buffered = 0
         self.remote_shards = 0
         self.local_shards = 0
+        # per-holder accounting feeds the health scoreboard drill:
+        # "routing on issues strictly fewer reads to the slow holder"
+        # is only assertable if someone counts reads per holder
+        self.holder_fetches: Dict[str, int] = {}
+        self.holder_errors: Dict[str, int] = {}
 
     def add_fetch(self, nbytes: int, t0: float, t1: float,
-                  remote: bool = False):
+                  remote: bool = False, holder: Optional[str] = None):
         self.timer.add("gather", t1 - t0, nbytes, interval=(t0, t1))
         with self._lock:
             self.fetches += 1
             self.bytes += nbytes
             if remote:
                 self.remote_bytes += nbytes
+            if holder:
+                self.holder_fetches[holder] = \
+                    self.holder_fetches.get(holder, 0) + 1
+
+    def add_holder_error(self, holder: str):
+        with self._lock:
+            self.holder_errors[holder] = \
+                self.holder_errors.get(holder, 0) + 1
 
     def add_hedge_fired(self):
         with self._lock:
@@ -137,6 +152,10 @@ class GatherStats:
     def add_hedge_won(self):
         with self._lock:
             self.hedges_won += 1
+
+    def add_hedge_lost(self):
+        with self._lock:
+            self.hedges_lost += 1
 
     def add_retry(self):
         with self._lock:
@@ -159,9 +178,12 @@ class GatherStats:
                 "gather_fetches": self.fetches,
                 "hedges_fired": self.hedges_fired,
                 "hedges_won": self.hedges_won,
+                "hedges_lost": self.hedges_lost,
                 "gather_retries": self.retries,
                 "gather_stripes": self.stripes,
                 "peak_gather_buffer": self.peak_buffered,
+                "holder_fetches": dict(self.holder_fetches),
+                "holder_errors": dict(self.holder_errors),
             }
 
 
@@ -213,6 +235,8 @@ class RemoteShardReader:
     # projected-read route with a different method/response size while
     # inheriting rotation, failover and hedging unchanged
     _method = "GET"
+    # health-scoreboard latency kind for fetches issued by this reader
+    _health_kind = "shard_read"
 
     def _url(self, holder: str, off: int, n: int) -> str:
         return (f"http://{holder}/admin/ec/shard_read?volume={self.vid}"
@@ -232,14 +256,21 @@ class RemoteShardReader:
             hdrs = {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
         expect = self._expect_len(n)
         t0 = time.perf_counter()
-        data = http_call(self._method, self._url(holder, off, n),
-                         headers=hdrs, timeout=self.timeout)
-        if len(data) != expect:
-            raise HttpError(
-                502, f"short shard read {self.vid}.{self.sid} from "
-                     f"{holder} at {off}: {len(data)} < {expect}")
-        self.stats.add_fetch(len(data), t0, time.perf_counter(),
-                             remote=True)
+        try:
+            data = http_call(self._method, self._url(holder, off, n),
+                             headers=hdrs, timeout=self.timeout)
+            if len(data) != expect:
+                raise HttpError(
+                    502, f"short shard read {self.vid}.{self.sid} from "
+                         f"{holder} at {off}: {len(data)} < {expect}")
+        except Exception:
+            self.stats.add_holder_error(holder)
+            _health.BOARD.record_error(holder, self._health_kind)
+            raise
+        t1 = time.perf_counter()
+        self.stats.add_fetch(len(data), t0, t1, remote=True,
+                             holder=holder)
+        _health.BOARD.record_latency(holder, self._health_kind, t1 - t0)
         return data
 
     def _read_failover(self, order: Sequence[str], off: int,
@@ -254,12 +285,32 @@ class RemoteShardReader:
                 last = e
         raise last
 
+    def _attribute_hedge_loss(self, loser_future, loser: str,
+                              winner: str):
+        """The race is decided: whenever the losing duplicate finishes
+        draining (maybe much later), charge the loss to the losing
+        holder.  The loser's full latency is recorded by its own
+        _read_one when the drained duplicate completes — the timing
+        that used to be discarded — so the callback only needs to add
+        the hedge-loss attribution."""
+        self.stats.add_hedge_lost()
+
+        def _done(_f):
+            _health.BOARD.record_hedge_loss(loser, winner)
+
+        loser_future.add_done_callback(_done)
+
     def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
         h = self.holders
         # rotation both spreads load (consecutive stripes of a
         # replicated shard split across its holders) and fixes the
         # failover/hedge order for this stripe
         order = [h[(stripe_idx + j) % len(h)] for j in range(len(h))]
+        if len(order) > 1 and _health.routing_enabled():
+            # demote unhealthy holders to the back of the failover /
+            # hedge order (stable within each class, so the rotation's
+            # load-spreading survives among healthy peers)
+            order = _health.BOARD.order_by_health(order)
         if self.hedge_s <= 0 or len(order) < 2:
             return self._read_failover(order, off, n)
         ex = _hedge_pool()
@@ -286,6 +337,11 @@ class RemoteShardReader:
                 if err is None:
                     if f is secondary:
                         self.stats.add_hedge_won()
+                        self._attribute_hedge_loss(
+                            primary, order[0], order[1])
+                    else:
+                        self._attribute_hedge_loss(
+                            secondary, order[1], order[0])
                     return f.result()
                 last = err
         if len(order) > 2:
@@ -389,6 +445,7 @@ class RemoteRepairReader(RemoteShardReader):
     range. Rotation, failover and hedging come from the base class."""
 
     _method = "POST"
+    _health_kind = "repair_read"
 
     def __init__(self, vid: int, sid: int, holders: Sequence[str],
                  masks: Sequence[int],

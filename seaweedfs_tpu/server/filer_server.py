@@ -26,7 +26,7 @@ from ..filer.log_buffer import LogBuffer, event_notification
 from ..filer.filerstore import make_store
 from ..filer.stream import read_chunked
 from .http_util import (HttpError, HttpServer, Request, Response,
-                        Router, traces_handler)
+                        Router, traces_export_handler, traces_handler)
 
 CHUNK_SIZE_DEFAULT = 32 << 20  # reference -maxMB=32 autochunk default
 
@@ -57,6 +57,7 @@ class FilerServer:
                    self.meta_delete_chunks)
         router.add("GET", "/metrics", self.metrics_handler)
         router.add("GET", "/admin/traces", traces_handler)
+        router.add("GET", "/admin/traces/export", traces_export_handler)
         router.set_fallback(self.data_handler)
         from ..stats.metrics import (FILER_REQUEST_COUNTER,
                                      FILER_REQUEST_HISTOGRAM)
@@ -68,6 +69,7 @@ class FilerServer:
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
         self.host = host
+        router.node = f"{host}:{self.port}"
         self.master_url = master_url
         self.collection = collection
         self.replication = replication

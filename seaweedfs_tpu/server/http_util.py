@@ -195,6 +195,18 @@ def traces_handler(req: Request) -> dict:
     return {"traces": tracing.RING.recent(n)}
 
 
+def traces_export_handler(req: Request) -> dict:
+    """Chrome trace-event JSON for one trace from this node's ring
+    (``/admin/traces/export?trace=<id>``) — loadable in Perfetto as-is,
+    and carrying enough in event args for shell ``trace.export`` to
+    merge several nodes' exports into one skew-normalized timeline."""
+    from ..util import trace_export
+    tid = req.query.get("trace")
+    if not tid:
+        raise HttpError(400, "trace query parameter required")
+    return trace_export.chrome_trace_events(tracing.RING.get(tid))
+
+
 def process_memory_stats() -> dict:
     """Peak RSS of this process (reference statsMemoryHandler).
     ru_maxrss is kilobytes on Linux but BYTES on macOS/BSD."""
@@ -215,6 +227,11 @@ class Router:
         # observe(op_label, seconds, ok) after every request — the
         # servers plug their metric registries in here
         self.observe: Optional[Callable] = None
+        # "host:port" of the owning server, set once its port is known;
+        # stamped onto every server span so a merged trace export can
+        # attribute spans to nodes even when in-process servers share
+        # one trace ring
+        self.node: Optional[str] = None
 
     def add(self, method: str, path: str, fn: Callable,
             prefix: bool = False):
@@ -231,6 +248,8 @@ class Router:
         srv_span = tracing.start_span(
             f"{req.method} {req.path.split('?')[0]}",
             traceparent=req.headers.get(tracing.TRACEPARENT_HEADER))
+        if self.node:
+            srv_span.tags.setdefault("node", self.node)
         t0 = _time.monotonic()
         label = None
         try:
@@ -540,6 +559,7 @@ class _TunedHTTPServer(ThreadingHTTPServer):
 
 class HttpServer:
     def __init__(self, port: int, router: Router, host: str = "127.0.0.1"):
+        self.router = router
         self.httpd = _TunedHTTPServer((host, port), _make_handler(router))
         if _TLS["server_ctx"] is not None:
             self.httpd.socket = _TLS["server_ctx"].wrap_socket(

@@ -18,7 +18,7 @@ from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
 from .http_util import (HttpError, HttpServer, Request, Response,
                         Router, post_json, post_multipart,
-                        traces_handler)
+                        traces_export_handler, traces_handler)
 
 
 class MasterServer:
@@ -64,7 +64,10 @@ class MasterServer:
         router.add("*", "/cluster/volumes", self.cluster_volumes)
         router.add("GET", "/cluster/watch", self.cluster_watch)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/cluster/metrics", self.cluster_metrics)
+        router.add("GET", "/cluster/health", self.cluster_health)
         router.add("GET", "/admin/traces", traces_handler)
+        router.add("GET", "/admin/traces/export", traces_export_handler)
         router.add("GET", "/", self.ui_handler)
         router.add("GET", "/ui", self.ui_handler)
         # GET /<fid> on the master redirects to a holder (reference
@@ -96,6 +99,12 @@ class MasterServer:
         router.observe = observe
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
+        router.node = f"{host}:{self.port}"
+        # fleet health plane: scrape every heartbeating node's /metrics
+        # on SW_CLUSTER_SCRAPE_S and serve the merged view at
+        # /cluster/metrics (+ the per-holder fold at /cluster/health)
+        from ..stats.aggregate import ClusterMetricsAggregator
+        self.cluster_agg = ClusterMetricsAggregator(self._scrape_targets)
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._stop = threading.Event()
         # cron'd embedded shell (reference startAdminScripts,
@@ -253,6 +262,27 @@ class MasterServer:
         return Response(MASTER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
+    def _scrape_targets(self):
+        with self.topology.lock:
+            return [n.url for n in self.topology.all_nodes()]
+
+    def cluster_metrics(self, req: Request):
+        """Merged cluster exposition: counters/histograms summed across
+        nodes, gauges per-node under a node= label. ``?refresh=1``
+        forces a synchronous scrape sweep first (tests, impatient
+        operators); otherwise the background loop's snapshots serve."""
+        if req.query.get("refresh"):
+            self.cluster_agg.scrape_once()
+        return Response(self.cluster_agg.render().encode(),
+                        content_type="text/plain; version=0.0.4")
+
+    def cluster_health(self, req: Request):
+        """Per-holder health fold of every node's ec_holder_* families
+        (worst observer score wins) + per-node scrape freshness."""
+        if req.query.get("refresh"):
+            self.cluster_agg.scrape_once()
+        return self.cluster_agg.holder_health()
+
     def ui_handler(self, req: Request):
         """HTML status dashboard (reference master_ui/templates.go)."""
         from .http_util import Response
@@ -264,6 +294,7 @@ class MasterServer:
     def start(self):
         self.server.start()
         self._pruner.start()
+        self.cluster_agg.start()
         if self.raft is not None:
             self.raft.start()
         if self._maintenance_thread is not None:
@@ -274,6 +305,7 @@ class MasterServer:
 
     def stop(self):
         self._stop.set()
+        self.cluster_agg.stop()
         if self.raft is not None:
             self.raft.stop()
         self.server.stop()

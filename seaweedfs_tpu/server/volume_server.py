@@ -24,7 +24,8 @@ from ..storage.store import Store
 from ..storage.types import parse_file_id
 from ..storage.volume import NotFound, VolumeError, volume_file_prefix
 from .http_util import (HttpError, HttpServer, Request, Response, Router,
-                        get_json, http_call, post_json, traces_handler)
+                        get_json, http_call, post_json,
+                        traces_export_handler, traces_handler)
 
 
 class VolumeServer:
@@ -77,6 +78,7 @@ class VolumeServer:
                    self.admin_volume_tail_receive)
         router.add("GET", "/metrics", self.metrics_handler)
         router.add("GET", "/admin/traces", traces_handler)
+        router.add("GET", "/admin/traces/export", traces_export_handler)
         router.add("GET", "/stats/disk", self.stats_disk)
         router.add("GET", "/stats/memory", self.stats_memory)
         router.add("GET", "/ui", self.ui_handler)
@@ -94,6 +96,7 @@ class VolumeServer:
         self.server = HttpServer(port, router, host)
         self.port = self.server.port
         self.host = host
+        router.node = f"{host}:{self.port}"
         # master_url may list several seed masters; heartbeats follow
         # the leader hint and rotate seeds on failure (reference
         # volume_grpc_client_to_master.go:25-55)
@@ -556,6 +559,11 @@ class VolumeServer:
         # the engine's on_read hook)
         from ..stats.metrics import observe_degraded
         observe_degraded(self.degraded.snapshot())
+        # per-holder health scoreboard (process-global EWMAs fed by the
+        # gather/repair/degraded readers) — fresh scores on every scrape
+        # so the master's aggregator and /cluster/health see them
+        from ..stats.health import export_board
+        export_board()
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -1620,19 +1628,25 @@ class VolumeServer:
         let one dead holder eat the whole request deadline — and a
         socket timeout forgets the holder exactly like an HTTP error."""
         from ..ec.degraded import degraded_read_timeout_s
+        from ..stats.health import BOARD
         timeout = degraded_read_timeout_s()
         for holder in self._ec_shard_locations(vid).get(sid, []):
             if holder == self.url:
                 continue
+            t0 = time.perf_counter()
             try:
-                return http_call(
+                data = http_call(
                     "GET",
                     f"http://{holder}/admin/ec/shard_read?volume={vid}"
                     f"&shard={sid}&offset={offset}&size={size}",
                     timeout=timeout)
             except (HttpError, OSError):
+                BOARD.record_error(holder, "degraded_read")
                 self._ec_loc_cache.forget(vid, sid, holder)
                 continue
+            BOARD.record_latency(holder, "degraded_read",
+                                 time.perf_counter() - t0)
+            return data
         return None
 
     def _reconstruct_shard_range(self, vid, sid, offset, size) -> bytes:

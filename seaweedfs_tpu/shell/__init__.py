@@ -11,3 +11,4 @@ from . import command_ec  # noqa: F401  (registers ec.* commands)
 from . import command_fs  # noqa: F401  (registers fs.* commands)
 from . import command_bucket  # noqa: F401  (registers bucket.* commands)
 from . import command_collection  # noqa: F401
+from . import command_cluster  # noqa: F401  (cluster.health, trace.export)

@@ -1,0 +1,89 @@
+"""cluster.* / trace.* — fleet health plane commands.
+
+`cluster.health` renders the master's per-holder health fold
+(/cluster/health: worst-observer ec_holder_health scores, latency
+EWMAs, hedge-loss attribution); `trace.export` fans a trace id out to
+every cluster node's /admin/traces/export, merges the per-node Chrome
+trace events by span id, normalizes clock skew, and writes one
+Perfetto-loadable file.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from ..server.http_util import HttpError
+from ..util import trace_export
+from .command_env import CommandEnv, command, parse_flags
+
+
+@command("cluster.health",
+         "[-refresh false]: per-holder health scores aggregated across "
+         "the fleet (latency/error/hedge-loss EWMAs from every node's "
+         "reader stack; worst observer wins)")
+def cluster_health(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    path = "/cluster/health"
+    if flags.get("refresh", "true") != "false":
+        path += "?refresh=1"
+    view = env.master_get(path)
+    holders = view.get("holders") or {}
+    nodes = view.get("nodes") or []
+    fresh = sum(1 for n in nodes if not n.get("stale"))
+    env.write(f"cluster.health: {len(holders)} holders scored by "
+              f"{fresh}/{len(nodes)} fresh nodes")
+    for n in nodes:
+        if n.get("stale"):
+            err = n.get("last_error") or "no fresh scrape"
+            env.write(f"  node {n['node']}  STALE ({err})")
+    for holder in sorted(holders, key=lambda h: holders[h]["score"]):
+        h = holders[holder]
+        lats = " ".join(f"{kind}={ms:.1f}ms" for kind, ms in
+                        sorted(h.get("latency_ewma_ms", {}).items()))
+        ev = h.get("events", {})
+        env.write(
+            f"  {holder}  score={h['score']:.3f}"
+            f"{('  ' + lats) if lats else ''}"
+            f"  reads={int(ev.get('reads', 0))}"
+            f" errors={int(ev.get('errors', 0))}"
+            f" hedges_lost={int(ev.get('hedges_lost', 0))}")
+
+
+@command("trace.export",
+         "-trace <id> [-o <file>]: merge one trace's spans from every "
+         "cluster node into a single skew-normalized Chrome trace-event "
+         "file (open in Perfetto / chrome://tracing)")
+def trace_export_cmd(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    tid = flags.get("trace")
+    if not tid:
+        env.write("usage: trace.export -trace <id> [-o <file>]")
+        return
+    out_path = flags.get("o") or f"trace_{tid[:12]}.json"
+    targets = [env.master_url] + \
+        [n["url"] for n in env.cluster_nodes()]
+    span_lists = []
+    reached = 0
+    for url in targets:
+        try:
+            obj = env.node_get(url,
+                               f"/admin/traces/export?trace={tid}")
+        except HttpError as e:
+            env.write(f"  {url}  unreachable: {e}")
+            continue
+        reached += 1
+        span_lists.append(trace_export.spans_from_chrome(obj))
+    if not any(span_lists):
+        env.write(f"trace.export: no spans for trace {tid} on "
+                  f"{reached} reachable nodes")
+        return
+    merged = trace_export.merged_chrome_trace(span_lists)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    meta = merged.get("metadata", {})
+    env.write(
+        f"trace.export: {meta.get('span_count', 0)} spans from "
+        f"{len(meta.get('nodes', []))} nodes -> {out_path} "
+        f"(clock offsets: "
+        f"{json.dumps(meta.get('clock_offsets_s', {}))})")

@@ -340,12 +340,18 @@ def ec_rebuild(env: CommandEnv, args: List[str]):
 
 def _merge_rebuild_stats(timings: Dict, out: dict):
     """Fold the rebuilder's stats dict into the shell timings: numbers
-    sum across volumes, the per-phase breakdown merges per key."""
+    sum across volumes, dict-valued breakdowns (per-phase seconds,
+    per-holder fetch/error counts) merge per key."""
     for key, val in (out.get("stats") or {}).items():
         if key == "phases" and isinstance(val, dict):
             agg = timings.setdefault("phases", {})
             for ph, secs in val.items():
                 agg[ph] = round(agg.get(ph, 0.0) + secs, 6)
+        elif key in ("holder_fetches", "holder_errors") and \
+                isinstance(val, dict):
+            agg = timings.setdefault(key, {})
+            for holder, n in val.items():
+                agg[holder] = agg.get(holder, 0) + n
         elif isinstance(val, (int, float)):
             timings[key] = timings.get(key, 0) + val
         else:

@@ -1,0 +1,179 @@
+"""Per-holder health scoreboard (fleet health plane, half two).
+
+The streaming reader stack (ec/gather.py, ec/degraded.py) already
+measures every range read it issues: per-fetch latency, failures, and
+— since hedge losers are now attributed instead of silently drained —
+which holder lost each hedge race.  This module folds those signals
+into a 0..1 health score per holder:
+
+    lat_score   = ref_ms / (ref_ms + latency_ewma_ms)     # 1.0 at 0ms,
+                                                          # 0.5 at ref
+    score       = lat_score * (1 - err_ewma)
+                            * (1 - 0.5 * hedge_loss_ewma)
+
+clipped to [0, 1]; a holder with no data scores 1.0 (healthy until
+proven otherwise, so fresh clusters don't demote everyone).  Scores are
+exported as the `ec_holder_health` gauge family on every /metrics
+scrape (stats.metrics.observe_health), aggregated by the master at
+/cluster/health, and — behind SW_EC_HEALTH_ROUTING=1 — consulted by the
+gather rotation to demote unhealthy holders to the back of the
+failover/hedge order.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+# EWMA smoothing: each observation moves the average 20% of the way to
+# the new value, so ~10 observations forget an old regime.
+_ALPHA = 0.2
+
+# Latency yielding a 0.5 lat_score; overridable for tests/deployments
+# with a different healthy-fetch baseline.
+_DEF_REF_MS = 50.0
+
+
+def _ref_ms() -> float:
+    try:
+        return float(os.environ.get("SW_EC_HEALTH_REF_MS", _DEF_REF_MS))
+    except ValueError:
+        return _DEF_REF_MS
+
+
+def routing_enabled() -> bool:
+    return os.environ.get("SW_EC_HEALTH_ROUTING", "0") == "1"
+
+
+class HolderHealthBoard:
+    """Thread-safe EWMA scoreboard keyed by holder URL."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # holder -> kind -> latency EWMA (seconds)
+        self._lat: Dict[str, Dict[str, float]] = {}
+        # holder -> error-rate EWMA (0..1)
+        self._err: Dict[str, float] = {}
+        # holder -> hedge-loss-rate EWMA (0..1)
+        self._hedge: Dict[str, float] = {}
+        # holder -> event -> monotonic count
+        self._events: Dict[str, Dict[str, int]] = {}
+
+    # -- feeds (called from the reader stack) --------------------------------
+
+    def _bump(self, holder: str, event: str, n: int = 1):
+        ev = self._events.setdefault(holder, {})
+        ev[event] = ev.get(event, 0) + n
+
+    def record_latency(self, holder: str, kind: str, seconds: float):
+        """One successful range read against `holder` took `seconds`."""
+        if not holder or seconds < 0:
+            return
+        with self._lock:
+            kinds = self._lat.setdefault(holder, {})
+            prev = kinds.get(kind)
+            kinds[kind] = (seconds if prev is None
+                           else prev + _ALPHA * (seconds - prev))
+            self._err[holder] = (1 - _ALPHA) * self._err.get(holder, 0.0)
+            self._hedge[holder] = \
+                (1 - _ALPHA) * self._hedge.get(holder, 0.0)
+            self._bump(holder, "reads")
+
+    def record_error(self, holder: str, kind: str = "shard_read"):
+        """A range read against `holder` failed or timed out."""
+        if not holder:
+            return
+        with self._lock:
+            prev = self._err.get(holder, 0.0)
+            self._err[holder] = prev + _ALPHA * (1.0 - prev)
+            self._bump(holder, "errors")
+
+    def record_hedge_loss(self, loser: str, winner: str,
+                          loser_latency_s: Optional[float] = None):
+        """A hedged read raced `loser` against `winner` and the loser's
+        response arrived second (or never).  The loser's full latency —
+        measured when the drained duplicate finally completes — feeds
+        its latency EWMA too, so chronic hedge losers look slow even if
+        every fetch eventually succeeds."""
+        if not loser:
+            return
+        with self._lock:
+            prev = self._hedge.get(loser, 0.0)
+            self._hedge[loser] = prev + _ALPHA * (1.0 - prev)
+            self._bump(loser, "hedges_lost")
+            if winner:
+                self._bump(winner, "hedges_won_against")
+            if loser_latency_s is not None and loser_latency_s >= 0:
+                kinds = self._lat.setdefault(loser, {})
+                prev_lat = kinds.get("shard_read")
+                kinds["shard_read"] = (
+                    loser_latency_s if prev_lat is None
+                    else prev_lat + _ALPHA * (loser_latency_s - prev_lat))
+
+    # -- reads ---------------------------------------------------------------
+
+    def score(self, holder: str) -> float:
+        with self._lock:
+            return self._score_locked(holder)
+
+    def _score_locked(self, holder: str) -> float:
+        kinds = self._lat.get(holder)
+        err = self._err.get(holder, 0.0)
+        hedge = self._hedge.get(holder, 0.0)
+        if not kinds and not err and not hedge:
+            return 1.0
+        ref = _ref_ms()
+        worst_ms = max(kinds.values()) * 1000.0 if kinds else 0.0
+        lat_score = ref / (ref + worst_ms) if worst_ms > 0 else 1.0
+        score = lat_score * (1.0 - err) * (1.0 - 0.5 * hedge)
+        return min(1.0, max(0.0, score))
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Per-holder view for /metrics export and shell rendering."""
+        with self._lock:
+            holders = (set(self._lat) | set(self._err) | set(self._hedge)
+                       | set(self._events))
+            out = {}
+            for h in sorted(holders):
+                out[h] = {
+                    "score": round(self._score_locked(h), 4),
+                    "latency_ewma_ms": {
+                        kind: round(s * 1000.0, 3)
+                        for kind, s in self._lat.get(h, {}).items()},
+                    "error_ewma": round(self._err.get(h, 0.0), 4),
+                    "hedge_loss_ewma": round(self._hedge.get(h, 0.0), 4),
+                    "events": dict(self._events.get(h, {})),
+                }
+            return out
+
+    def order_by_health(self, holders: Sequence[str],
+                        threshold: float = 0.5) -> List[str]:
+        """Stable-partition `holders` into healthy-first order: holders
+        scoring below `threshold` keep their relative order but move to
+        the back of the failover/hedge rotation."""
+        with self._lock:
+            scores = {h: self._score_locked(h) for h in holders}
+        healthy = [h for h in holders if scores[h] >= threshold]
+        unhealthy = [h for h in holders if scores[h] < threshold]
+        return healthy + unhealthy
+
+    def reset(self):
+        with self._lock:
+            self._lat.clear()
+            self._err.clear()
+            self._hedge.clear()
+            self._events.clear()
+
+
+# Process-global board: every reader in this process (rebuild gather,
+# trace repair, degraded engine) feeds the same scoreboard, mirroring
+# the module-global metric registries.
+BOARD = HolderHealthBoard()
+
+
+def export_board():
+    """Push the current board onto the ec_holder_* metric families;
+    called from /metrics handlers so scrapes always see fresh scores."""
+    from .metrics import observe_health
+    observe_health(BOARD.snapshot())

@@ -25,6 +25,215 @@ def _escape_label_value(value) -> str:
             .replace("\n", "\\n"))
 
 
+def _escape_help(text) -> str:
+    """HELP lines escape only backslash and line feed (the value is not
+    quoted, so double quotes pass through verbatim)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _unescape_help(text: str) -> str:
+    out = []
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            nxt = text[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _unescape_label_value(value: str) -> str:
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ('"', "\\"):
+                out.append(nxt)
+            else:           # unknown escape: keep verbatim
+                out.append(c)
+                out.append(nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _fmt_value(v) -> str:
+    """Render a sample value so that parse(render(v)) == v exactly.
+
+    Integral values print without a decimal point (matching the plain
+    int rendering of histogram bucket counts); everything else uses
+    repr(), Python's shortest round-trip float representation.  The
+    %g formatting this replaces silently truncated to 6 significant
+    digits, which broke the render->parse->render fixed point for
+    large counters."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _parse_value(text: str) -> float:
+    t = text.strip()
+    if t in ("+Inf", "Inf"):
+        return float("inf")
+    if t == "-Inf":
+        return float("-inf")
+    if t == "NaN":
+        return float("nan")
+    return float(t)
+
+
+def _parse_labels(body: str) -> Tuple[Tuple[str, str], ...]:
+    """Parse the inside of a {...} label block, honoring escapes."""
+    pairs = []
+    i = 0
+    n = len(body)
+    while i < n:
+        while i < n and body[i] in ", ":
+            i += 1
+        if i >= n:
+            break
+        eq = body.index("=", i)
+        name = body[i:eq].strip()
+        i = eq + 1
+        if i >= n or body[i] != '"':
+            raise ValueError(f"unquoted label value in {body!r}")
+        i += 1
+        raw = []
+        while i < n:
+            c = body[i]
+            if c == "\\" and i + 1 < n:
+                raw.append(body[i:i + 2])
+                i += 2
+                continue
+            if c == '"':
+                break
+            raw.append(c)
+            i += 1
+        if i >= n:
+            raise ValueError(f"unterminated label value in {body!r}")
+        i += 1  # closing quote
+        pairs.append((name, _unescape_label_value("".join(raw))))
+    return tuple(pairs)
+
+
+def parse_prometheus_text(text: str) -> List[Dict]:
+    """Parse a Prometheus text exposition back into sample families.
+
+    Returns an ordered list of dicts:
+        {"name": family name, "kind": counter|gauge|histogram|untyped,
+         "help": help text,
+         "samples": [(sample_name, ((label, value), ...), float), ...]}
+
+    Histogram child series (`_bucket`/`_sum`/`_count`) are grouped under
+    their family.  Designed as the exact inverse of Registry.render():
+    render -> parse -> render_families is a fixed point, so the cluster
+    aggregator can merge scraped text without dropping samples."""
+    families: List[Dict] = []
+    by_name: Dict[str, Dict] = {}
+
+    def family_for_sample(sample_name: str) -> Dict:
+        # histogram children carry suffixes; try the longest prefix
+        for cand in (sample_name, sample_name.rsplit("_bucket", 1)[0],
+                     sample_name.rsplit("_sum", 1)[0],
+                     sample_name.rsplit("_count", 1)[0]):
+            fam = by_name.get(cand)
+            if fam is not None:
+                return fam
+        fam = {"name": sample_name, "kind": "untyped", "help": "",
+               "samples": []}
+        families.append(fam)
+        by_name[sample_name] = fam
+        return fam
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            help_text = _unescape_help(help_text)
+            fam = by_name.get(name)
+            if fam is None:
+                fam = {"name": name, "kind": "untyped", "help": help_text,
+                       "samples": []}
+                families.append(fam)
+                by_name[name] = fam
+            else:
+                fam["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) >= 2:
+                name, kind = parts[0], parts[1]
+                fam = by_name.get(name)
+                if fam is None:
+                    fam = {"name": name, "kind": kind, "help": "",
+                           "samples": []}
+                    families.append(fam)
+                    by_name[name] = fam
+                else:
+                    fam["kind"] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        # sample line: name[{labels}] value
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ValueError(f"malformed sample line: {line!r}")
+            sample_name = line[:brace]
+            labels = _parse_labels(line[brace + 1:close])
+            value = _parse_value(line[close + 1:])
+        else:
+            sample_name, _, value_text = line.partition(" ")
+            labels = ()
+            value = _parse_value(value_text)
+        family_for_sample(sample_name)["samples"].append(
+            (sample_name, labels, value))
+    return families
+
+
+def render_families(families: List[Dict]) -> str:
+    """Render parsed families back to exposition text — the inverse of
+    parse_prometheus_text, and line-identical to Registry.render() for
+    text that originated there."""
+    lines: List[str] = []
+    for fam in families:
+        lines.append(f"# HELP {fam['name']} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {fam['name']} {fam['kind']}")
+        for sample_name, labels, value in fam["samples"]:
+            if labels:
+                body = ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in labels)
+                lines.append(f"{sample_name}{{{body}}} {_fmt_value(value)}")
+            else:
+                lines.append(f"{sample_name} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
 def _fmt_labels(label_names, label_values) -> str:
     if not label_names:
         return ""
@@ -44,7 +253,7 @@ class _Metric:
         self._lock = threading.Lock()
 
     def header(self) -> List[str]:
-        return [f"# HELP {self.name} {self.help}",
+        return [f"# HELP {self.name} {_escape_help(self.help)}",
                 f"# TYPE {self.name} {self.kind}"]
 
 
@@ -77,7 +286,7 @@ class Counter(_Metric):
             for lv, v in sorted(self._values.items()):
                 out.append(
                     f"{self.name}"
-                    f"{_fmt_labels(self.label_names, lv)} {v:g}")
+                    f"{_fmt_labels(self.label_names, lv)} {_fmt_value(v)}")
         return out
 
 
@@ -102,7 +311,7 @@ class Gauge(_Metric):
             for lv, v in sorted(self._values.items()):
                 out.append(
                     f"{self.name}"
-                    f"{_fmt_labels(self.label_names, lv)} {v:g}")
+                    f"{_fmt_labels(self.label_names, lv)} {_fmt_value(v)}")
         return out
 
 
@@ -146,7 +355,7 @@ class Histogram(_Metric):
                     f"{self.name}_bucket{labels} {self._totals[lv]}")
                 base = _fmt_labels(self.label_names, lv)
                 out.append(f"{self.name}_sum{base} "
-                           f"{self._sums[lv]:g}")
+                           f"{_fmt_value(self._sums[lv])}")
                 out.append(f"{self.name}_count{base} "
                            f"{self._totals[lv]}")
         return out
@@ -223,6 +432,25 @@ MASTER_REQUEST_HISTOGRAM = MASTER_GATHER.histogram(
     "Bucketed histogram of master request processing time.",
     labels=("type",))
 
+# -- fleet health plane: cluster scrape (stats/aggregate.py) -----------------
+
+CLUSTER_SCRAPE_COUNTER = MASTER_GATHER.counter(
+    "SeaweedFS_master_cluster_scrape_total",
+    "Cluster /metrics scrape attempts by outcome (ok, error).",
+    labels=("outcome",))
+CLUSTER_SCRAPE_SECONDS = MASTER_GATHER.histogram(
+    "SeaweedFS_master_cluster_scrape_seconds",
+    "Bucketed duration of one full cluster scrape sweep.")
+CLUSTER_NODE_UP_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_cluster_node_up",
+    "1 if the node's last /metrics scrape succeeded, 0 if it is stale.",
+    labels=("node",))
+CLUSTER_NODES_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_cluster_scraped_nodes",
+    "Nodes currently held by the cluster aggregator, by freshness "
+    "(fresh, stale).",
+    labels=("state",))
+
 # -- EC phase spans (fed by util/tracing via observe_span) -------------------
 
 EC_PHASE_NAMES = ("gather", "plan", "dispatch", "drain", "write")
@@ -249,7 +477,7 @@ SMALL_DISPATCH_SUGGESTED_GAUGE = VOLUME_SERVER_GATHER.gauge(
 VOLUME_EC_GATHER_COUNTER = VOLUME_SERVER_GATHER.counter(
     "SeaweedFS_volumeServer_ec_gather_total",
     "Streaming-rebuild gather events by kind (bytes, fetches, stripes, "
-    "retries, hedges_fired, hedges_won).",
+    "retries, hedges_fired, hedges_won, hedges_lost).",
     labels=("kind",))
 VOLUME_EC_GATHER_SECONDS = VOLUME_SERVER_GATHER.counter(
     "SeaweedFS_volumeServer_ec_gather_seconds_total",
@@ -280,7 +508,8 @@ def observe_gather(stats: Dict):
                       ("stripes", "gather_stripes"),
                       ("retries", "gather_retries"),
                       ("hedges_fired", "hedges_fired"),
-                      ("hedges_won", "hedges_won")):
+                      ("hedges_won", "hedges_won"),
+                      ("hedges_lost", "hedges_lost")):
         n = stats.get(key)
         if n:
             VOLUME_EC_GATHER_COUNTER.inc(kind, amount=n)
@@ -382,6 +611,40 @@ def observe_spread(stats: Dict):
         VOLUME_EC_SPREAD_MBPS_GAUGE.set(stats["spread_mbps"])
     if "overlap_frac" in stats:
         VOLUME_EC_ENCODE_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
+
+
+# -- per-holder health scoreboard (stats/health.py) --------------------------
+
+HOLDER_HEALTH_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_holder_health",
+    "0..1 health score per shard holder as seen by this node's reader "
+    "stack (1.0 = healthy / no data; latency, error and hedge-loss "
+    "EWMAs folded in).",
+    labels=("holder",))
+HOLDER_LATENCY_EWMA_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_holder_latency_ewma_ms",
+    "EWMA of per-fetch latency against each holder, by read kind "
+    "(shard_read, repair_read, degraded_read).",
+    labels=("holder", "kind"))
+HOLDER_EVENT_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_holder_events_total",
+    "Per-holder reader-stack events (reads, errors, hedges_lost, "
+    "hedges_won_against).",
+    labels=("holder", "event"))
+
+
+def observe_health(snapshot: Dict):
+    """Mirror one HolderHealthBoard snapshot (stats/health.py) onto the
+    volume registry; called on every /metrics scrape so the master-side
+    aggregator sees fresh per-holder scores."""
+    if not snapshot:
+        return
+    for holder, h in snapshot.items():
+        HOLDER_HEALTH_GAUGE.set(h["score"], holder)
+        for kind, ewma_ms in h.get("latency_ewma_ms", {}).items():
+            HOLDER_LATENCY_EWMA_GAUGE.set(ewma_ms, holder, kind)
+        for event, n in h.get("events", {}).items():
+            HOLDER_EVENT_COUNTER.set_total(n, holder, event)
 
 
 # -- degraded reads (ec/degraded.py via observe_degraded) --------------------

@@ -397,7 +397,9 @@ class Store:
                     vid, base, local, present, missing, sources, sized,
                     stats, slab, window, hedge_ms, root, mode)
             if rebuilt is None:
-                src = [i for i, p in enumerate(present) if p][:k]
+                gather_present = self._health_survivor_mask(
+                    present, local, sources, k, stats)
+                src = [i for i, p in enumerate(gather_present) if p][:k]
                 gstats = gather.GatherStats()
                 readers = []
                 for i in src:
@@ -415,8 +417,8 @@ class Store:
                     readers, shard_size, slab=eff_slab,
                     window=window, stats=gstats, parent_span=root)
                 rebuilt = ec_encoder.rebuild_ec_files_streaming(
-                    base, present, missing, source, codec=self.codec,
-                    slab=eff_slab, stats=stats)
+                    base, gather_present, missing, source,
+                    codec=self.codec, slab=eff_slab, stats=stats)
                 if stats is not None:
                     stats["repair_mode"] = "full"
             t0 = _time.perf_counter()
@@ -427,6 +429,39 @@ class Store:
                 stats["phases"]["write"] = round(
                     stats["phases"].get("write", 0.0) + ecx_s, 6)
         return rebuilt
+
+    @staticmethod
+    def _health_survivor_mask(present, local, sources, k, stats):
+        """Health-aware survivor selection for the full streaming
+        gather. With more than k survivors reachable and
+        SW_EC_HEALTH_ROUTING=1, the surplus shards are dropped from the
+        decode plan worst-holder-first (local shards score a perfect
+        1.0), so a slow or erroring holder is demoted out of the gather
+        entirely when healthier survivors can cover the k. Decoding
+        from any k survivors is exact, so the rebuilt bytes are
+        bit-identical regardless of which surplus shards are masked.
+        Ties drop the highest shard ids, matching the un-routed
+        first-k selection."""
+        from ..stats import health as _health
+        survivors = [i for i, p in enumerate(present) if p]
+        surplus = len(survivors) - k
+        if surplus <= 0 or not _health.routing_enabled():
+            return present
+
+        def shard_score(i):
+            if local[i] or not sources.get(i):
+                return 1.0
+            return max(_health.BOARD.score(u) for u in sources[i])
+
+        masked = list(present)
+        drop_order = sorted(survivors,
+                            key=lambda i: (shard_score(i), -i))
+        demoted = sorted(drop_order[:surplus])
+        for i in demoted:
+            masked[i] = False
+        if stats is not None:
+            stats["health_demoted_shards"] = demoted
+        return masked
 
     def _rebuild_streaming_trace(self, vid, base, local, present,
                                  missing, sources, sized, stats, slab,

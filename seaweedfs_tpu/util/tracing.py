@@ -83,21 +83,34 @@ class Span:
         }
 
 
+_LOWER_HEX = frozenset("0123456789abcdef")
+
+
 def parse_traceparent(header) -> Optional[Tuple[str, str]]:
-    """``00-<trace>-<span>-<flags>`` -> (trace_id, parent_span_id)."""
+    """``00-<trace>-<span>-<flags>`` -> (trace_id, parent_span_id).
+
+    Strictly W3C (trace-context §3.2): ids must be lowercase hex —
+    uppercase is invalid on the wire, and ``int(x, 16)`` would happily
+    continue a bogus trace under a casing no other participant can
+    match — and all-zero trace/span ids mean "not sampled / invalid"
+    and must start a fresh root instead of threading onto id 0."""
     if not header:
         return None
     parts = str(header).strip().split("-")
     if len(parts) != 4:
         return None
-    _, trace_id, span_id, _ = parts
-    if len(trace_id) != 32 or len(span_id) != 16:
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
         return None
-    try:
-        int(trace_id, 16), int(span_id, 16)
-    except ValueError:
+    if not (_LOWER_HEX.issuperset(version)
+            and _LOWER_HEX.issuperset(trace_id)
+            and _LOWER_HEX.issuperset(span_id)
+            and _LOWER_HEX.issuperset(flags)):
         return None
-    if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+    if version == "ff":          # forbidden version value
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
         return None
     return trace_id, span_id
 

@@ -1,0 +1,618 @@
+"""Fleet health plane: cluster-aggregated metrics (/cluster/metrics),
+per-holder health scoring (/cluster/health, SW_EC_HEALTH_ROUTING), and
+merged Perfetto trace export (/admin/traces/export, trace.export)."""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.stats.aggregate import ClusterMetricsAggregator
+from seaweedfs_tpu.stats.health import BOARD, HolderHealthBoard
+from seaweedfs_tpu.stats.metrics import (Registry, parse_prometheus_text,
+                                         render_families)
+from seaweedfs_tpu.util import trace_export, tracing
+from seaweedfs_tpu.util.tracing import parse_traceparent
+
+
+class TestPrometheusRoundTrip:
+    """render -> parse -> render must be a fixed point: the aggregator
+    re-renders what it scraped, so any asymmetry corrupts the merged
+    /cluster/metrics view."""
+
+    def _assert_fixed_point(self, registry):
+        text = registry.render()
+        fams = parse_prometheus_text(text)
+        assert render_families(fams) == text
+        # idempotent through a second cycle too
+        assert render_families(parse_prometheus_text(
+            render_families(fams))) == render_families(fams)
+
+    def test_counter_round_trip(self):
+        r = Registry()
+        c = r.counter("req_total", "requests served", labels=("op", "path"))
+        c.inc("get", "/x")
+        c.inc("get", "/x")
+        # 8 significant digits: a %g-style renderer would truncate
+        c.inc("put", "/y", amount=12345678)
+        self._assert_fixed_point(r)
+
+    def test_escaped_labels_round_trip(self):
+        r = Registry()
+        c = r.counter("esc_total", 'help with "quotes"\nand newline',
+                      labels=("weird",))
+        c.inc('back\\slash "quote"\nnewline')
+        text = r.render()
+        fams = parse_prometheus_text(text)
+        assert render_families(fams) == text
+        # the parsed label VALUE is the unescaped original
+        (_, labels, value), = fams[-1]["samples"]
+        assert dict(labels)["weird"] == 'back\\slash "quote"\nnewline'
+        assert value == 1
+
+    def test_gauge_and_float_precision_round_trip(self):
+        r = Registry()
+        g = r.gauge("temp", "temperature", labels=("room",))
+        g.set(36.5, "a")
+        g.set(0.30000000000000004, "b")     # shortest-repr float
+        g.set(-2.5e-7, "c")
+        self._assert_fixed_point(r)
+
+    def test_histogram_round_trip(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", "latency", labels=("op",),
+                        buckets=(0.01, 0.5, 2.0))
+        for v in (0.005, 0.25, 5.25):
+            h.observe(v, "get")
+        text = r.render()
+        assert 'lat_seconds_bucket{op="get",le="+Inf"} 3' in text
+        self._assert_fixed_point(r)
+
+    def test_live_registries_round_trip(self):
+        from seaweedfs_tpu.stats import metrics as m
+        for reg in (m.MASTER_GATHER, m.VOLUME_SERVER_GATHER,
+                    m.FILER_GATHER):
+            self._assert_fixed_point(reg)
+
+    def test_parse_rejects_malformed_labels(self):
+        with pytest.raises(ValueError):
+            parse_prometheus_text('x_total{op=unquoted} 1\n')
+        with pytest.raises(ValueError):
+            parse_prometheus_text('x_total{op="unterminated} 1\n')
+
+
+class TestTraceparentStrict:
+    TRACE = "0af7651916cd43dd8448eb211c80319c"
+    SPAN = "b7ad6b7169203331"
+
+    def test_valid(self):
+        assert parse_traceparent(
+            f"00-{self.TRACE}-{self.SPAN}-01") == (self.TRACE, self.SPAN)
+
+    def test_uppercase_hex_rejected(self):
+        assert parse_traceparent(
+            f"00-{self.TRACE.upper()}-{self.SPAN}-01") is None
+        assert parse_traceparent(
+            f"00-{self.TRACE}-{self.SPAN.upper()}-01") is None
+
+    def test_all_zero_ids_rejected(self):
+        assert parse_traceparent(
+            f"00-{'0' * 32}-{self.SPAN}-01") is None
+        assert parse_traceparent(
+            f"00-{self.TRACE}-{'0' * 16}-01") is None
+
+    def test_version_ff_rejected(self):
+        assert parse_traceparent(
+            f"ff-{self.TRACE}-{self.SPAN}-01") is None
+
+    def test_malformed_shapes_rejected(self):
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("") is None
+        assert parse_traceparent("00-abc-def") is None
+        assert parse_traceparent(
+            f"00-{self.TRACE[:-2]}-{self.SPAN}-01") is None
+        assert parse_traceparent(
+            f"00-{self.TRACE}-{self.SPAN}xx-01") is None
+        assert parse_traceparent(
+            f"00-{self.TRACE}-{self.SPAN}-01-extra") is None
+        assert parse_traceparent(
+            f"0g-{self.TRACE}-{self.SPAN}-01") is None
+
+
+class TestHolderHealthBoard:
+    def test_no_data_scores_healthy(self):
+        b = HolderHealthBoard()
+        assert b.score("nobody:8080") == 1.0
+
+    def test_slow_holder_scores_below_fast(self, monkeypatch):
+        monkeypatch.setenv("SW_EC_HEALTH_REF_MS", "50")
+        b = HolderHealthBoard()
+        for _ in range(10):
+            b.record_latency("fast:1", "shard_read", 0.002)
+            b.record_latency("slow:2", "shard_read", 0.200)
+        assert b.score("slow:2") < 0.5 < b.score("fast:1")
+        # 200ms EWMA against a 50ms ref: 50 / 250
+        assert b.score("slow:2") == pytest.approx(0.2, rel=0.05)
+
+    def test_errors_degrade_and_successes_recover(self):
+        b = HolderHealthBoard()
+        for _ in range(10):
+            b.record_error("h:1")
+        degraded = b.score("h:1")
+        assert degraded < 0.2
+        for _ in range(30):
+            b.record_latency("h:1", "shard_read", 0.001)
+        assert b.score("h:1") > degraded
+        assert b.score("h:1") > 0.9
+
+    def test_hedge_loss_attribution(self):
+        b = HolderHealthBoard()
+        b.record_hedge_loss("loser:1", "winner:2", loser_latency_s=0.3)
+        snap = b.snapshot()
+        assert snap["loser:1"]["events"]["hedges_lost"] == 1
+        assert snap["winner:2"]["events"]["hedges_won_against"] == 1
+        assert snap["loser:1"]["latency_ewma_ms"]["shard_read"] == \
+            pytest.approx(300.0)
+        assert b.score("loser:1") < 1.0
+
+    def test_order_by_health_stable_partition(self):
+        b = HolderHealthBoard()
+        for _ in range(10):
+            b.record_error("bad:1")
+        order = b.order_by_health(["a:1", "bad:1", "b:2", "c:3"])
+        assert order == ["a:1", "b:2", "c:3", "bad:1"]
+        # unknown holders keep their relative order
+        assert b.order_by_health(["x:1", "y:2"]) == ["x:1", "y:2"]
+
+    def test_reset(self):
+        b = HolderHealthBoard()
+        b.record_error("h:1")
+        b.reset()
+        assert b.score("h:1") == 1.0
+        assert b.snapshot() == {}
+
+
+def _expo(*families: str) -> str:
+    return "".join(families)
+
+
+class TestClusterAggregator:
+    COUNTER_A = ("# HELP req_total reqs\n# TYPE req_total counter\n"
+                 'req_total{op="get"} 2\n')
+    COUNTER_B = ("# HELP req_total reqs\n# TYPE req_total counter\n"
+                 'req_total{op="get"} 3\nreq_total{op="put"} 7\n')
+    GAUGE_A = "# TYPE temp gauge\ntemp 36.5\n"
+    GAUGE_B = "# TYPE temp gauge\ntemp 40\n"
+    HIST_A = ("# TYPE lat_seconds histogram\n"
+              'lat_seconds_bucket{le="0.5"} 1\n'
+              'lat_seconds_bucket{le="+Inf"} 2\n'
+              "lat_seconds_sum 5.25\nlat_seconds_count 2\n")
+    HIST_B = ("# TYPE lat_seconds histogram\n"
+              'lat_seconds_bucket{le="0.5"} 4\n'
+              'lat_seconds_bucket{le="+Inf"} 4\n'
+              "lat_seconds_sum 0.75\nlat_seconds_count 4\n")
+
+    def _agg(self, texts):
+        return ClusterMetricsAggregator(
+            lambda: list(texts), interval_s=60,
+            fetch=lambda url: texts[url])
+
+    def test_counters_sum_and_gauges_keep_node_label(self):
+        texts = {"n1:1": _expo(self.COUNTER_A, self.GAUGE_A),
+                 "n2:2": _expo(self.COUNTER_B, self.GAUGE_B)}
+        agg = self._agg(texts)
+        assert agg.scrape_once() == 2
+        out = agg.render()
+        assert 'req_total{op="get"} 5' in out
+        assert 'req_total{op="put"} 7' in out
+        assert 'temp{node="n1:1"} 36.5' in out
+        assert 'temp{node="n2:2"} 40' in out
+        assert 'cluster_node_up{node="n1:1"} 1' in out
+        # merged text is itself valid exposition
+        assert render_families(parse_prometheus_text(out)) == out
+
+    def test_histogram_buckets_merge_bucket_wise(self):
+        texts = {"n1:1": self.HIST_A, "n2:2": self.HIST_B}
+        agg = self._agg(texts)
+        agg.scrape_once()
+        out = agg.render()
+        assert 'lat_seconds_bucket{le="0.5"} 5' in out
+        assert 'lat_seconds_bucket{le="+Inf"} 6' in out
+        assert "lat_seconds_sum 6" in out
+        assert "lat_seconds_count 6" in out
+
+    def test_failed_scrape_marks_node_stale(self):
+        texts = {"ok:1": self.COUNTER_A}
+
+        def fetch(url):
+            if url == "dead:2":
+                raise OSError("connection refused")
+            return texts[url]
+
+        agg = ClusterMetricsAggregator(lambda: ["ok:1", "dead:2"],
+                                       interval_s=60, fetch=fetch)
+        assert agg.scrape_once() == 1
+        status = {n["node"]: n for n in agg.node_status()}
+        assert not status["ok:1"]["stale"]
+        assert status["dead:2"]["stale"]
+        assert "connection refused" in status["dead:2"]["last_error"]
+        out = agg.render()
+        assert 'cluster_node_up{node="dead:2"} 0' in out
+        assert 'cluster_node_up{node="ok:1"} 1' in out
+
+    def test_aged_out_node_leaves_the_merge(self):
+        texts = {"n1:1": self.COUNTER_A, "n2:2": self.COUNTER_B}
+        nodes = ["n1:1", "n2:2"]
+        agg = ClusterMetricsAggregator(lambda: list(nodes),
+                                       interval_s=60,
+                                       fetch=lambda url: texts[url])
+        agg.scrape_once()
+        assert 'req_total{op="get"} 5' in agg.render()
+        # n2 disappears from heartbeats and its snapshot goes ancient
+        nodes.remove("n2:2")
+        snap = agg._nodes["n2:2"]
+        snap.last_success -= agg.age_out_s + 1
+        snap.last_attempt -= agg.age_out_s + 1
+        agg.scrape_once()
+        out = agg.render()
+        assert 'req_total{op="get"} 2' in out
+        assert "n2:2" not in out
+
+    def test_holder_health_fold_worst_observer_wins(self):
+        fam = ("# TYPE SeaweedFS_volumeServer_ec_holder_health gauge\n"
+               'SeaweedFS_volumeServer_ec_holder_health{holder="h:1"} %s\n'
+               "# TYPE SeaweedFS_volumeServer_ec_holder_latency_ewma_ms"
+               " gauge\n"
+               "SeaweedFS_volumeServer_ec_holder_latency_ewma_ms"
+               '{holder="h:1",kind="shard_read"} %s\n'
+               "# TYPE SeaweedFS_volumeServer_ec_holder_events_total"
+               " counter\n"
+               "SeaweedFS_volumeServer_ec_holder_events_total"
+               '{holder="h:1",event="reads"} %s\n')
+        texts = {"n1:1": fam % (0.9, 12.0, 10),
+                 "n2:2": fam % (0.4, 80.0, 4)}
+        agg = self._agg(texts)
+        agg.scrape_once()
+        view = agg.holder_health()
+        h = view["holders"]["h:1"]
+        assert h["score"] == 0.4
+        assert h["observers"] == {"n1:1": 0.9, "n2:2": 0.4}
+        assert h["latency_ewma_ms"]["shard_read"] == 80.0
+        assert h["events"]["reads"] == 14
+
+
+def _span(sid, parent, name, start, dur, node=None, trace="t" * 8):
+    tags = {"node": node} if node else {}
+    return {"trace_id": trace, "span_id": sid, "parent_id": parent,
+            "name": name, "start": start, "duration_s": dur,
+            "tags": tags}
+
+
+class TestTraceExport:
+    def test_assign_nodes_inherits_nearest_ancestor(self):
+        spans = [
+            _span("a", None, "root", 100.0, 1.0),
+            _span("b", "a", "rpc", 100.1, 0.5, node="vs:1"),
+            _span("c", "b", "phase", 100.2, 0.1),
+        ]
+        nodes = trace_export.assign_nodes(spans)
+        assert nodes == {"a": "client", "b": "vs:1", "c": "vs:1"}
+
+    def test_merge_spans_dedupes_preferring_node_tagged(self):
+        tagged = _span("b", "a", "rpc", 1.0, 0.5, node="vs:1")
+        untagged = _span("b", "a", "rpc", 1.0, 0.5)
+        merged = trace_export.merge_spans([[untagged], [tagged],
+                                           [untagged]])
+        assert len(merged) == 1
+        assert merged[0]["tags"]["node"] == "vs:1"
+
+    def test_skew_normalization_nests_child_in_parent(self):
+        # node B's wall clock runs 5 s AHEAD: its recorded start is
+        # true_start + 5
+        spans = [
+            _span("a", None, "root", 100.0, 0.5, node="A"),
+            _span("b", "a", "child", 105.1, 0.3, node="B"),
+        ]
+        offsets = trace_export.estimate_node_offsets(spans)
+        assert offsets["A"] == 0.0
+        assert offsets["B"] == pytest.approx(-5.0, abs=0.11)
+        out = trace_export.chrome_trace_events(spans, offsets=offsets)
+        xs = {e["name"]: e for e in out["traceEvents"]
+              if e["ph"] == "X"}
+        root, child = xs["root"], xs["child"]
+        assert root["ts"] <= child["ts"]
+        assert child["ts"] + child["dur"] <= \
+            root["ts"] + root["dur"] + 1e-3
+        assert all(e["ts"] >= 0 for e in out["traceEvents"]
+                   if e["ph"] == "X")
+
+    def test_chrome_round_trip_and_metadata(self):
+        spans = [
+            _span("a", None, "root", 10.0, 1.0, node="m:1"),
+            _span("b", "a", "rpc", 10.1, 0.5, node="vs:2"),
+            _span("c", "b", "phase", 10.2, 0.2),
+        ]
+        merged = trace_export.merged_chrome_trace([spans])
+        blob = json.dumps(merged)       # must be JSON-serializable
+        loaded = json.loads(blob)
+        assert loaded["metadata"]["span_count"] == 3
+        assert set(loaded["metadata"]["nodes"]) == {"m:1", "vs:2"}
+        procs = {e["args"]["name"] for e in loaded["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert procs == {"m:1", "vs:2"}
+        back = trace_export.spans_from_chrome(loaded)
+        assert {(s["span_id"], s["parent_id"], s["name"], s["start"],
+                 s["duration_s"]) for s in back} == \
+            {(s["span_id"], s["parent_id"], s["name"], s["start"],
+              s["duration_s"]) for s in spans}
+
+
+class TestHealthSurvivorMask:
+    def test_mask_demotes_slow_holder_surplus(self, monkeypatch):
+        from seaweedfs_tpu.storage.store import Store
+        monkeypatch.setenv("SW_EC_HEALTH_ROUTING", "1")
+        BOARD.reset()
+        for _ in range(10):
+            BOARD.record_latency("slow:1", "shard_read", 0.5)
+            BOARD.record_latency("fast:2", "shard_read", 0.001)
+        try:
+            total, k = 6, 4
+            present = [True] * total
+            local = [False] * total
+            sources = {0: ["slow:1"], 1: ["fast:2"], 2: ["slow:1"],
+                       3: ["fast:2"], 4: ["slow:1"], 5: ["fast:2"]}
+            stats = {}
+            masked = Store._health_survivor_mask(
+                present, local, sources, k, stats)
+            # surplus of 2: the two highest-id slow shards are demoted
+            assert stats["health_demoted_shards"] == [2, 4]
+            assert [i for i, p in enumerate(masked) if p] == [0, 1, 3, 5]
+            # routing off, or no surplus: untouched
+            monkeypatch.delenv("SW_EC_HEALTH_ROUTING")
+            assert Store._health_survivor_mask(
+                present, local, sources, k, {}) is present
+            monkeypatch.setenv("SW_EC_HEALTH_ROUTING", "1")
+            assert Store._health_survivor_mask(
+                present, local, sources, total, {}) is present
+        finally:
+            BOARD.reset()
+
+    def test_mask_ties_match_unrouted_first_k(self, monkeypatch):
+        from seaweedfs_tpu.storage.store import Store
+        monkeypatch.setenv("SW_EC_HEALTH_ROUTING", "1")
+        BOARD.reset()
+        present = [True] * 5
+        masked = Store._health_survivor_mask(
+            present, [False] * 5, {i: ["h:1"] for i in range(5)}, 3, {})
+        # all scores tie at 1.0: drop the highest ids, i.e. keep the
+        # same first-k the un-routed selection uses
+        assert [i for i, p in enumerate(masked) if p] == [0, 1, 2]
+
+
+class TestFleetHealthCluster:
+    """3-server drill: one holder +200 ms slower; its health score
+    drops below its peers, SW_EC_HEALTH_ROUTING=1 sends strictly fewer
+    range reads its way at bit-identical output, /cluster/metrics sums
+    per-node counters, and trace.export merges one rebuild's spans from
+    every server into a single Chrome trace file."""
+
+    def test_slow_holder_drill(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.ec.constants import TOTAL_SHARDS
+        from seaweedfs_tpu.server.http_util import (get_json, http_call,
+                                                    post_json)
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.shell.command_env import CommandEnv, \
+            run_command
+
+        monkeypatch.delenv("SW_EC_HEALTH_ROUTING", raising=False)
+        monkeypatch.setenv("SW_EC_HEALTH_REF_MS", "50")
+        BOARD.reset()
+        master = MasterServer(port=0, volume_size_limit_mb=64,
+                              pulse_seconds=1).start()
+        servers = [VolumeServer(
+            port=0, directories=[str(tmp_path / f"v{i}")],
+            master_url=master.url, pulse_seconds=1,
+            max_volume_counts=[20], ec_backend="numpy").start()
+            for i in range(3)]
+        try:
+            a = op.assign(master.url, collection="fh")
+            vid = int(a["fid"].split(",")[0])
+            rng = np.random.default_rng(8)
+            payload = rng.integers(0, 256, 400_000).astype(
+                np.uint8).tobytes()
+            fid = f"{vid},100000001"
+            op.upload(a["url"], fid, payload, filename="f1")
+            env = CommandEnv(master.url, out=io.StringIO())
+            run_command(env, f"ec.encode -volumeId {vid}")
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                              f"?volumeId={vid}")
+                if len(ec.get("shards", {})) == TOTAL_SHARDS:
+                    break
+                time.sleep(0.2)
+            shards = {int(s): u for s, u in ec["shards"].items()}
+            assert len(shards) == TOTAL_SHARDS
+
+            by_holder = {}
+            for sid, urls in shards.items():
+                by_holder.setdefault(urls[0], []).append(sid)
+            assert len(by_holder) == 3
+            # slow down the holder of shard 0 (guaranteed in the
+            # un-routed first-k gather set) by +200 ms per shard read
+            slow_url = shards[0][0]
+            slow_vs = next(s for s in servers if s.url == slow_url)
+            self._delay_route(slow_vs, "/admin/ec/shard_read", 0.2)
+            # rebuilder: a healthy server; victim shard: a healthy
+            # NON-rebuilder holder, so both rounds see the identical
+            # survivor layout and the slow holder keeps all its shards
+            healthy = [u for u in by_holder if u != slow_url]
+            rebuilder, victim_holder = healthy[0], healthy[1]
+            lost = max(by_holder[victim_holder])
+            self._drop_shard(master, victim_holder, vid, "fh", lost)
+
+            # --- round A: routing OFF (also warms the health board)
+            sources = {str(s): u for s, u in shards.items()
+                       if s != lost and rebuilder not in u}
+            out_a = post_json(
+                f"http://{rebuilder}/admin/ec/rebuild?volume={vid}"
+                f"&collection=fh",
+                {"sources": sources, "repair": "full"}, timeout=120)
+            assert out_a["rebuilt"] == [lost]
+            fetches_off = out_a["stats"]["holder_fetches"]
+            assert fetches_off.get(slow_url, 0) > 0
+            post_json(f"http://{rebuilder}/admin/ec/mount?volume={vid}"
+                      f"&collection=fh&shards={lost}")
+
+            # health scores: the slow holder drops below every peer
+            # within one scrape (?refresh=1 forces the sweep)
+            view = get_json(f"http://{master.url}/cluster/health"
+                            f"?refresh=1")
+            holders = view["holders"]
+            assert slow_url in holders
+            peers = [h for h in holders if h != slow_url]
+            assert peers
+            assert all(holders[slow_url]["score"] <
+                       holders[p]["score"] for p in peers)
+            assert holders[slow_url]["score"] < 0.5
+            assert all(not n["stale"] for n in view["nodes"])
+
+            # merged /cluster/metrics: summed families equal the sum of
+            # the per-node scrapes, bucket-wise for histograms
+            merged = http_call(
+                "GET", f"http://{master.url}/cluster/metrics?refresh=1"
+            ).decode()
+            self._assert_merge_sums(merged, servers,
+                                    "ec_phase_seconds_total")
+            assert merged.count("cluster_node_up{") == 3
+
+            # merged trace export: one Chrome trace with spans from >=3
+            # distinct servers under a single trace id
+            tid = out_a["trace_id"]
+            out_file = tmp_path / "rebuild_trace.json"
+            run_command(env, f"trace.export -trace {tid} "
+                             f"-o {out_file}")
+            trace = json.loads(out_file.read_text())
+            xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+            assert xs
+            assert {e["args"]["trace_id"] for e in xs} == {tid}
+            span_nodes = {e["args"]["node"] for e in xs}
+            assert len({n for n in span_nodes if ":" in n}) >= 3
+            assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+            names = {e["name"] for e in xs}
+            assert "ec.rebuild.stream" in names
+            assert "GET /admin/ec/shard_read" in names
+            # per-node export route answers directly too, and refuses a
+            # missing trace id
+            per_node = get_json(f"http://{servers[0].url}"
+                                f"/admin/traces/export?trace={tid}")
+            assert any(e.get("ph") == "X"
+                       for e in per_node["traceEvents"])
+            with pytest.raises(Exception):
+                get_json(f"http://{servers[0].url}"
+                         f"/admin/traces/export")
+
+            # --- round B: routing ON, identical survivor layout
+            self._drop_shard(master, rebuilder, vid, "fh", lost)
+            monkeypatch.setenv("SW_EC_HEALTH_ROUTING", "1")
+            out_b = post_json(
+                f"http://{rebuilder}/admin/ec/rebuild?volume={vid}"
+                f"&collection=fh",
+                {"sources": sources, "repair": "full"}, timeout=120)
+            assert out_b["rebuilt"] == [lost]
+            assert out_b["stats"].get("health_demoted_shards")
+            fetches_on = out_b["stats"]["holder_fetches"]
+            assert fetches_on.get(slow_url, 0) < fetches_off[slow_url]
+            post_json(f"http://{rebuilder}/admin/ec/mount?volume={vid}"
+                      f"&collection=fh&shards={lost}")
+            # bit-identical service after the routed rebuild
+            assert op.read_file(master.url, fid) == payload
+        finally:
+            monkeypatch.delenv("SW_EC_HEALTH_ROUTING", raising=False)
+            BOARD.reset()
+            for vs in servers:
+                vs.stop()
+            master.stop()
+
+    @staticmethod
+    def _delay_route(vs, path, delay):
+        routes = vs.server.router.routes
+        for i, (method, p, prefix, fn) in enumerate(routes):
+            if p == path:
+                def slowed(req, _fn=fn):
+                    time.sleep(delay)
+                    return _fn(req)
+                routes[i] = (method, p, prefix, slowed)
+                return
+        raise AssertionError(f"route {path} not found")
+
+    @staticmethod
+    def _drop_shard(master, holder, vid, collection, sid):
+        from seaweedfs_tpu.server.http_util import get_json, post_json
+        post_json(f"http://{holder}/admin/ec/unmount?volume={vid}"
+                  f"&shards={sid}")
+        post_json(f"http://{holder}/admin/ec/delete_shards"
+                  f"?volume={vid}&collection={collection}"
+                  f"&shards={sid}")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                          f"?volumeId={vid}")
+            held = {int(s): u for s, u in
+                    ec.get("shards", {}).items()}
+            if sid not in held:
+                return
+            time.sleep(0.2)
+        raise AssertionError(f"shard {sid} still mapped after delete")
+
+    @staticmethod
+    def _assert_merge_sums(merged_text, servers, family_suffix):
+        from seaweedfs_tpu.server.http_util import http_call
+        want = {}
+        for vs in servers:
+            text = http_call(
+                "GET", f"http://{vs.url}/metrics").decode()
+            for fam in parse_prometheus_text(text):
+                if not fam["name"].endswith(family_suffix):
+                    continue
+                for sample_name, labels, value in fam["samples"]:
+                    key = (sample_name, labels)
+                    want[key] = want.get(key, 0.0) + value
+        assert want, f"no {family_suffix} samples on any node"
+        got = {}
+        for fam in parse_prometheus_text(merged_text):
+            if not fam["name"].endswith(family_suffix):
+                continue
+            for sample_name, labels, value in fam["samples"]:
+                got[(sample_name, labels)] = value
+        for key, total in want.items():
+            assert got[key] == pytest.approx(total, rel=1e-6), key
+
+
+class TestTraceExportRouteOnRing:
+    def test_export_serves_current_ring(self):
+        """/admin/traces/export renders whatever the in-process ring
+        holds for the id — exercised here without a cluster."""
+        from seaweedfs_tpu.server.http_util import HttpError, \
+            traces_export_handler
+        root = tracing.start_span("unit.root")
+        child = tracing.start_span("unit.child")
+        tracing.finish_span(child)
+        tracing.finish_span(root)
+        tid = root.trace_id
+
+        class Req:
+            def __init__(self, **query):
+                self.query = query
+
+        with pytest.raises(HttpError):
+            traces_export_handler(Req())
+        out = traces_export_handler(Req(trace=tid))
+        xs = [e for e in out["traceEvents"] if e["ph"] == "X"]
+        assert {e["args"]["span_id"] for e in xs} >= \
+            {root.span_id, child.span_id}
+        assert all(e["args"]["trace_id"] == tid for e in xs)

@@ -35,7 +35,16 @@ RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
 # dashboards/bench assertions reference them by name, so renaming or
 # dropping one must fail the lint, not silently flatline a panel
 REQUIRED_FAMILIES = {
+    "master": (
+        "SeaweedFS_master_cluster_scrape_total",
+        "SeaweedFS_master_cluster_scrape_seconds",
+        "SeaweedFS_master_cluster_node_up",
+        "SeaweedFS_master_cluster_scraped_nodes",
+    ),
     "volume": (
+        "SeaweedFS_volumeServer_ec_holder_health",
+        "SeaweedFS_volumeServer_ec_holder_latency_ewma_ms",
+        "SeaweedFS_volumeServer_ec_holder_events_total",
         "SeaweedFS_volumeServer_ec_phase_seconds_total",
         "SeaweedFS_volumeServer_ec_gather_total",
         "SeaweedFS_volumeServer_ec_gather_seconds_total",
@@ -114,6 +123,25 @@ def check_route_coverage(repo_root: str) -> list:
                 problems.append(
                     f"degraded-coverage: no test under tests/ "
                     f"references {token} ({what})")
+    # fleet health plane: every observability route must be exercised by
+    # a test — these feed dashboards and the health-routing decision, so
+    # an untested one can silently serve garbage
+    master_py = os.path.join(repo_root, "seaweedfs_tpu", "server",
+                             "master.py")
+    with open(master_py, encoding="utf-8") as f:
+        master_src = f.read()
+    for route, src, src_name in (
+            ("/cluster/metrics", master_src, "master.py"),
+            ("/cluster/health", master_src, "master.py"),
+            ("/admin/traces/export", master_src, "master.py")):
+        if f'"{route}"' not in src:
+            problems.append(
+                f"route-coverage: {route} is not registered in "
+                f"{src_name}")
+        elif route not in blob:
+            problems.append(
+                f"route-coverage: {route} is registered in {src_name} "
+                f"but no test references it")
     return problems
 
 
