@@ -64,11 +64,13 @@ SLAB_BYTES_ENV = "SW_EC_DEGRADED_SLAB_BYTES"
 BATCH_MS_ENV = "SW_EC_DEGRADED_BATCH_MS"
 READ_TIMEOUT_ENV = "SW_EC_DEGRADED_READ_TIMEOUT_S"
 MODE_ENV = "SW_EC_DEGRADED_MODE"
+READAHEAD_ENV = "SW_EC_DEGRADED_READAHEAD_SLABS"
 
 DEFAULT_CACHE_BYTES = 64 << 20
 DEFAULT_SLAB_BYTES = 128 << 10
 DEFAULT_BATCH_MS = 2.0
 DEFAULT_READ_TIMEOUT_S = 10.0
+DEFAULT_READAHEAD_SLABS = 1
 
 
 def _env_num(name: str, default, cast=float):
@@ -95,6 +97,14 @@ def degraded_read_timeout_s() -> float:
     30 s meant one dead holder could eat the whole request deadline
     before failover even started; default well under it."""
     return max(0.1, _env_num(READ_TIMEOUT_ENV, DEFAULT_READ_TIMEOUT_S))
+
+
+def degraded_readahead_slabs() -> int:
+    """Neighbor slabs reconstructed per batch beyond the requested
+    range: the batch is already paying a gather + dispatch, so widening
+    it by a slab is nearly free and sequential readers of a dead shard
+    hit the LRU instead of a fresh batch. 0 disables."""
+    return max(0, _env_num(READAHEAD_ENV, DEFAULT_READAHEAD_SLABS, int))
 
 
 def degraded_mode() -> str:
@@ -125,6 +135,13 @@ class SlabCache:
             self._entries.move_to_end(key)
             self.hits += 1
             return hit
+
+    def peek(self, key: tuple) -> Optional[bytes]:
+        """Presence probe that counts as neither hit nor miss and does
+        not touch LRU order — readahead planning must not distort the
+        cache stats or promote entries it only inspects."""
+        with self._lock:
+            return self._entries.get(key)
 
     def put(self, key: tuple, data: bytes):
         if self.max_bytes <= 0 or len(data) > self.max_bytes:
@@ -206,6 +223,7 @@ class DegradedReadEngine:
                  slab: Optional[int] = None,
                  batch_ms: Optional[float] = None,
                  hedge_ms: Optional[float] = None,
+                 readahead: Optional[int] = None,
                  on_read=None):
         self.store = store
         self._locations = locations
@@ -216,8 +234,12 @@ class DegradedReadEngine:
         self.batch_s = (degraded_batch_ms() if batch_ms is None
                         else float(batch_ms)) / 1000.0
         self._hedge_ms = hedge_ms
+        self.readahead = (degraded_readahead_slabs() if readahead is None
+                          else max(0, int(readahead)))
         self.cache = SlabCache(degraded_cache_bytes()
                                if cache_bytes is None else cache_bytes)
+        # readahead-produced cache keys, so hits on them are attributable
+        self._ra_keys: set = set()
         self.size_cache = ShardSizeCache(timeout=degraded_read_timeout_s())
         self.on_read = on_read
         self._lock = threading.Lock()
@@ -231,6 +253,7 @@ class DegradedReadEngine:
             "survivor_bytes": 0, "remote_bytes": 0,
             "hedges_fired": 0, "hedges_won": 0, "retries": 0,
             "host_dispatches": 0, "device_dispatches": 0,
+            "readahead_slabs": 0, "readahead_hits": 0,
         }
         # the gather pool is shared across batches: a batch needs at
         # most k concurrent range reads and batches for different lost
@@ -281,6 +304,9 @@ class DegradedReadEngine:
         looked = out["cache_hits"] + out["cache_misses"]
         out["cache_hit_ratio"] = (out["cache_hits"] / looked) if looked \
             else 0.0
+        out["readahead_hit_ratio"] = \
+            (out["readahead_hits"] / out["readahead_slabs"]) \
+            if out["readahead_slabs"] else 0.0
         if lat:
             out["p50_ms"] = lat[len(lat) // 2] * 1000.0
             out["p99_ms"] = lat[min(len(lat) - 1,
@@ -299,13 +325,32 @@ class DegradedReadEngine:
         parts: Dict[int, bytes] = {}
         want: List[int] = []
         for idx in range(first, last + 1):
-            hit = self.cache.get((vid, sid, idx))
+            key = (vid, sid, idx)
+            hit = self.cache.get(key)
             if hit is None:
                 want.append(idx)
             else:
                 parts[idx] = hit
+                with self._lock:
+                    if key in self._ra_keys:
+                        self._ra_keys.discard(key)
+                        self._c["readahead_hits"] += 1
         if want:
-            parts.update(self._batched(vid, sid, want))
+            # the batch is already paying a gather + fused dispatch, so
+            # widen it by the readahead window: neighbor slabs land in
+            # the LRU and the next sequential read never reaches here
+            ra = self.readahead if self.cache.max_bytes > 0 else 0
+            extra = [idx for idx in range(last + 1, last + 1 + ra)
+                     if self.cache.peek((vid, sid, idx)) is None]
+            got = self._batched(vid, sid, want + extra)
+            parts.update({i: got[i] for i in want})
+            with self._lock:
+                for idx in extra:
+                    if got.get(idx):
+                        self._ra_keys.add((vid, sid, idx))
+                        self._c["readahead_slabs"] += 1
+                if len(self._ra_keys) > 8192:  # evicted keys pile up
+                    self._ra_keys.clear()
         out = bytearray()
         for idx in range(first, last + 1):
             seg = parts[idx]
@@ -513,13 +558,14 @@ class DegradedReadEngine:
         shard's single coefficient row. Below the small-dispatch
         crossover the host LUT walk wins; above it the batch streams
         through the device kernel."""
-        from ..ops.codec import host_matmul, small_dispatch_override
+        from ..ops.codec import dispatch_threshold, host_matmul
         data = blocks[0] if len(blocks) == 1 else \
             np.concatenate(blocks, axis=1)
         width = data.shape[1]
-        thr = codec.small_dispatch_bytes
-        if thr and small_dispatch_override() is not None:
-            thr = small_dispatch_override()
+        # dispatch_threshold folds the env default AND the
+        # SW_EC_SMALL_DISPATCH_AUTO fitted crossover, so the tuner's
+        # suggestion steers batches without reconstructing the codec
+        thr = dispatch_threshold(codec)
         host = (not thr) or width < thr or width == 0
         with tracing.span("dispatch", backend=codec.backend,
                           bytes=int(data.nbytes),

@@ -309,7 +309,7 @@ class EcVolume:
 
     def destroy(self):
         self.close()
-        for ext in (".ecx", ".ecj", ".vif"):
+        for ext in (".ecx", ".ecj", ".vif", ".scrub"):
             p = self.base_name + ext
             if os.path.exists(p):
                 os.remove(p)

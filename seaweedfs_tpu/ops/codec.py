@@ -90,6 +90,19 @@ def maybe_auto_apply_small_dispatch(suggestion: int) -> bool:
     return True
 
 
+def dispatch_threshold(codec) -> int:
+    """Live host/device crossover width for a codec: the
+    SW_EC_SMALL_DISPATCH_AUTO fitted override (installed by the tuner
+    via set_small_dispatch_override) supersedes whatever the codec
+    snapshotted at construction, so a tuner suggestion applies without
+    reconstructing the codec; host-only codecs
+    (small_dispatch_bytes == 0) never delegate to the device."""
+    if not codec.small_dispatch_bytes:
+        return 0
+    ov = small_dispatch_override()
+    return ov if ov is not None else codec.small_dispatch_bytes
+
+
 class _ConstCache:
     """Bounded LRU of device-resident coefficient constants, keyed by
     the coefficient bytes. A 256 MB rebuild must upload its ~14 KB
@@ -142,6 +155,7 @@ class ReedSolomonCodec:
         self.matrix = gf256.build_matrix(self.k, self.total, matrix_kind)
         self._decode_cache: dict = {}
         self._plan_cache: dict = {}
+        self._syndrome_rows: Optional[np.ndarray] = None
 
     # -- primitive ---------------------------------------------------------
     def _matmul(self, coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
@@ -285,6 +299,25 @@ class ReedSolomonCodec:
                                   np.asarray(shards[self.k + i], dtype=np.uint8)):
                 return False
         return True
+
+    def syndrome_plan(self) -> np.ndarray:
+        """Parity-check rows H = [P | I_m], shape (m, k+m), derived from
+        the cached encode matrix ([I_k; P] for systematic codes).
+
+        For a consistent codeword column x (all k+m shard bytes at one
+        offset), H @ x = P @ data XOR parity = 0 — GF(2^8) addition IS
+        subtraction, so the identity block needs no negation. Any
+        nonzero syndrome byte pins corruption to that byte column, and
+        the scrub verifies a whole slab as ONE (m, k+m) x (k+m, w)
+        fused matmul — the same PipelinedMatmul hot path encode and
+        rebuild ride, with coefficients swapped. Cached like the decode
+        plans: steady-state scrub pays zero GF planning per slab."""
+        if self._syndrome_rows is None:
+            h = np.zeros((self.m, self.total), dtype=np.uint8)
+            h[:, : self.k] = self.matrix[self.k:]
+            h[:, self.k:] = np.eye(self.m, dtype=np.uint8)
+            self._syndrome_rows = np.ascontiguousarray(h)
+        return self._syndrome_rows
 
 
 class NumpyCodec(ReedSolomonCodec):

@@ -56,6 +56,7 @@ class FilerServer:
         router.add("POST", "/filer/meta/delete_chunks",
                    self.meta_delete_chunks)
         router.add("GET", "/metrics", self.metrics_handler)
+        router.add("GET", "/stats/integrity", self.stats_integrity)
         router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/admin/traces/export", traces_export_handler)
         router.set_fallback(self.data_handler)
@@ -194,6 +195,16 @@ class FilerServer:
 
     def status_handler(self, req: Request):
         return {"version": "seaweedfs-tpu", "master": self.master_url}
+
+    def stats_integrity(self, req: Request):
+        """Data-integrity view for filer clients: the master's repair
+        queue (open incidents, time-to-re-protection), so an S3/filer
+        operator sees durability exposure without master access."""
+        import json as _json
+        from .http_util import http_call
+        out = http_call(
+            "GET", f"http://{self.master_url}/cluster/repairs", timeout=10)
+        return _json.loads(out or b"{}")
 
     def events_handler(self, req: Request):
         since = float(req.query.get("since", 0) or 0)

@@ -66,6 +66,9 @@ class MasterServer:
         router.add("GET", "/metrics", self.metrics_handler)
         router.add("GET", "/cluster/metrics", self.cluster_metrics)
         router.add("GET", "/cluster/health", self.cluster_health)
+        router.add("GET", "/cluster/repairs", self.cluster_repairs)
+        router.add("POST", "/cluster/scrub_report",
+                   self.cluster_scrub_report)
         router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/admin/traces/export", traces_export_handler)
         router.add("GET", "/", self.ui_handler)
@@ -105,6 +108,20 @@ class MasterServer:
         # /cluster/metrics (+ the per-holder fold at /cluster/health)
         from ..stats.aggregate import ClusterMetricsAggregator
         self.cluster_agg = ClusterMetricsAggregator(self._scrape_targets)
+        # integrity plane: scrub findings + topology scans + health
+        # signals feed a priority queue that drives repairs and accounts
+        # time-to-re-protection (stats/repair_queue.py)
+        from ..stats.repair_queue import RepairQueue
+        self.repair_queue = RepairQueue()
+        # vids whose stripe the scan has seen complete at least once —
+        # only those can report lost shards (mid-encode holes are not
+        # losses)
+        self._repair_seen_complete: set = set()
+        self.repair_interval = self._env_f("SW_REPAIR_INTERVAL_S", 5.0)
+        self.at_risk_score = self._env_f("SW_REPAIR_AT_RISK_SCORE", 0.4)
+        self._repair_thread = threading.Thread(
+            target=self._repair_loop, daemon=True) \
+            if self.repair_interval > 0 else None
         self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
         self._stop = threading.Event()
         # cron'd embedded shell (reference startAdminScripts,
@@ -256,9 +273,17 @@ class MasterServer:
         out = http_call(req.method, url, req.body or None, headers)
         return _json.loads(out or b"{}")
 
+    @staticmethod
+    def _env_f(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return default
+
     def metrics_handler(self, req: Request):
-        from ..stats.metrics import MASTER_GATHER
+        from ..stats.metrics import MASTER_GATHER, observe_repair_queue
         from .http_util import Response
+        observe_repair_queue(self.repair_queue.snapshot())
         return Response(MASTER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
 
@@ -278,10 +303,50 @@ class MasterServer:
 
     def cluster_health(self, req: Request):
         """Per-holder health fold of every node's ec_holder_* families
-        (worst observer score wins) + per-node scrape freshness."""
+        (worst observer score wins) + per-node scrape freshness + the
+        repair queue's open-incident / time-to-re-protection summary."""
         if req.query.get("refresh"):
             self.cluster_agg.scrape_once()
-        return self.cluster_agg.holder_health()
+        out = self.cluster_agg.holder_health()
+        out["repairs"] = self.repair_queue.summary()
+        return out
+
+    def cluster_repairs(self, req: Request):
+        """Integrity-plane view: open incidents by priority, recently
+        resolved ones with their time-to-re-protection, and queue
+        counters. ``?refresh=1`` runs a topology/health scan first so
+        tests and operators see lost shards without waiting a repair
+        interval."""
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
+        if req.query.get("refresh"):
+            self._repair_scan()
+        return self.repair_queue.snapshot()
+
+    def cluster_scrub_report(self, req: Request):
+        """Scrub corruption findings from volume servers. One incident
+        per (volume, corrupt shard); an unattributed finding (locator
+        could not pin a shard) opens one incident keyed shard=-1 so the
+        exposure is still tracked."""
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
+        finding = req.json()
+        vid = int(finding.get("volume", 0))
+        shards = [int(s) for s in (finding.get("shards") or [])] or [-1]
+        detected = finding.get("detected_at")
+        opened = []
+        for sid in shards:
+            inc = self.repair_queue.report(
+                "corruption", volume=vid, shard=sid,
+                source=str(finding.get("source", "")),
+                detail={"slabs": finding.get("slabs"),
+                        "columns": finding.get("columns"),
+                        "collection": finding.get("collection", "")},
+                detected_at=float(detected) if detected else None)
+            opened.append(inc.id)
+        return {"volume": vid, "incidents": opened}
 
     def ui_handler(self, req: Request):
         """HTML status dashboard (reference master_ui/templates.go)."""
@@ -301,6 +366,8 @@ class MasterServer:
             self._maintenance_thread.start()
         if self._vacuum_thread is not None:
             self._vacuum_thread.start()
+        if self._repair_thread is not None:
+            self._repair_thread.start()
         return self
 
     def stop(self):
@@ -410,6 +477,130 @@ class MasterServer:
                     glog.V(0).infof("auto vacuum: %s", out)
             except Exception as e:  # noqa: BLE001 - keep the loop alive
                 glog.V(0).infof("auto vacuum failed: %s", e)
+
+    # -- repair queue drive (integrity plane) ------------------------------
+    def _repair_scan(self):
+        """Open/close incidents from what the master already knows:
+        missing shards in the heartbeat-built topology and holders the
+        health fold scores at-risk. Scrub corruption arrives separately
+        via /cluster/scrub_report. Idempotent — repeat sightings
+        collapse onto the open incident and keep its original
+        detection time."""
+        from ..ec import TOTAL_SHARDS
+        with self.topology.lock:
+            shard_map = {vid: [[n.url for n in holders]
+                               for holders in per_shard]
+                         for vid, per_shard in
+                         self.topology.ec_shard_map.items()}
+        for vid, per_shard in shard_map.items():
+            if not any(per_shard):
+                continue  # fully unregistered volume, not a shard loss
+            present = sum(1 for holders in per_shard if holders)
+            if present == TOTAL_SHARDS:
+                self._repair_seen_complete.add(vid)
+            # a hole is only a LOSS if the stripe was once whole: a
+            # streaming encode registers shards incrementally, and
+            # opening incidents mid-spread fires doomed rebuilds at a
+            # half-built volume
+            if vid not in self._repair_seen_complete:
+                continue
+            for sid in range(TOTAL_SHARDS):
+                holders = per_shard[sid] if sid < len(per_shard) else []
+                if holders:
+                    self.repair_queue.resolve("lost_shard", volume=vid,
+                                              shard=sid, via="remounted")
+                else:
+                    self.repair_queue.report("lost_shard", volume=vid,
+                                             shard=sid, source=self.url)
+        # volumes gone from the map entirely: their incidents are moot
+        self._repair_seen_complete &= set(shard_map)
+        for inc in list(self.repair_queue.snapshot()["open"]):
+            if inc["kind"] == "lost_shard" \
+                    and inc["volume"] not in shard_map:
+                self.repair_queue.resolve("lost_shard",
+                                          volume=inc["volume"],
+                                          shard=inc["shard"],
+                                          via="volume_removed")
+        health = self.cluster_agg.holder_health().get("holders", {})
+        for holder, h in health.items():
+            score = float(h.get("score", 1.0))
+            if score < self.at_risk_score:
+                self.repair_queue.report(
+                    "at_risk_holder", holder=holder, source=self.url,
+                    detail={"score": round(score, 3)})
+            elif score > self.at_risk_score + 0.1:  # hysteresis
+                self.repair_queue.resolve("at_risk_holder",
+                                          holder=holder, via="recovered")
+
+    def _repair_loop(self):
+        from ..util import glog
+        while not self._stop.wait(self.repair_interval):
+            if not self.is_leader():
+                continue
+            try:
+                self._repair_scan()
+                for _ in range(4):  # bounded drain per tick
+                    inc = self.repair_queue.next_incident()
+                    if inc is None:
+                        break
+                    self._drain_one(inc)
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                glog.V(0).infof("repair loop failed: %s", e)
+
+    def _drain_one(self, inc):
+        """Drive one incident through the existing repair machinery:
+        corruption → the holder quarantines + rebuilds the poisoned
+        shard (/admin/ec/scrub_repair); lost shard → a surviving holder
+        streams the missing shard back (/admin/ec/rebuild + mount)."""
+        from ..util import glog
+        vid = inc.volume
+        shards = self.topology.lookup_ec_shards(vid) or {}
+        collection = self.topology.ec_collections.get(vid, "")
+        try:
+            if inc.kind == "corruption":
+                if inc.shard < 0 or not shards.get(inc.shard):
+                    raise RuntimeError(
+                        f"no holder for corrupt shard {vid}.{inc.shard}")
+                target = shards[inc.shard][0]
+                sources = {str(s): [u for u in urls if u != target]
+                           for s, urls in shards.items() if s != inc.shard}
+                post_json(
+                    f"http://{target}/admin/ec/scrub_repair"
+                    f"?volume={vid}&shard={inc.shard}"
+                    f"&collection={collection}",
+                    {"sources": sources}, timeout=300)
+                self.repair_queue.resolve("corruption", volume=vid,
+                                          shard=inc.shard,
+                                          via="scrub_repair")
+            elif inc.kind == "lost_shard":
+                if not shards:
+                    raise RuntimeError(f"no survivors for volume {vid}")
+                # rebuild on a node already holding shards of this
+                # volume — its local rows never cross the wire
+                target = shards[min(shards)][0]
+                sources = {str(s): urls for s, urls in shards.items()
+                           if target not in urls}
+                out = post_json(
+                    f"http://{target}/admin/ec/rebuild"
+                    f"?volume={vid}&collection={collection}",
+                    {"sources": sources}, timeout=300)
+                rebuilt = out.get("rebuilt") or []
+                if not rebuilt:
+                    raise RuntimeError(f"rebuild of {vid} restored "
+                                       f"nothing")
+                post_json(
+                    f"http://{target}/admin/ec/mount?volume={vid}"
+                    f"&collection={collection}"
+                    f"&shards={','.join(map(str, rebuilt))}", {},
+                    timeout=60)
+                for sid in rebuilt:
+                    self.repair_queue.resolve("lost_shard", volume=vid,
+                                              shard=int(sid),
+                                              via="rebuild")
+        except Exception as e:  # noqa: BLE001 - back off, retry later
+            self.repair_queue.attempt_failed(inc, str(e))
+            glog.V(0).infof("repair of %s %s.%s failed: %s",
+                            inc.kind, vid, inc.shard, e)
 
     def _maintenance_loop(self):
         """Run the configured shell scripts every interval (leader-only,
@@ -737,7 +928,8 @@ class MasterServer:
         # volumes/status/ec_lookup serve the same data as the guarded
         # lookups, so cluster nodes (volume servers, filers, gateways)
         # must be included in -whiteList like any other HTTP client
-        if p in ("/cluster/heartbeat", "/cluster/goodbye", "/metrics") \
+        if p in ("/cluster/heartbeat", "/cluster/goodbye",
+                 "/cluster/scrub_report", "/metrics") \
                 or p.startswith("/raft/"):
             return
         if not self.guard.allows(req.handler.client_address[0]):

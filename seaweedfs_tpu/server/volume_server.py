@@ -66,6 +66,11 @@ class VolumeServer:
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
         router.add("POST", "/admin/ec/shard_repair_read",
                    self.admin_ec_shard_repair_read)
+        router.add("POST", "/admin/ec/scrub", self.admin_ec_scrub)
+        router.add("GET", "/admin/ec/scrub_status",
+                   self.admin_ec_scrub_status)
+        router.add("POST", "/admin/ec/scrub_repair",
+                   self.admin_ec_scrub_repair)
         router.add("GET", "/admin/file", self.admin_file)
         router.add("POST", "/admin/volume/tier_upload",
                    self.admin_tier_upload)
@@ -145,6 +150,16 @@ class VolumeServer:
         # a shard (re-)registered after rebuild must win over cached
         # reconstructions immediately
         self.store.on_ec_mount = self.degraded.invalidate
+        # background integrity scrub: paced H·x=0 syndrome verification
+        # of every local EC volume, findings pushed to the master's
+        # repair queue (ec/scrub.py)
+        from ..ec.scrub import ScrubEngine
+        self.scrub = ScrubEngine(
+            store=self.store,
+            locations=self._ec_shard_locations,
+            codec=lambda: self.store.codec or get_codec(DATA_SHARDS, 4),
+            self_url=lambda: self.url,
+            on_finding=self._report_scrub_finding)
         self._stop = threading.Event()
         # immediate delta-push (reference store.go:40-64 change channels,
         # consumed by volume_grpc_client_to_master.go:57-185): volume
@@ -195,11 +210,13 @@ class VolumeServer:
             from ..util import glog
             glog.V(0).infof("initial heartbeat failed: %s", e)
         self._hb_thread.start()
+        self.scrub.start()
         return self
 
     def stop(self):
         self._stop.set()
         self._hb_wake.set()
+        self.scrub.stop()
         try:
             # clean shutdown: tell the master now so watch subscribers
             # reroute immediately instead of after heartbeat expiry
@@ -415,6 +432,7 @@ class VolumeServer:
     def status(self, req: Request):
         out = self.store.status()
         out["ec_degraded"] = self.degraded.snapshot()
+        out["ec_scrub"] = self.scrub.snapshot()
         if self.fast_plane is not None:
             out["fast_plane"] = {
                 "url": self.fast_url,
@@ -557,8 +575,10 @@ class VolumeServer:
         # degraded-read engine counters (engine-global, same mirror
         # pattern; the per-read latency histogram streams in live via
         # the engine's on_read hook)
-        from ..stats.metrics import observe_degraded
+        from ..stats.metrics import observe_degraded, observe_scrub
         observe_degraded(self.degraded.snapshot())
+        # integrity-scrub engine counters (same mirror pattern)
+        observe_scrub(self.scrub.snapshot())
         # per-holder health scoreboard (process-global EWMAs fed by the
         # gather/repair/degraded readers) — fresh scores on every scrape
         # so the master's aggregator and /cluster/health see them
@@ -856,6 +876,69 @@ class VolumeServer:
         return {"volume": vid, "rebuilt": rebuilt, "stats": stats,
                 "trace_id": tracing.current_trace_id()}
 
+    def admin_ec_scrub(self, req: Request):
+        """Trigger a synchronous scrub: one volume (?volume=) or a full
+        pass over every local EC volume. Manual triggers bypass the
+        lowest-shard ownership election — an operator asking this
+        server to scrub means this server."""
+        vid = req.query.get("volume")
+        if vid is not None:
+            return self.scrub.scrub_volume(int(vid), force=True)
+        return self.scrub.run_pass(force=True)
+
+    def admin_ec_scrub_status(self, req: Request):
+        return self.scrub.snapshot()
+
+    def admin_ec_scrub_repair(self, req: Request):
+        """Quarantine + rebuild one corrupt shard: drop the poisoned
+        file so it cannot serve reads or feed a decode, then stream a
+        fresh copy from the surviving k. Driven by the master's repair
+        queue when a scrub finding names this holder."""
+        from ..stats.metrics import observe_gather, observe_repair
+        from ..util import tracing
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        collection = req.query.get("collection", "")
+        try:
+            body = req.json()
+        except ValueError:
+            raise HttpError(400, "bad JSON body") from None
+        body = body if isinstance(body, dict) else {}
+        self.store.unmount_ec_shards(vid, [sid])
+        for loc in self.store.locations:
+            base = volume_file_prefix(loc.directory, collection, vid)
+            for p in (base + to_ext(sid), base + to_ext(sid) + ".part"):
+                if os.path.exists(p):
+                    os.remove(p)
+        sources = body.get("sources") or self._ec_shard_locations(vid)
+        sources = {int(s): [u for u in urls if u != self.url]
+                   for s, urls in (sources or {}).items()
+                   if int(s) != sid}
+        stats: dict = {}
+        rebuilt = self.store.rebuild_ec_shards_streaming(
+            vid, collection, sources=sources, stats=stats,
+            repair=str(body.get("repair") or "auto"))
+        observe_gather(stats)
+        observe_repair(stats)
+        mounted = self.store.mount_ec_shards(vid, collection, rebuilt) \
+            if rebuilt else []
+        self.degraded.invalidate(vid, rebuilt or [sid])
+        self.heartbeat_once()
+        return {"volume": vid, "shard": sid, "rebuilt": rebuilt,
+                "mounted": mounted, "stats": stats,
+                "trace_id": tracing.current_trace_id()}
+
+    def _report_scrub_finding(self, finding: dict) -> bool:
+        """Push a scrub corruption finding to the master's repair
+        queue; True only on an acknowledged report (the engine counts
+        failures and the finding stays visible in its snapshot)."""
+        try:
+            post_json(f"http://{self.master_url}/cluster/scrub_report",
+                      finding, timeout=5)
+            return True
+        except Exception:  # noqa: BLE001 - master may be down
+            return False
+
     def admin_ec_copy(self, req: Request):
         """Pull shard files from a source server (reference
         VolumeEcShardsCopy: the target pulls via CopyFile stream)."""
@@ -921,7 +1004,7 @@ class VolumeServer:
                             removed.append(sid)
             if not any(os.path.exists(base + to_ext(s))
                        for s in range(TOTAL_SHARDS)):
-                for ext in (".ecx", ".ecj", ".vif"):
+                for ext in (".ecx", ".ecj", ".vif", ".scrub"):
                     if os.path.exists(base + ext):
                         os.remove(base + ext)
         self.heartbeat_once()

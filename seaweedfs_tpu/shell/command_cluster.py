@@ -50,6 +50,39 @@ def cluster_health(env: CommandEnv, args: List[str]):
             f" hedges_lost={int(ev.get('hedges_lost', 0))}")
 
 
+@command("cluster.repairs",
+         "[-refresh false]: the master's repair queue — open durability "
+         "incidents by priority (corruption > lost shard > at-risk "
+         "holder) and time-to-re-protection over recent repairs")
+def cluster_repairs(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    path = "/cluster/repairs"
+    if flags.get("refresh", "true") != "false":
+        path += "?refresh=1"
+    view = env.master_get(path)
+    open_incs = view.get("open") or []
+    ttr = view.get("time_to_re_protection") or {}
+    counters = view.get("counters") or {}
+    env.write(f"cluster.repairs: {len(open_incs)} open, "
+              f"{int(counters.get('resolved', 0))} resolved "
+              f"(ttr p50={ttr.get('p50_s', 0.0):.1f}s "
+              f"p99={ttr.get('p99_s', 0.0):.1f}s "
+              f"over {int(ttr.get('count', 0))})")
+    for inc in open_incs:
+        where = f"volume {inc.get('volume')}.{inc.get('shard')}" \
+            if inc.get("volume") is not None else inc.get("holder", "?")
+        env.write(f"  [{inc.get('kind')}] {where}"
+                  f"  attempts={int(inc.get('attempts', 0))}"
+                  f"  since={inc.get('detected_at', 0.0):.0f}"
+                  + (f"  err={inc['last_error']}"
+                     if inc.get("last_error") else ""))
+    for inc in (view.get("resolved_recent") or [])[-5:]:
+        env.write(f"  done [{inc.get('kind')}] volume "
+                  f"{inc.get('volume')}.{inc.get('shard')} via "
+                  f"{inc.get('via')} "
+                  f"ttr={inc.get('time_to_re_protection_s', 0.0):.1f}s")
+
+
 @command("trace.export",
          "-trace <id> [-o <file>]: merge one trace's spans from every "
          "cluster node into a single skew-normalized Chrome trace-event "

@@ -732,3 +732,41 @@ def volume_ec_degraded(env: CommandEnv, args: List[str]):
             f"{int(snap.get('device_dispatches', 0))} "
             f"p99={snap.get('p99_ms', 0.0):.1f}ms "
             f"errors={int(snap.get('errors', 0))}")
+
+
+@command("volume.ec.scrub",
+         "[-trigger] [-volumeId <id>]: per-server syndrome-scrub status "
+         "(passes, bytes verified, corruption found); -trigger runs a "
+         "synchronous pass on every server first")
+def volume_ec_scrub(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    nodes = env.cluster_nodes()
+    if not nodes:
+        env.write("no volume servers")
+        return
+    vid = flags.get("volumeId")
+    for node in nodes:
+        url = node["url"]
+        try:
+            if "trigger" in flags:
+                q = f"?volume={int(vid)}" if vid else ""
+                env.node_post(url, f"/admin/ec/scrub{q}")
+            snap = env.node_get(url, "/admin/ec/scrub_status") or {}
+        except HttpError as e:
+            env.write(f"{url}  unreachable: {e}")
+            continue
+        env.write(
+            f"{url}  passes={int(snap.get('passes', 0))} "
+            f"volumes={int(snap.get('volumes_scrubbed', 0))} "
+            f"slabs={int(snap.get('slabs', 0))} "
+            f"verified={int(snap.get('bytes_verified', 0)) >> 20}MB "
+            f"@{snap.get('last_pass_mbps', 0.0):.1f}MB/s "
+            f"corrupt(slabs/cols)={int(snap.get('corrupt_slabs', 0))}/"
+            f"{int(snap.get('corrupt_columns', 0))} "
+            f"findings={int(snap.get('findings', 0))} "
+            f"dispatch(host/dev)={int(snap.get('host_dispatches', 0))}/"
+            f"{int(snap.get('device_dispatches', 0))} "
+            f"skipped(owner/missing)="
+            f"{int(snap.get('skipped_not_owner', 0))}/"
+            f"{int(snap.get('skipped_missing', 0))} "
+            f"errors={int(snap.get('errors', 0))}")

@@ -666,6 +666,10 @@ VOLUME_EC_DEGRADED_BATCH_WIDTH_GAUGE = VOLUME_SERVER_GATHER.gauge(
 VOLUME_EC_DEGRADED_HIT_RATIO_GAUGE = VOLUME_SERVER_GATHER.gauge(
     "SeaweedFS_volumeServer_ec_degraded_cache_hit_ratio",
     "Reconstructed-slab LRU hit ratio since process start, 0..1.")
+VOLUME_EC_DEGRADED_READAHEAD_RATIO_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_degraded_readahead_hit_ratio",
+    "Fraction of readahead-reconstructed slabs later served from the "
+    "LRU, 0..1 (SW_EC_DEGRADED_READAHEAD_SLABS).")
 
 
 def observe_degraded(snap: Dict):
@@ -676,12 +680,82 @@ def observe_degraded(snap: Dict):
         return
     for kind in ("reads", "batches", "batched_requests", "cache_hits",
                  "cache_misses", "survivor_bytes", "remote_bytes",
-                 "host_dispatches", "device_dispatches", "errors"):
+                 "host_dispatches", "device_dispatches", "errors",
+                 "readahead_slabs", "readahead_hits"):
         VOLUME_EC_DEGRADED_COUNTER.set_total(snap.get(kind, 0), kind)
     VOLUME_EC_DEGRADED_BATCH_WIDTH_GAUGE.set(
         snap.get("last_batch_requests", 0))
     VOLUME_EC_DEGRADED_HIT_RATIO_GAUGE.set(
         snap.get("cache_hit_ratio", 0.0))
+    VOLUME_EC_DEGRADED_READAHEAD_RATIO_GAUGE.set(
+        snap.get("readahead_hit_ratio", 0.0))
+
+
+# -- EC integrity scrub (ec/scrub.py via observe_scrub) ----------------------
+
+VOLUME_EC_SCRUB_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_scrub_total",
+    "Syndrome-scrub engine events by kind (passes, volumes_scrubbed, "
+    "slabs, bytes_verified, corrupt_slabs, corrupt_columns, findings, "
+    "host_dispatches, device_dispatches, errors).",
+    labels=("kind",))
+VOLUME_EC_SCRUB_MBPS_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_scrub_mbps",
+    "Gather bandwidth of the most recent scrub pass, MB/s (paced by "
+    "SW_EC_SCRUB_RATE_MBPS).")
+VOLUME_EC_SCRUB_LAST_PASS_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_scrub_last_pass_unixtime",
+    "Wall-clock time the last scrub pass finished; staleness alarm "
+    "feed.")
+
+
+def observe_scrub(snap: Dict):
+    """Mirror one ScrubEngine snapshot onto the volume registry."""
+    if not snap:
+        return
+    for kind in ("passes", "volumes_scrubbed", "slabs", "bytes_verified",
+                 "remote_bytes", "corrupt_slabs", "corrupt_columns",
+                 "findings", "report_failures", "skipped_missing",
+                 "skipped_not_owner", "host_dispatches",
+                 "device_dispatches", "errors"):
+        VOLUME_EC_SCRUB_COUNTER.set_total(snap.get(kind, 0), kind)
+    VOLUME_EC_SCRUB_MBPS_GAUGE.set(snap.get("last_pass_mbps", 0.0))
+    VOLUME_EC_SCRUB_LAST_PASS_GAUGE.set(snap.get("last_pass_at", 0.0))
+
+
+# -- repair queue (stats/repair_queue.py via observe_repair_queue) -----------
+
+MASTER_REPAIR_QUEUE_COUNTER = MASTER_GATHER.counter(
+    "SeaweedFS_master_repair_queue_incidents_total",
+    "Repair-queue incident flow by kind and event (reported, resolved, "
+    "attempts, attempt_failures, duplicates).",
+    labels=("kind", "event"))
+MASTER_REPAIR_QUEUE_OPEN_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_repair_queue_open",
+    "Open incidents by kind (corruption, lost_shard, at_risk_holder).",
+    labels=("kind",))
+MASTER_REPAIR_QUEUE_TTR_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_repair_queue_ttr_seconds",
+    "Time-to-re-protection over recent resolved incidents (quantile "
+    "label: p50, p99, max).",
+    labels=("quantile",))
+
+
+def observe_repair_queue(snap: Dict):
+    """Mirror one RepairQueue snapshot onto the master registry."""
+    if not snap:
+        return
+    counters = snap.get("counters", {})
+    for event in ("reported", "resolved", "attempts",
+                  "attempt_failures", "duplicates"):
+        MASTER_REPAIR_QUEUE_COUNTER.set_total(
+            counters.get(event, 0), "all", event)
+    for kind, depth in snap.get("depth", {}).items():
+        MASTER_REPAIR_QUEUE_OPEN_GAUGE.set(depth, kind)
+    ttr = snap.get("time_to_re_protection", {})
+    MASTER_REPAIR_QUEUE_TTR_GAUGE.set(ttr.get("p50_s", 0.0), "p50")
+    MASTER_REPAIR_QUEUE_TTR_GAUGE.set(ttr.get("p99_s", 0.0), "p99")
+    MASTER_REPAIR_QUEUE_TTR_GAUGE.set(ttr.get("max_s", 0.0), "max")
 
 
 class SmallDispatchTuner:

@@ -203,6 +203,65 @@ def test_degraded_engine_device_crossover(tmp_path, backend):
     assert snap["errors"] == 0
 
 
+def test_degraded_readahead_prefetch_and_hits(tmp_path):
+    eng, shards, lost = _engine(tmp_path, _codec("numpy"))
+    eng.readahead = 2
+    # one slab requested, two neighbors ride the same batch
+    assert eng.read(1, lost, 0, 4096) == _expect(shards, lost, 0, 4096)
+    snap = eng.snapshot()
+    assert snap["readahead_slabs"] == 2
+    assert snap["readahead_hits"] == 0
+    fetched = snap["survivor_bytes"]
+    # the sequential next read is served by the prefetched slab — no
+    # new survivor traffic, and the hit is attributed to readahead
+    assert eng.read(1, lost, 4096, 4096) == \
+        _expect(shards, lost, 4096, 4096)
+    snap = eng.snapshot()
+    assert snap["survivor_bytes"] == fetched
+    assert snap["readahead_hits"] == 1
+    assert snap["readahead_hit_ratio"] == 0.5
+    # readahead=0 disables the widening entirely
+    eng0, shards0, lost0 = _engine(tmp_path, _codec("numpy"))
+    eng0.readahead = 0
+    eng0.read(1, lost0, 0, 4096)
+    assert eng0.snapshot()["readahead_slabs"] == 0
+    # a disabled cache can never serve a prefetch: don't waste the work
+    engc, shardsc, lostc = _engine(tmp_path, _codec("numpy"),
+                                   cache_bytes=0)
+    engc.readahead = 2
+    engc.read(1, lostc, 0, 4096)
+    assert engc.snapshot()["readahead_slabs"] == 0
+
+
+def test_degraded_readahead_env_knob(monkeypatch):
+    from seaweedfs_tpu.ec.degraded import degraded_readahead_slabs
+    monkeypatch.delenv("SW_EC_DEGRADED_READAHEAD_SLABS", raising=False)
+    assert degraded_readahead_slabs() == 1
+    monkeypatch.setenv("SW_EC_DEGRADED_READAHEAD_SLABS", "3")
+    assert degraded_readahead_slabs() == 3
+    monkeypatch.setenv("SW_EC_DEGRADED_READAHEAD_SLABS", "-2")
+    assert degraded_readahead_slabs() == 0
+    monkeypatch.setenv("SW_EC_DEGRADED_READAHEAD_SLABS", "junk")
+    assert degraded_readahead_slabs() == 1
+
+
+def test_degraded_dispatch_honors_live_override(tmp_path):
+    """The SW_EC_SMALL_DISPATCH_AUTO fitted crossover steers the batch
+    host/device decision live — no codec reconstruction."""
+    from seaweedfs_tpu.ops.codec import set_small_dispatch_override
+    codec = _codec("tpu", small_dispatch_bytes=1024)
+    eng, shards, lost = _engine(tmp_path, codec, slab=16_384)
+    set_small_dispatch_override(1 << 28)
+    try:
+        assert eng.read(1, lost, 0, 80_000) == \
+            _expect(shards, lost, 0, 80_000)
+        snap = eng.snapshot()
+        assert snap["device_dispatches"] == 0
+        assert snap["host_dispatches"] >= 1
+    finally:
+        set_small_dispatch_override(None)
+
+
 def test_slab_cache_lru_budget_and_invalidate():
     c = SlabCache(max_bytes=10_000)
     c.put((1, 0, 0), b"a" * 4_000)

@@ -40,6 +40,9 @@ REQUIRED_FAMILIES = {
         "SeaweedFS_master_cluster_scrape_seconds",
         "SeaweedFS_master_cluster_node_up",
         "SeaweedFS_master_cluster_scraped_nodes",
+        "SeaweedFS_master_repair_queue_incidents_total",
+        "SeaweedFS_master_repair_queue_open",
+        "SeaweedFS_master_repair_queue_ttr_seconds",
     ),
     "volume": (
         "SeaweedFS_volumeServer_ec_holder_health",
@@ -63,6 +66,10 @@ REQUIRED_FAMILIES = {
         "SeaweedFS_volumeServer_ec_degraded_read_seconds",
         "SeaweedFS_volumeServer_ec_degraded_batch_width",
         "SeaweedFS_volumeServer_ec_degraded_cache_hit_ratio",
+        "SeaweedFS_volumeServer_ec_degraded_readahead_hit_ratio",
+        "SeaweedFS_volumeServer_ec_scrub_total",
+        "SeaweedFS_volumeServer_ec_scrub_mbps",
+        "SeaweedFS_volumeServer_ec_scrub_last_pass_unixtime",
     ),
 }
 
@@ -123,6 +130,20 @@ def check_route_coverage(repo_root: str) -> list:
                 problems.append(
                     f"degraded-coverage: no test under tests/ "
                     f"references {token} ({what})")
+    # integrity plane: the scrub engine and the master's repair queue
+    # back the /cluster/repairs view and the corruption drill — each
+    # surface must be exercised by name, same contract as above
+    scrub_py = os.path.join(repo_root, "seaweedfs_tpu", "ec", "scrub.py")
+    if os.path.exists(scrub_py):
+        for token, what in (
+                ("ScrubEngine", "the scrub engine"),
+                ("ec_scrub_", "the ec_scrub_* metric families"),
+                ("RepairQueue", "the master repair queue"),
+                ("repair_queue_", "the repair_queue_* metric families")):
+            if token not in blob:
+                problems.append(
+                    f"scrub-coverage: no test under tests/ "
+                    f"references {token} ({what})")
     # fleet health plane: every observability route must be exercised by
     # a test — these feed dashboards and the health-routing decision, so
     # an untested one can silently serve garbage
@@ -133,6 +154,7 @@ def check_route_coverage(repo_root: str) -> list:
     for route, src, src_name in (
             ("/cluster/metrics", master_src, "master.py"),
             ("/cluster/health", master_src, "master.py"),
+            ("/cluster/repairs", master_src, "master.py"),
             ("/admin/traces/export", master_src, "master.py")):
         if f'"{route}"' not in src:
             problems.append(
