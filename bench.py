@@ -45,9 +45,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from seaweedfs_tpu.util import config  # noqa: E402
+
 K, M = 10, 4
 TOTAL = K + M
-TRIALS = int(os.environ.get("SW_BENCH_TRIALS", "2"))
+TRIALS = config.env_int("SW_BENCH_TRIALS")
 
 
 def log(*args):
@@ -161,15 +163,12 @@ def init_device_retrying(retry_log: list):
     and spaced with exponential backoff (base SW_BENCH_INIT_RETRY_SPACING,
     doubling up to SW_BENCH_INIT_RETRY_MAX_SPACING), and the CPU-fallback
     verdict is recorded in the log the moment the last probe fails."""
-    attempts = max(1, int(os.environ.get(
+    attempts = max(1, config.env_int(
         "SW_BENCH_DEVICE_INIT_RETRIES",
-        os.environ.get("SW_BENCH_INIT_RETRIES", "5"))))
-    timeout_s = float(os.environ.get("SW_BENCH_INIT_RETRY_TIMEOUT",
-                                     "120"))
-    spacing_s = float(os.environ.get("SW_BENCH_INIT_RETRY_SPACING",
-                                     "15"))
-    max_spacing_s = float(os.environ.get("SW_BENCH_INIT_RETRY_MAX_SPACING",
-                                         "120"))
+        config.env_int("SW_BENCH_INIT_RETRIES")))
+    timeout_s = config.env_float("SW_BENCH_INIT_RETRY_TIMEOUT")
+    spacing_s = config.env_float("SW_BENCH_INIT_RETRY_SPACING")
+    max_spacing_s = config.env_float("SW_BENCH_INIT_RETRY_MAX_SPACING")
     for i in range(attempts):
         t0 = time.time()
         log(f"device init retry {i + 1}/{attempts}")
@@ -562,8 +561,7 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
     from seaweedfs_tpu.server.http_util import get_json, post_json
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
-    backend = backend or os.environ.get("SW_BENCH_CLUSTER_BACKEND",
-                                        "mesh")
+    backend = backend or config.env_str("SW_BENCH_CLUSTER_BACKEND")
     workdir = tempfile.mkdtemp(prefix="swcluster_")
     master = MasterServer(port=0, volume_size_limit_mb=size_mb * 2,
                           pulse_seconds=1).start()
@@ -594,8 +592,7 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         # keep the drill bounded even if the device link degrades
         # mid-run (the interactive shell default is a generous 3600s;
         # a wedged tunnel would stall the whole bench on it)
-        env.admin_timeout = float(
-            os.environ.get("SW_BENCH_DRILL_TIMEOUT", "900"))
+        env.admin_timeout = config.env_float("SW_BENCH_DRILL_TIMEOUT")
         from seaweedfs_tpu.shell.command_ec import do_ec_encode
         enc_timings = {}
         t_encode = time.perf_counter()
@@ -796,15 +793,11 @@ def measure_cluster_degraded_read(n_needles: int = None,
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
     from seaweedfs_tpu.storage.types import parse_file_id
-    n_needles = n_needles or int(
-        os.environ.get("SW_BENCH_DEGRADED_NEEDLES", "24"))
-    needle_kb = needle_kb or int(
-        os.environ.get("SW_BENCH_DEGRADED_KB", "64"))
-    readers = readers or int(
-        os.environ.get("SW_BENCH_DEGRADED_READERS", "8"))
-    rounds = rounds or int(
-        os.environ.get("SW_BENCH_DEGRADED_ROUNDS", "3"))
-    backend = os.environ.get("SW_BENCH_DEGRADED_BACKEND", "numpy")
+    n_needles = n_needles or config.env_int("SW_BENCH_DEGRADED_NEEDLES")
+    needle_kb = needle_kb or config.env_int("SW_BENCH_DEGRADED_KB")
+    readers = readers or config.env_int("SW_BENCH_DEGRADED_READERS")
+    rounds = rounds or config.env_int("SW_BENCH_DEGRADED_ROUNDS")
+    backend = config.env_str("SW_BENCH_DEGRADED_BACKEND")
     workdir = tempfile.mkdtemp(prefix="swdegraded_")
     master = MasterServer(port=0, volume_size_limit_mb=64,
                           pulse_seconds=1).start()
@@ -836,8 +829,7 @@ def measure_cluster_degraded_read(n_needles: int = None,
         from seaweedfs_tpu.shell.command_env import CommandEnv
         from seaweedfs_tpu.shell.command_ec import do_ec_encode
         env = CommandEnv(master.url, out=sys.stderr)
-        env.admin_timeout = float(
-            os.environ.get("SW_BENCH_DRILL_TIMEOUT", "900"))
+        env.admin_timeout = config.env_float("SW_BENCH_DRILL_TIMEOUT")
         do_ec_encode(env, vid)
 
         def poll(pred, what, timeout=30.0):
@@ -1007,15 +999,11 @@ def measure_cluster_scrub_repair(n_volumes: int = None,
         post_json
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
-    n_volumes = n_volumes or int(
-        os.environ.get("SW_BENCH_SCRUB_VOLUMES", "3"))
-    n_needles = n_needles or int(
-        os.environ.get("SW_BENCH_SCRUB_NEEDLES", "8"))
-    needle_kb = needle_kb or int(
-        os.environ.get("SW_BENCH_SCRUB_KB", "64"))
-    readers = readers or int(
-        os.environ.get("SW_BENCH_SCRUB_READERS", "4"))
-    rate_mbps = float(os.environ.get("SW_EC_SCRUB_RATE_MBPS", "8"))
+    n_volumes = n_volumes or config.env_int("SW_BENCH_SCRUB_VOLUMES")
+    n_needles = n_needles or config.env_int("SW_BENCH_SCRUB_NEEDLES")
+    needle_kb = needle_kb or config.env_int("SW_BENCH_SCRUB_KB")
+    readers = readers or config.env_int("SW_BENCH_SCRUB_READERS")
+    rate_mbps = config.env_float("SW_EC_SCRUB_RATE_MBPS")
     workdir = tempfile.mkdtemp(prefix="swscrub_")
     saved = {k: os.environ.get(k)
              for k in ("SW_REPAIR_INTERVAL_S", "SW_EC_SCRUB_IDLE_S")}
@@ -1050,8 +1038,7 @@ def measure_cluster_scrub_repair(n_volumes: int = None,
         from seaweedfs_tpu.shell.command_env import CommandEnv
         from seaweedfs_tpu.shell.command_ec import do_ec_encode
         env = CommandEnv(master.url, out=sys.stderr)
-        env.admin_timeout = float(
-            os.environ.get("SW_BENCH_DRILL_TIMEOUT", "900"))
+        env.admin_timeout = config.env_float("SW_BENCH_DRILL_TIMEOUT")
         for vid in sorted(by_vid):
             do_ec_encode(env, vid)
 
@@ -1306,8 +1293,7 @@ def measure_data_plane(seconds: float = None) -> dict:
     from seaweedfs_tpu.command.benchmark import run_native_benchmark
     from seaweedfs_tpu.server.master import MasterServer
     from seaweedfs_tpu.server.volume_server import VolumeServer
-    seconds = seconds or float(os.environ.get("SW_BENCH_DP_SECONDS",
-                                              "5"))
+    seconds = seconds or config.env_float("SW_BENCH_DP_SECONDS")
     workdir = tempfile.mkdtemp(prefix="swdp_")
     master = MasterServer(port=0, pulse_seconds=1).start()
     vs = None
@@ -1330,8 +1316,7 @@ def measure_data_plane(seconds: float = None) -> dict:
                 time.sleep(0.1)
         buf = io.StringIO()
         run_native_benchmark(master.url, file_size=1024,
-                             concurrency=int(os.environ.get(
-                                 "SW_BENCH_DP_CONNS", "12")),
+                             concurrency=config.env_int("SW_BENCH_DP_CONNS"),
                              seconds=seconds, pool=2048, out=buf)
         out = {}
         for raw in buf.getvalue().splitlines():
@@ -1368,14 +1353,14 @@ def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
         log(f"data-plane bench failed: {e!r}")
     try:
         extras["rs_geometries"] = measure_geometries(
-            int(os.environ.get("SW_BENCH_GEO_MB", "256")),
+            config.env_int("SW_BENCH_GEO_MB"),
             chained_by_geo)
     except Exception as e:  # noqa: BLE001 - secondary
         log(f"geometry bench failed: {e!r}")
     try:
         extras["batched_small_needles"] = measure_batched_small_needles(
-            int(os.environ.get("SW_BENCH_SMALL_VOLS", "4")),
-            int(os.environ.get("SW_BENCH_SMALL_NEEDLES", "8192")))
+            config.env_int("SW_BENCH_SMALL_VOLS"),
+            config.env_int("SW_BENCH_SMALL_NEEDLES"))
     except Exception as e:  # noqa: BLE001 - secondary
         log(f"small-needle bench failed: {e!r}")
     # loss-masked reads under live traffic: healthy vs degraded p99,
@@ -1396,15 +1381,15 @@ def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
     # when the tunnel is up
     try:
         extras["cluster_rebuild"] = run_cluster_drill_subprocess(
-            int(os.environ.get("SW_BENCH_CLUSTER_MB", "256")),
-            int(os.environ.get("SW_BENCH_CLUSTER_SERVERS", "4")))
+            config.env_int("SW_BENCH_CLUSTER_MB"),
+            config.env_int("SW_BENCH_CLUSTER_SERVERS"))
     except Exception as e:  # noqa: BLE001 - secondary
         log(f"cluster rebuild (cpu mesh) failed: {e!r}")
     if device_ok:
         try:
             extras["cluster_rebuild_device"] = measure_cluster_rebuild(
-                int(os.environ.get("SW_BENCH_CLUSTER_TPU_MB", "64")),
-                int(os.environ.get("SW_BENCH_CLUSTER_SERVERS", "4")),
+                config.env_int("SW_BENCH_CLUSTER_TPU_MB"),
+                config.env_int("SW_BENCH_CLUSTER_SERVERS"),
                 backend="mesh")
         except Exception as e:  # noqa: BLE001 - secondary
             log(f"cluster rebuild (device mesh) failed: {e!r}")
@@ -1412,10 +1397,10 @@ def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
 
 
 def main():
-    dat_mb = int(os.environ.get("SW_BENCH_DAT_MB", "4096"))
-    slab_mb = int(os.environ.get("SW_BENCH_SLAB_MB", "8"))
-    init_timeout = float(os.environ.get("SW_BENCH_INIT_TIMEOUT", "180"))
-    user_dir = os.environ.get("SW_BENCH_DIR")
+    dat_mb = config.env_int("SW_BENCH_DAT_MB")
+    slab_mb = config.env_int("SW_BENCH_SLAB_MB")
+    init_timeout = config.env_float("SW_BENCH_INIT_TIMEOUT")
+    user_dir = config.env_str("SW_BENCH_DIR")
     workdir = user_dir or tempfile.mkdtemp(prefix="swbench_")
     os.makedirs(workdir, exist_ok=True)
     base = os.path.join(workdir, "1")
@@ -1573,7 +1558,7 @@ def main():
             emit(tpu_mbps, tpu_mbps / cpu_mbps, "tpu_e2e_tunnel_bound",
                  **extras)
     finally:
-        if not os.environ.get("SW_BENCH_KEEP"):
+        if not config.env_bool("SW_BENCH_KEEP"):
             if user_dir:
                 from seaweedfs_tpu.ec import to_ext
                 # caller-provided dir may hold unrelated files: remove only
@@ -1602,8 +1587,8 @@ if __name__ == "__main__":
         from seaweedfs_tpu.util.jax_platform import honor_platform_request
         honor_platform_request()
         result = measure_cluster_rebuild(
-            int(os.environ.get("SW_BENCH_CLUSTER_MB", "256")),
-            int(os.environ.get("SW_BENCH_CLUSTER_SERVERS", "4")))
+            config.env_int("SW_BENCH_CLUSTER_MB"),
+            config.env_int("SW_BENCH_CLUSTER_SERVERS"))
         print("CLUSTER_DRILL " + json.dumps(result), flush=True)
     elif "cluster_scrub_repair" in sys.argv:
         # standalone integrity drill: detection latency, scrub MB/s,

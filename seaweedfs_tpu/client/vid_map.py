@@ -13,8 +13,10 @@ One daemon poller per master URL is shared process-wide
 from __future__ import annotations
 
 import threading
+from ..util.locks import make_lock
 import time
 from typing import Dict, List, Optional
+from ..util import config
 
 
 class VidMap:
@@ -25,7 +27,7 @@ class VidMap:
         self.master_url = master_url
         self._locations: Dict[int, List[dict]] = {}
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("vid_map._lock")
         self._ready = threading.Event()  # first snapshot applied
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -144,7 +146,8 @@ class VidMap:
                 self._seq = 0        # resync with a snapshot on recovery
                 if failures >= self.MAX_CONSECUTIVE_FAILURES:
                     return           # park; a later lookup() revives us
-                self._stop.wait(min(2.0, 0.2 * failures))
+                self._stop.wait(max(0.01, config.retry_backoff_s(
+                    min(2.0, 0.2 * failures))))
 
 
 def _read_routes(locs) -> List[str]:
@@ -160,7 +163,7 @@ def _read_routes(locs) -> List[str]:
 
 
 _shared: Dict[str, VidMap] = {}
-_shared_lock = threading.Lock()
+_shared_lock = make_lock("vid_map._shared_lock")
 
 
 def shared_vid_map(master_url: str) -> VidMap:

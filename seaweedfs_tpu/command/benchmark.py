@@ -10,6 +10,7 @@ tool.
 from __future__ import annotations
 
 import threading
+from ..util.locks import make_lock
 import time
 from typing import List
 
@@ -24,7 +25,7 @@ class Stats:
         self.latencies: List[float] = []
         self.failed = 0
         self.bytes = 0
-        self.lock = threading.Lock()
+        self.lock = make_lock("benchmark.lock")
 
     def add(self, dt: float, nbytes: int):
         with self.lock:
@@ -60,7 +61,7 @@ def run_benchmark(master_url: str, num_files: int = 1024,
     rng = np.random.default_rng(0)
     payload = rng.integers(0, 256, file_size).astype(np.uint8).tobytes()
     fids: List[str] = []
-    fid_lock = threading.Lock()
+    fid_lock = make_lock("benchmark.fid_lock")
 
     if write:
         stats = Stats()
@@ -121,7 +122,8 @@ def run_benchmark(master_url: str, num_files: int = 1024,
                 remaining -= granted
 
         t0 = time.perf_counter()
-        threads = [threading.Thread(target=writer, args=(w,))
+        threads = [threading.Thread(target=writer, args=(w,),
+                                    name=f"bench-writer-{w}")
                    for w in range(concurrency)]
         for th in threads:
             th.start()
@@ -147,7 +149,8 @@ def run_benchmark(master_url: str, num_files: int = 1024,
                     stats.fail()
 
         t0 = time.perf_counter()
-        threads = [threading.Thread(target=reader, args=(c,))
+        threads = [threading.Thread(target=reader, args=(c,),
+                                    name=f"bench-reader-{c[0]}")
                    for c in chunks]
         for th in threads:
             th.start()

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util.locks import make_lock
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
@@ -54,7 +55,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..util import tracing
+from ..util import config, tracing
 from .ec_volume import EcShardNotFound
 from .gather import (GatherStats, LocalShardReader, RemoteShardReader,
                      ShardSizeCache, default_hedge_ms)
@@ -66,37 +67,23 @@ READ_TIMEOUT_ENV = "SW_EC_DEGRADED_READ_TIMEOUT_S"
 MODE_ENV = "SW_EC_DEGRADED_MODE"
 READAHEAD_ENV = "SW_EC_DEGRADED_READAHEAD_SLABS"
 
-DEFAULT_CACHE_BYTES = 64 << 20
-DEFAULT_SLAB_BYTES = 128 << 10
-DEFAULT_BATCH_MS = 2.0
-DEFAULT_READ_TIMEOUT_S = 10.0
-DEFAULT_READAHEAD_SLABS = 1
-
-
-def _env_num(name: str, default, cast=float):
-    try:
-        return cast(os.environ[name])
-    except (KeyError, ValueError):
-        return default
-
-
 def degraded_cache_bytes() -> int:
-    return max(0, _env_num(CACHE_BYTES_ENV, DEFAULT_CACHE_BYTES, int))
+    return max(0, config.env_int(CACHE_BYTES_ENV))
 
 
 def degraded_slab_bytes() -> int:
-    return max(1 << 10, _env_num(SLAB_BYTES_ENV, DEFAULT_SLAB_BYTES, int))
+    return max(1 << 10, config.env_int(SLAB_BYTES_ENV))
 
 
 def degraded_batch_ms() -> float:
-    return max(0.0, _env_num(BATCH_MS_ENV, DEFAULT_BATCH_MS))
+    return max(0.0, config.env_float(BATCH_MS_ENV))
 
 
 def degraded_read_timeout_s() -> float:
     """Per-holder budget for degraded-read shard fetches. The legacy
     30 s meant one dead holder could eat the whole request deadline
     before failover even started; default well under it."""
-    return max(0.1, _env_num(READ_TIMEOUT_ENV, DEFAULT_READ_TIMEOUT_S))
+    return max(0.1, config.env_float(READ_TIMEOUT_ENV))
 
 
 def degraded_readahead_slabs() -> int:
@@ -104,13 +91,13 @@ def degraded_readahead_slabs() -> int:
     range: the batch is already paying a gather + dispatch, so widening
     it by a slab is nearly free and sequential readers of a dead shard
     hit the LRU instead of a fresh batch. 0 disables."""
-    return max(0, _env_num(READAHEAD_ENV, DEFAULT_READAHEAD_SLABS, int))
+    return max(0, config.env_int(READAHEAD_ENV))
 
 
 def degraded_mode() -> str:
     """"batch" (the engine) or "naive" (per-read exactly-k fallback,
     kept for A/B benching and emergencies)."""
-    return os.environ.get(MODE_ENV, "batch").strip().lower() or "batch"
+    return (config.env_str(MODE_ENV) or "batch").strip().lower() or "batch"
 
 
 class SlabCache:
@@ -121,7 +108,7 @@ class SlabCache:
         self.max_bytes = int(max_bytes)
         self._entries: "OrderedDict[tuple, bytes]" = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("degraded.SlabCache._lock")
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -177,7 +164,7 @@ class _Batch:
     into a batch the leader has already taken."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock("degraded.Batch.lock")
         self.pending: Dict[int, "_SlabFuture"] = {}
         self.leading = False
         self.requests = 0
@@ -242,7 +229,7 @@ class DegradedReadEngine:
         self._ra_keys: set = set()
         self.size_cache = ShardSizeCache(timeout=degraded_read_timeout_s())
         self.on_read = on_read
-        self._lock = threading.Lock()
+        self._lock = make_lock("degraded.Engine._lock")
         self._batches: Dict[Tuple[int, int], _Batch] = {}
         self._latencies: deque = deque(maxlen=512)
         self._c: Dict[str, int] = {

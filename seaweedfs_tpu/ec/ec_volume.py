@@ -16,6 +16,7 @@ import json
 import os
 import struct
 import threading
+from ..util.locks import make_lock
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -148,12 +149,12 @@ class EcVolume:
         self.ecx_size = os.path.getsize(self.base_name + ".ecx")
         # one seekable handle shared by lookups and in-place tombstoning —
         # every seek+read/write pair must hold this lock
-        self.ecx_lock = threading.Lock()
+        self.ecx_lock = make_lock("ec_volume.ecx_lock")
         self.ecj_file = open(self.base_name + ".ecj", "a+b")
-        self.ecj_lock = threading.Lock()
+        self.ecj_lock = make_lock("ec_volume.ecj_lock")
         self.shards: Dict[int, EcVolumeShard] = {}
         self.shard_locations: Dict[int, List[str]] = {}
-        self.shard_locations_lock = threading.Lock()
+        self.shard_locations_lock = make_lock("ec_volume.shard_locations_lock")
         self.shard_locations_refreshed_at = 0.0
         self.created_at = time.time()
         self.version = None

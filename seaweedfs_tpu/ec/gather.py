@@ -37,6 +37,7 @@ from __future__ import annotations
 import os
 import re
 import threading
+from ..util.locks import make_lock
 import time
 from collections import deque
 from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
@@ -47,6 +48,7 @@ import numpy as np
 
 from ..stats import health as _health
 from ..util import tracing
+from ..util import config
 from ..util.profiling import StageTimer
 
 DEFAULT_WINDOW = 4
@@ -72,25 +74,18 @@ def auto_slab(shard_size: int, default: int = 8 << 20,
 
 
 def gather_window() -> int:
-    try:
-        return max(1, int(os.environ.get(GATHER_WINDOW_ENV,
-                                         str(DEFAULT_WINDOW))))
-    except ValueError:
-        return DEFAULT_WINDOW
+    return max(1, config.env_int(GATHER_WINDOW_ENV))
 
 
 def default_hedge_ms() -> float:
-    try:
-        return float(os.environ.get(HEDGE_MS_ENV, "0"))
-    except ValueError:
-        return 0.0
+    return config.env_float(HEDGE_MS_ENV)
 
 
 # hedged duplicates run here rather than in the gather pool: a stripe
 # worker submitting back into its own (possibly saturated) pool could
 # deadlock the window
 _HEDGE_POOL: Optional[ThreadPoolExecutor] = None
-_HEDGE_LOCK = threading.Lock()
+_HEDGE_LOCK = make_lock("gather._HEDGE_LOCK")
 
 
 def _hedge_pool() -> ThreadPoolExecutor:
@@ -110,7 +105,7 @@ class GatherStats:
 
     def __init__(self):
         self.timer = StageTimer()
-        self._lock = threading.Lock()
+        self._lock = make_lock("gather.GatherStats._lock")
         self.fetches = 0
         self.bytes = 0
         self.remote_bytes = 0
@@ -424,7 +419,7 @@ class ShardSizeCache:
         self.timeout = timeout
         self.probes = 0
         self._sizes: Dict[Tuple[int, int], int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("gather.ShardSizeCache._lock")
 
     def get(self, vid: int, sid: int, holders: Sequence[str]) -> int:
         key = (int(vid), int(sid))
@@ -559,7 +554,7 @@ class StripedGatherSource:
         self.stats.local_shards = len(self.readers) - \
             self.stats.remote_shards
         self._buffered = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("gather.GatherSource._lock")
 
     def _note_buffered(self, delta: int):
         with self._lock:

@@ -27,13 +27,14 @@ master's repair queue via the ``on_finding`` callback.
 import json
 import os
 import threading
+from ..util.locks import make_lock
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..util import glog
+from ..util import config, glog
 from ..util import tracing
 from .gather import (GatherStats, LocalShardReader, RemoteShardReader,
                      default_hedge_ms)
@@ -49,26 +50,17 @@ _LOCATE_SAMPLE = 64
 
 def scrub_rate_mbps() -> float:
     """Gather-bandwidth ceiling for a pass; 0 disables pacing."""
-    try:
-        return float(os.environ.get(RATE_ENV, "8"))
-    except ValueError:
-        return 8.0
+    return config.env_float(RATE_ENV)
 
 
 def scrub_idle_s() -> float:
     """Sleep between background passes; <= 0 disables the loop (manual
     trigger via POST /admin/ec/scrub still works)."""
-    try:
-        return float(os.environ.get(IDLE_ENV, "300"))
-    except ValueError:
-        return 300.0
+    return config.env_float(IDLE_ENV)
 
 
 def scrub_slab_bytes() -> int:
-    try:
-        return max(4096, int(os.environ.get(SLAB_ENV, str(1 << 20))))
-    except ValueError:
-        return 1 << 20
+    return max(4096, config.env_int(SLAB_ENV))
 
 
 def locate_corrupt_shard(h: np.ndarray, syndrome: np.ndarray) -> int:
@@ -123,8 +115,8 @@ class ScrubEngine:
         self._hedge_ms = hedge_ms
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._pass_lock = threading.Lock()   # one pass at a time
-        self._lock = threading.Lock()        # counters
+        self._pass_lock = make_lock("scrub._pass_lock")   # one pass at a time
+        self._lock = make_lock("scrub._lock")        # counters
         self._c = {
             "passes": 0, "volumes_scrubbed": 0, "slabs": 0,
             "bytes_verified": 0, "remote_bytes": 0,

@@ -19,6 +19,7 @@ tries someone else instead of timing out again.
 from __future__ import annotations
 
 import threading
+from ..util.locks import make_lock
 import time
 from typing import Callable, Dict, List
 
@@ -36,7 +37,7 @@ class EcShardLocationCache:
         self._fetch = fetch
         self._data_shards = data_shards
         self._total_shards = total_shards
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard_cache._lock")
         self._entries: Dict[int, tuple] = {}  # vid -> (refresh_t, locations)
 
     def _ttl(self, locations: Dict[int, List[str]]) -> float:

@@ -40,10 +40,11 @@ import os
 import queue
 import re
 import threading
+from ..util.locks import make_lock
 import time
 from typing import Dict, List, Optional, Sequence
 
-from ..util import tracing
+from ..util import config, tracing
 from ..util.profiling import StageTimer
 
 DEFAULT_WINDOW = 4
@@ -55,11 +56,7 @@ _SENTINEL = object()
 
 
 def spread_window() -> int:
-    try:
-        return max(1, int(os.environ.get(SPREAD_WINDOW_ENV,
-                                         str(DEFAULT_WINDOW))))
-    except ValueError:
-        return DEFAULT_WINDOW
+    return max(1, config.env_int(SPREAD_WINDOW_ENV))
 
 
 class SpreadError(Exception):
@@ -74,7 +71,7 @@ class SpreadStats:
 
     def __init__(self):
         self.timer = StageTimer()
-        self._lock = threading.Lock()
+        self._lock = make_lock("spread.SpreadStats._lock")
         self.sends = 0
         self.bytes = 0
         self.retries = 0
@@ -360,7 +357,7 @@ class StripedSpreadSink:
         self.offset = 0
         self.failed: Optional[BaseException] = None
         self._spares = [s for s in (spares or []) if s]
-        self._lock = threading.Lock()
+        self._lock = make_lock("spread.SpreadSink._lock")
         self._buffered = 0
         self.writers: List = []
         by_target: Dict[Optional[str], List[int]] = {}

@@ -29,6 +29,7 @@ import posixpath
 import socket
 import struct
 import threading
+from ..util.locks import make_lock
 from typing import List, Optional, Tuple
 
 from .entry import Entry
@@ -81,7 +82,7 @@ class CqlClient:
         self._sock: Optional[socket.socket] = None
         self._buf = b""
         self._stream = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("cassandra_store._lock")
 
     # -- framing ----------------------------------------------------------
 

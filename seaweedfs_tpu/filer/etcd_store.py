@@ -27,6 +27,7 @@ import http.client
 import json
 import posixpath
 import threading
+from ..util.locks import make_lock
 from typing import List, Optional
 
 from .entry import Entry
@@ -87,7 +88,7 @@ class EtcdClient:
         self.password = password
         self.timeout = timeout
         self.api_prefix = api_prefix.rstrip("/")
-        self._lock = threading.Lock()
+        self._lock = make_lock("etcd_store._lock")
         self._conn: Optional[http.client.HTTPConnection] = None
         self._token = ""
 

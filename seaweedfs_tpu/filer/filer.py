@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import posixpath
 import threading
+from ..util.locks import make_rlock
 import time
 from collections import OrderedDict
 from typing import Callable, List, Optional
@@ -35,7 +36,7 @@ class Filer:
         self.buckets_folder = buckets_folder
         self._dir_cache: "OrderedDict[str, Entry]" = OrderedDict()
         self._dir_cache_size = dir_cache_size
-        self._lock = threading.RLock()
+        self._lock = make_rlock("filer._lock")
         # notify(old_entry | None, new_entry | None, delete_chunks: bool)
         self.notify_fns: List[Callable] = []
         # fids queued for deletion on the volume servers

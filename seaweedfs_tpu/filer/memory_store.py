@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+from ..util.locks import make_rlock
 from typing import Dict, List, Optional
 
 from .entry import Entry
@@ -19,7 +20,7 @@ class MemoryStore(FilerStore):
     name = "memory"
 
     def initialize(self, **options):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("memory_store._lock")
         self._entries: Dict[str, bytes] = {}
         # dir -> sorted list of child names (listing index)
         self._children: Dict[str, List[str]] = {}

@@ -28,6 +28,7 @@ import posixpath
 import socket
 import struct
 import threading
+from ..util.locks import make_lock
 from typing import List, Optional, Tuple
 
 from .entry import Entry
@@ -113,7 +114,7 @@ class MysqlClient:
         self._buf = b""
         self._seq = 0
         self.status = 0   # server status flags (handshake + OK packets)
-        self._lock = threading.Lock()
+        self._lock = make_lock("mysql_store._lock")
 
     def escape(self, s: str) -> str:
         return escape_string(

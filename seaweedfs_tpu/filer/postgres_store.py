@@ -25,6 +25,7 @@ import posixpath
 import socket
 import struct
 import threading
+from ..util.locks import make_lock
 from typing import List, Optional, Tuple
 
 from .entry import Entry
@@ -76,7 +77,7 @@ class PostgresClient:
         self.timeout = float(timeout)
         self._sock: Optional[socket.socket] = None
         self._buf = b""
-        self._lock = threading.Lock()
+        self._lock = make_lock("postgres_store._lock")
 
     # -- framing ----------------------------------------------------------
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import posixpath
 import socket
 import threading
+from ..util.locks import make_lock
 from typing import List, Optional
 
 from .entry import Entry
@@ -46,7 +47,7 @@ class RespClient:
         self.timeout = float(timeout)
         self._sock: Optional[socket.socket] = None
         self._buf = b""
-        self._lock = threading.Lock()
+        self._lock = make_lock("redis_store._lock")
 
     # -- transport --------------------------------------------------------
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import posixpath
 import sqlite3
 import threading
+from ..util.locks import make_rlock
 from typing import List, Optional
 
 from .entry import Entry
@@ -23,7 +24,7 @@ class SqliteStore(FilerStore):
     name = "sqlite"
 
     def initialize(self, path: str = ":memory:", **options):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("sqlite_store._lock")
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute(
             "CREATE TABLE IF NOT EXISTS filemeta ("

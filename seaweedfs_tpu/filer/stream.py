@@ -11,6 +11,7 @@ from typing import Callable, List, Optional
 
 from .entry import FileChunk
 from .filechunks import view_from_chunks
+from ..util import config as _config
 
 
 def default_fetcher(master_url: str):
@@ -45,7 +46,7 @@ def default_fetcher(master_url: str):
             if last is not None and last.status < 500:
                 break
             if round_ == 0:
-                _time.sleep(0.5)
+                _time.sleep(_config.retry_backoff_s(0.5))
         raise last or HttpError(404, f"no locations for {fid}")
 
     return fetch

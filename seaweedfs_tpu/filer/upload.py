@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 from ..client import operation
 from ..util import encrypt, gzip_data, is_compressible
 from .entry import FileChunk
+from ..util import config
 
 
 def split_and_upload(master_url: str, data: bytes, filename: str,
@@ -83,7 +84,8 @@ def _assign_and_upload(master_url: str, blob: bytes, filename: str,
             # master stops routing to a frozen volume within a pulse
             # and prunes a dead node within a few; each failure also
             # blacklists a sick volume or node, so the walk converges
-            time.sleep(min(0.3 * (2 ** (attempt - 1)), 1.5))
+            time.sleep(config.retry_backoff_s(
+                min(0.3 * (2 ** (attempt - 1)), 1.5)))
         a = None
         try:
             a = _fresh_assign(master_url, collection, replication, ttl,

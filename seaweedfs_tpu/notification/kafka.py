@@ -39,9 +39,11 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+from ..util.locks import make_lock
 import time
 import zlib
 from typing import Dict, List, Optional, Tuple
+from ..util import config
 
 API_PRODUCE = 0
 API_METADATA = 3
@@ -221,7 +223,7 @@ class KafkaProducer:
         # topic -> total partition count (incl. leaderless — the key->
         # partition mapping must be stable across leader elections)
         self._npartitions: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("kafka._lock")
 
     # -- transport --------------------------------------------------------
 
@@ -464,21 +466,27 @@ class KafkaProducer:
         """Publish one message; returns the broker-assigned base offset
         (-1 with acks=0). Retries with a metadata refresh on leadership
         errors — at-least-once, like the reference's sarama config."""
-        with self._lock:
-            last: Exception = KafkaError("unreachable")
-            for attempt in range(self.retries):
-                try:
+        last: Exception = KafkaError("unreachable")
+        for attempt in range(self.retries):
+            try:
+                # the lock covers one wire attempt (socket + leader
+                # cache); the backoff sleep happens OUTSIDE it so a
+                # flapping leader can't stall every other producer
+                # thread for the whole retry schedule
+                with self._lock:
                     return self._send_once(topic, key, value)
-                except (OSError, KafkaError) as e:
-                    if isinstance(e, KafkaError) and not e.retriable:
-                        raise  # permanent verdict: retrying can't help
-                    last = e
+            except (OSError, KafkaError) as e:
+                if isinstance(e, KafkaError) and not e.retriable:
+                    raise  # permanent verdict: retrying can't help
+                last = e
+                with self._lock:
                     self._leaders.pop(topic, None)
-                    if attempt + 1 < self.retries:
-                        time.sleep(min(0.1 * (2 ** attempt), 1.0))
-            raise KafkaError(
-                f"produce to {topic!r} failed after {self.retries} "
-                f"attempts: {last}")
+                if attempt + 1 < self.retries:
+                    time.sleep(config.retry_backoff_s(
+                        min(0.1 * (2 ** attempt), 1.0)))
+        raise KafkaError(
+            f"produce to {topic!r} failed after {self.retries} "
+            f"attempts: {last}")
 
     def _send_once(self, topic: str, key: Optional[bytes],
                    value: bytes) -> int:

@@ -4,7 +4,9 @@ from __future__ import annotations
 
 import sys
 import threading
+from ..util.locks import make_lock
 from typing import Callable, Dict, List, Type
+from ..util import config
 
 
 class Publisher:
@@ -59,7 +61,7 @@ class MemoryPublisher(Publisher):
 
     def initialize(self, **options):
         self._subs: List[Callable[[str, dict], None]] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("queues._lock")
         self.events: List[tuple] = []
 
     def subscribe(self, fn: Callable[[str, dict], None]):
@@ -93,7 +95,8 @@ def _post_with_retries(url: str, body: bytes, headers: dict,
         except Exception as e:  # noqa: BLE001 - network: retried
             last = e
         if attempt + 1 < retries:
-            _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+            _time.sleep(config.retry_backoff_s(
+                min(0.2 * (2 ** attempt), 2.0)))
     # chain the last HttpError so callers can classify by status
     # (google_pub_sub re-auths on 401)
     raise RuntimeError(f"{label} {url} failed after "

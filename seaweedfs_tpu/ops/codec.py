@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import gf256
+from ..util import config
 
 
 def host_matmul(coeffs: np.ndarray, data: np.ndarray) -> np.ndarray:
@@ -66,8 +67,7 @@ def small_dispatch_default() -> int:
     superseded by the auto-tuner's override once one is applied."""
     if _SMALL_DISPATCH_OVERRIDE is not None:
         return _SMALL_DISPATCH_OVERRIDE
-    return int(os.environ.get("SW_EC_SMALL_DISPATCH_BYTES",
-                              str(256 << 10)))
+    return config.env_int("SW_EC_SMALL_DISPATCH_BYTES")
 
 
 def small_dispatch_override() -> "int | None":
@@ -84,7 +84,7 @@ def set_small_dispatch_override(nbytes: "int | None"):
 def maybe_auto_apply_small_dispatch(suggestion: int) -> bool:
     """Apply the tuner's suggested threshold when the operator opted in
     via SW_EC_SMALL_DISPATCH_AUTO=1. Returns whether it was applied."""
-    if os.environ.get("SW_EC_SMALL_DISPATCH_AUTO", "") != "1":
+    if not config.env_bool("SW_EC_SMALL_DISPATCH_AUTO"):
         return False
     set_small_dispatch_override(suggestion)
     return True
@@ -353,7 +353,8 @@ def _tpu_present(timeout_s: float = 60.0) -> bool:
         except Exception:
             result["tpu"] = False
 
-    th = threading.Thread(target=probe, daemon=True)
+    th = threading.Thread(target=probe, daemon=True,
+                          name="device-init-probe")
     th.start()
     th.join(timeout_s)
     _TPU_PROBE_RESULT = bool(result.get("tpu", False))

@@ -111,7 +111,8 @@ class PipelinedMatmul:
             finally:
                 q.put(_SENTINEL)
 
-        reader = threading.Thread(target=produce, daemon=True)
+        reader = threading.Thread(target=produce, daemon=True,
+                                  name="pipeline-producer")
         reader.start()
 
         # d2h runs in a small pool so fetches start the moment each

@@ -14,6 +14,7 @@ wall time.
 from __future__ import annotations
 
 import threading
+from ..util.locks import make_lock
 
 
 class DispatchStats:
@@ -23,7 +24,7 @@ class DispatchStats:
                "device_bytes")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry._lock")
         for f in self._FIELDS:
             setattr(self, f, 0)
 

@@ -12,6 +12,7 @@ import urllib.parse
 from typing import Iterator, Tuple
 
 from ..server.http_util import HttpError, get_json
+from ..util import config
 
 
 class EventSubscriber:
@@ -67,7 +68,7 @@ class EventSubscriber:
             try:
                 batch = self.poll_once()
             except HttpError:
-                time.sleep(1.0)
+                time.sleep(max(0.02, config.retry_backoff_s(1.0)))
                 continue
             for e in batch:
                 yield e["ts"], e["event"]

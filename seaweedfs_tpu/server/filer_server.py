@@ -86,10 +86,12 @@ class FilerServer:
         self._fetch = None
         self._stop = threading.Event()
         self._deleter = threading.Thread(target=self._deletion_loop,
-                                         daemon=True)
+                                         daemon=True,
+                                         name="filer-deleter")
         self._notify_queue: queue.Queue = queue.Queue(maxsize=1024)
         self._notifier = threading.Thread(target=self._notify_loop,
-                                          daemon=True) \
+                                          daemon=True,
+                                          name="filer-notifier") \
             if notify_publisher is not None else None
 
     # -- lifecycle ----------------------------------------------------------
@@ -171,7 +173,9 @@ class FilerServer:
     def _deletion_loop(self):
         """Drain the filer's chunk-deletion queue against the cluster
         (reference filer_deletion.go loopProcessingDeletion)."""
-        while not self._stop.wait(1.0):
+        from ..util import config
+        while not self._stop.wait(
+                max(0.01, config.env_float("SW_FILER_TICK_S"))):
             self.flush_deletions()
 
     def flush_deletions(self):

@@ -12,6 +12,7 @@ import os
 import re
 import socket
 import threading
+from ..util.locks import make_lock
 import time
 import urllib.error
 import urllib.parse
@@ -20,7 +21,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..util import tracing
+from ..util import config, tracing
 
 
 class HttpError(Exception):
@@ -523,7 +524,7 @@ class _TunedHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, *args, **kwargs):
         self._client_socks: set = set()
-        self._conn_lock = threading.Lock()
+        self._conn_lock = make_lock("http_util._conn_lock")
         super().__init__(*args, **kwargs)
 
     # track live client sockets so stop() can sever keep-alive
@@ -569,10 +570,17 @@ class HttpServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name=f"http-serve-{self.port}")
         self._thread.start()
         return self
+
+    def _serve(self):
+        # shutdown() latency is bounded by the accept-loop poll; the
+        # tier-1 conftest drops SW_HTTP_POLL_S to ~20 ms so hundreds of
+        # per-test server stops don't each eat the stdlib's 0.5 s
+        self.httpd.serve_forever(
+            poll_interval=max(0.001, config.env_float("SW_HTTP_POLL_S")))
 
     def stop(self):
         # shutdown() blocks on serve_forever()'s ack; if start() never ran
@@ -633,7 +641,7 @@ import http.client as _httpc
 # we would otherwise only discover stale at reuse, and long-lived shells
 # would pin sockets to servers they talked to once
 _POOL: Dict[Tuple[str, str], List] = {}
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = make_lock("http_util._POOL_LOCK")
 _POOL_MAX_PER_HOST = 32
 _POOL_MAX_IDLE_ENV = "SW_HTTP_POOL_MAX_IDLE_S"
 # churn counters, mirrored into /metrics (http_pool_churn_total{event=})
@@ -644,10 +652,7 @@ _RETRIABLE_STALE = (_httpc.RemoteDisconnected, _httpc.BadStatusLine,
 
 
 def _pool_max_idle_s() -> float:
-    try:
-        return float(os.environ.get(_POOL_MAX_IDLE_ENV, "60"))
-    except ValueError:
-        return 60.0
+    return config.env_float(_POOL_MAX_IDLE_ENV)
 
 
 def _pool_count(event: str, n: int = 1):

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util.locks import make_lock
 import time
 
 from ..storage.types import TTL, ReplicaPlacement
+from ..util import config
 from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
 from .http_util import (HttpError, HttpServer, Request, Response,
@@ -25,7 +27,7 @@ class MasterServer:
     def __init__(self, port: int = 9333, host: str = "127.0.0.1",
                  volume_size_limit_mb: int = 30 * 1024,
                  default_replication: str = "000",
-                 pulse_seconds: int = 5,
+                 pulse_seconds: float = None,
                  garbage_threshold: float = 0.3,
                  jwt_signing_key: str = "",
                  peers: str = "", raft_dir: str = "",
@@ -36,13 +38,15 @@ class MasterServer:
                  metrics_interval: int = 15, sequencer=None,
                  growth_counts: dict = None,
                  maintenance_filer_url: str = ""):
+        if pulse_seconds is None:
+            pulse_seconds = config.env_float("SW_PULSE_S")
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds, sequencer=sequencer)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.jwt_signing_key = jwt_signing_key
-        self.vg_lock = threading.Lock()
+        self.vg_lock = make_lock("master.vg_lock")
         self.host = host
 
         router = Router()
@@ -117,12 +121,14 @@ class MasterServer:
         # only those can report lost shards (mid-encode holes are not
         # losses)
         self._repair_seen_complete: set = set()
-        self.repair_interval = self._env_f("SW_REPAIR_INTERVAL_S", 5.0)
-        self.at_risk_score = self._env_f("SW_REPAIR_AT_RISK_SCORE", 0.4)
+        self.repair_interval = config.env_float("SW_REPAIR_INTERVAL_S")
+        self.at_risk_score = config.env_float("SW_REPAIR_AT_RISK_SCORE")
         self._repair_thread = threading.Thread(
-            target=self._repair_loop, daemon=True) \
+            target=self._repair_loop, daemon=True,
+            name="master-repair-queue") \
             if self.repair_interval > 0 else None
-        self._pruner = threading.Thread(target=self._prune_loop, daemon=True)
+        self._pruner = threading.Thread(target=self._prune_loop, daemon=True,
+                                        name="master-pruner")
         self._stop = threading.Event()
         # cron'd embedded shell (reference startAdminScripts,
         # master_server.go:187-253): ';'-separated command lines run
@@ -138,13 +144,15 @@ class MasterServer:
         self._maintenance_thread = None
         if self.maintenance_scripts:
             self._maintenance_thread = threading.Thread(
-                target=self._maintenance_loop, daemon=True)
+                target=self._maintenance_loop, daemon=True,
+                name="master-maintenance")
         # automatic vacuum + TTL expiry (reference
         # Topo.StartRefreshWritableVolumes, master_server.go:128 →
         # topology_vacuum.go:139); 0 disables
         self.vacuum_interval = float(vacuum_interval)
         self._vacuum_thread = threading.Thread(
-            target=self._vacuum_loop, daemon=True) \
+            target=self._vacuum_loop, daemon=True,
+            name="master-vacuum") \
             if self.vacuum_interval > 0 else None
 
         # raft HA (reference weed/server/raft_server.go): multi-master
@@ -272,13 +280,6 @@ class MasterServer:
                 headers[h] = v
         out = http_call(req.method, url, req.body or None, headers)
         return _json.loads(out or b"{}")
-
-    @staticmethod
-    def _env_f(name: str, default: float) -> float:
-        try:
-            return float(os.environ.get(name, default))
-        except ValueError:
-            return default
 
     def metrics_handler(self, req: Request):
         from ..stats.metrics import MASTER_GATHER, observe_repair_queue

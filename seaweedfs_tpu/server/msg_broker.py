@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import threading
+from ..util.locks import make_lock
 import time
 from typing import Dict
 
@@ -32,7 +33,7 @@ class MsgBrokerServer:
         self.host = host
         self.max_topics = max_topics
         self.topics: Dict[str, LogBuffer] = {}
-        self.lock = threading.Lock()
+        self.lock = make_lock("msg_broker.lock")
 
     def start(self):
         self.server.start()

@@ -21,17 +21,19 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+from ..util import config
+from ..util.locks import make_lock
 from typing import Optional
 
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 # SW_HTTP_PLANE_LIB overrides the library (e.g. an ASAN-instrumented
 # build for the sanitizer test pass)
-_LIB_PATH = os.environ.get(
+_LIB_PATH = config.env_str(
     "SW_HTTP_PLANE_LIB",
     os.path.join(_LIB_DIR, "libseaweed_http.so"))
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("native_plane._lib_lock")
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -39,7 +41,7 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib or None
-        if "SW_HTTP_PLANE_LIB" in os.environ and \
+        if config.env_is_set("SW_HTTP_PLANE_LIB") and \
                 not os.path.exists(_LIB_PATH):
             # an explicit override must never silently degrade into a
             # freshly compiled plain build (it usually points at an

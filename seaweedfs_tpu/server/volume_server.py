@@ -32,7 +32,7 @@ class VolumeServer:
     def __init__(self, port: int = 8080, host: str = "127.0.0.1",
                  directories=None, master_url: str = "127.0.0.1:9333",
                  data_center: str = "", rack: str = "",
-                 max_volume_counts=None, pulse_seconds: int = 5,
+                 max_volume_counts=None, pulse_seconds: float = None,
                  public_url: str = "", read_redirect: bool = True,
                  ec_backend: str = "auto", jwt_signing_key: str = "",
                  whitelist=(), index_kind: str = "memory",
@@ -109,7 +109,9 @@ class VolumeServer:
                               if m.strip()]
         self.master_url = self._seed_masters[0]
         self._seed_i = 0
-        self.pulse_seconds = pulse_seconds
+        from ..util import config as _config
+        self.pulse_seconds = _config.env_float("SW_PULSE_S") \
+            if pulse_seconds is None else pulse_seconds
         self.read_redirect = read_redirect
         codec = get_codec(DATA_SHARDS, 4, backend=ec_backend) \
             if ec_backend != "auto" else None
@@ -186,8 +188,8 @@ class VolumeServer:
                             self.fast_plane.register_volume(v)
                             self._writer_acquire(v)
             except Exception as e:  # noqa: BLE001 - plane is optional
-                import os as _os
-                if "SW_HTTP_PLANE_LIB" in _os.environ:
+                from ..util import config as _config
+                if _config.env_is_set("SW_HTTP_PLANE_LIB"):
                     raise   # explicit lib override must fail loudly
                 from ..util import glog
                 glog.V(0).infof("native read plane unavailable: %s", e)
@@ -196,7 +198,8 @@ class VolumeServer:
         self._hb_acked_master = None
         self._hb_acked_volumes = None
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
-                                           daemon=True)
+                                           daemon=True,
+                                           name="volume-heartbeat")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

@@ -21,6 +21,7 @@ import xml.etree.ElementTree as ET
 from typing import Optional
 
 from ..filer import Attr, Entry
+from ..util.locks import make_lock
 from ..filer.entry import new_dir_entry
 from ..filer.filer import FilerError, NotFoundError
 from ..filer.stream import read_chunked
@@ -56,9 +57,8 @@ class LockManager:
     A lock on a path covers the path and everything under it."""
 
     def __init__(self):
-        import threading
         self._locks: dict = {}       # path -> _Lock
-        self._mu = threading.Lock()
+        self._mu = make_lock("webdav_server._mu")
 
     def _evict_expired(self, now: float):
         dead = [p for p, lk in self._locks.items() if lk.expires <= now]

@@ -116,9 +116,9 @@ def do_ec_encode(env: CommandEnv, vid: int, mode: str = None,
 
     ``timings``, when given, records encode/spread busy seconds,
     ``overlap_frac``, and the spread counters for bench."""
-    import os as _os
+    from ..util import config as _config
     from ..util import tracing
-    mode = (mode or _os.environ.get("SW_EC_SPREAD_MODE") or
+    mode = (mode or _config.env_str("SW_EC_SPREAD_MODE") or
             "stream").lower()
     replicas = _volume_replicas(env, vid)
     if not replicas:
@@ -377,11 +377,11 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
     rebuilder use trace repair — projected sub-shard symbols from all
     survivors — when exactly one shard is lost; "trace" forces it,
     "full" forces the k-survivor gather. Stream mode only."""
-    import os as _os
+    from ..util import config as _config
     from ..util import tracing
-    mode = (mode or _os.environ.get("SW_EC_GATHER_MODE") or
+    mode = (mode or _config.env_str("SW_EC_GATHER_MODE") or
             "stream").lower()
-    repair = (repair or _os.environ.get("SW_EC_REPAIR_MODE") or
+    repair = (repair or _config.env_str("SW_EC_REPAIR_MODE") or
               "auto").lower()
     # shell-side trace root: every call below — survivor gathering, the
     # rebuild, mount — carries its traceparent: ONE trace per operation

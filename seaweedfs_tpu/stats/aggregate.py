@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util import config
+from ..util.locks import make_lock
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -41,11 +43,7 @@ _HEALTH_EVENTS_SUFFIX = "_ec_holder_events_total"
 
 
 def scrape_interval_s() -> float:
-    try:
-        return float(os.environ.get("SW_CLUSTER_SCRAPE_S",
-                                    DEFAULT_SCRAPE_S))
-    except ValueError:
-        return DEFAULT_SCRAPE_S
+    return config.env_float("SW_CLUSTER_SCRAPE_S")
 
 
 class _NodeSnapshot:
@@ -73,7 +71,7 @@ class ClusterMetricsAggregator:
         self.stale_after_s = max(2.5 * self.interval_s, 1.0)
         self.age_out_s = 4 * self.stale_after_s
         self._fetch = fetch or self._http_fetch
-        self._lock = threading.Lock()
+        self._lock = make_lock("aggregate._lock")
         self._nodes: Dict[str, _NodeSnapshot] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util import config
+from ..util.locks import make_lock
 from typing import Dict, List, Optional, Sequence
 
 # EWMA smoothing: each observation moves the average 20% of the way to
@@ -36,21 +38,18 @@ _DEF_REF_MS = 50.0
 
 
 def _ref_ms() -> float:
-    try:
-        return float(os.environ.get("SW_EC_HEALTH_REF_MS", _DEF_REF_MS))
-    except ValueError:
-        return _DEF_REF_MS
+    return config.env_float("SW_EC_HEALTH_REF_MS")
 
 
 def routing_enabled() -> bool:
-    return os.environ.get("SW_EC_HEALTH_ROUTING", "0") == "1"
+    return config.env_bool("SW_EC_HEALTH_ROUTING")
 
 
 class HolderHealthBoard:
     """Thread-safe EWMA scoreboard keyed by holder URL."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("health._lock")
         # holder -> kind -> latency EWMA (seconds)
         self._lat: Dict[str, Dict[str, float]] = {}
         # holder -> error-rate EWMA (0..1)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+from ..util.locks import make_lock
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -250,7 +251,7 @@ class _Metric:
         self.name = name
         self.help = help_text
         self.label_names = tuple(labels)
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Metric._lock")
 
     def header(self) -> List[str]:
         return [f"# HELP {self.name} {_escape_help(self.help)}",
@@ -364,7 +365,7 @@ class Histogram(_Metric):
 class Registry:
     def __init__(self):
         self._metrics: List[_Metric] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.Registry._lock")
 
     def register(self, metric: _Metric):
         with self._lock:
@@ -771,7 +772,7 @@ class SmallDispatchTuner:
     CLAMP = (64 << 10, 8 << 20)
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.SmallDispatchTuner._lock")
         self._host: List[Tuple[float, float]] = []    # (bytes, seconds)
         self._device: List[Tuple[float, float]] = []
 
@@ -861,7 +862,7 @@ def start_push_loop(registry: Registry, gateway_url: str,
                 # nothing would ever restart it
                 pass
 
-    t = threading.Thread(target=loop, daemon=True)
+    t = threading.Thread(target=loop, daemon=True, name="metrics-push")
     t.stop_event = stop
     t.start()
     return t

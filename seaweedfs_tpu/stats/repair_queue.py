@@ -25,6 +25,7 @@ exported as ``repair_queue_ttr_seconds`` and reported by the
 """
 
 import threading
+from ..util.locks import make_lock
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -92,7 +93,7 @@ class RepairQueue:
     """Deduplicated priority queue of durability incidents."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("repair_queue._lock")
         self._open: Dict[tuple, Incident] = {}
         self._resolved: deque = deque(maxlen=_RESOLVED_KEEP)
         self._next_id = 1

@@ -22,6 +22,7 @@ import io
 import os
 import shutil
 import threading
+from ..util.locks import make_lock
 import urllib.parse
 import urllib.request
 from typing import Dict, Optional
@@ -276,7 +277,7 @@ class S3Backend(BackendStorage):
 # registry (reference backend.go InitBackendStorages from config)
 
 _registry: Dict[str, BackendStorage] = {}
-_registry_lock = threading.Lock()
+_registry_lock = make_lock("backend._registry_lock")
 
 _KINDS = {"dir": DirBackend, "s3": S3Backend}
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 import re
 import threading
+from ..util.locks import make_rlock
 from typing import Dict, Optional
 
 from ..ec.ec_volume import EcVolume
@@ -29,7 +30,7 @@ class DiskLocation:
         self.index_kind = index_kind  # needle-map variant for new loads
         self.volumes: Dict[int, Volume] = {}
         self.ec_volumes: Dict[int, EcVolume] = {}
-        self.lock = threading.RLock()
+        self.lock = make_rlock("disk_location.lock")
         os.makedirs(self.directory, exist_ok=True)
 
     # -- boot scan ---------------------------------------------------------

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util.locks import make_rlock
 from typing import Dict, List, Optional
 
 from ..ec import encoder as ec_encoder
@@ -48,7 +49,7 @@ class Store:
         # reconstructions of them — a shard back on disk (e.g. after
         # rebuild) must be served from disk, not from the slab LRU.
         self.on_ec_mount = None
-        self.lock = threading.RLock()
+        self.lock = make_rlock("store.lock")
         for loc in self.locations:
             loc.load_existing_volumes()
             loc.load_all_ec_shards()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+from ..util.locks import make_rlock
 import time
 from typing import Optional
 
@@ -49,7 +50,7 @@ class Volume:
         # compact (16B/needle sorted arrays) | sortedfile (mmap'd .sdx)
         self.index_kind = index_kind
         self.readonly = False
-        self.lock = threading.RLock()
+        self.lock = make_rlock("volume.lock")
         self.last_modified = 0
         # write-lease delegate (server/native_plane.NativeWriter).
         # While set, the native plane owns the .dat/.idx tails: appends

@@ -23,6 +23,7 @@ import os
 import random
 import socket
 import threading
+from ..util.locks import make_rlock
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -107,13 +108,13 @@ class RaftNode:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
 
-        self.lock = threading.RLock()
+        self.lock = make_rlock("raft.lock")
         self._commit_cv = threading.Condition(self.lock)
         self._stop = threading.Event()
         self._election_deadline = self._new_deadline()
         self._inflight: Dict[str, bool] = {}   # one RPC per peer at a time
         self._ticker = threading.Thread(target=self._tick_loop,
-                                        daemon=True)
+                                        daemon=True, name="raft-ticker")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -270,7 +271,8 @@ class RaftNode:
                     if votes[0] * 2 > len(self.peers) + 1:
                         done.set()
 
-        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True,
+                                    name=f"raft-vote-{p}")
                    for p in self.peers]
         for t in threads:
             t.start()
@@ -322,7 +324,8 @@ class RaftNode:
                 finally:
                     with self.lock:
                         self._inflight[p] = False
-            threading.Thread(target=run, daemon=True).start()
+            threading.Thread(target=run, daemon=True,
+                             name=f"raft-replicate-{peer}").start()
 
     def _replicate_to(self, peer: str):
         with self.lock:

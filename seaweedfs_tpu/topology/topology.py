@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 import random
 import threading
+from ..util.locks import make_lock, make_rlock
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -25,7 +26,7 @@ class Sequencer:
 
     def __init__(self, start: int = 1):
         self._counter = start
-        self._lock = threading.Lock()
+        self._lock = make_lock("topology.Sequencer._lock")
 
     def next_file_id(self, count: int = 1) -> int:
         with self._lock:
@@ -264,7 +265,7 @@ class Topology:
         # optional ("new"|"deleted", vid, url, public_url) callback — the
         # master wires its watch hub here to push location deltas
         self.location_listener = None
-        self.lock = threading.RLock()
+        self.lock = make_rlock("topology.lock")
 
     # -- tree --------------------------------------------------------------
     def get_or_create_dc(self, dc_id: str) -> DataCenter:

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import random
 import threading
+from ..util.locks import make_rlock
 from typing import Dict, List, Optional
 
 from ..storage.types import ReplicaPlacement
@@ -25,7 +26,7 @@ class VolumeLayout:
         self.writables: List[int] = []
         self.readonly: set = set()
         self.oversized: set = set()
-        self.lock = threading.RLock()
+        self.lock = make_rlock("volume_layout.lock")
 
     def register_volume(self, vi: VolumeInfo, node: DataNode):
         with self.lock:

@@ -22,6 +22,276 @@ SEARCH_DIRS = [".", os.path.expanduser("~/.seaweedfs_tpu"),
                "/etc/seaweedfs_tpu"]
 ENV_PREFIX = "WEED_"
 
+# -- SW_* env-knob registry --------------------------------------------------
+#
+# Every SW_* tunable the codebase reads is declared here ONCE — name,
+# type, default, one-line doc — and read through the typed accessors
+# below (env_str/env_int/env_float/env_bool/env_is_set). tools/analyze.py
+# enforces the contract as a tier-1 lint: a raw os.environ/os.getenv read
+# of an SW_* name anywhere else is a violation, a registered knob nobody
+# reads is a violation, and the README env table is generated from this
+# registry (a stale committed table is a violation too).
+
+KNOB_KINDS = ("str", "int", "float", "bool")
+
+
+class EnvKnob:
+    __slots__ = ("name", "kind", "default", "doc")
+
+    def __init__(self, name: str, kind: str, default, doc: str):
+        self.name = name
+        self.kind = kind
+        self.default = default
+        self.doc = doc
+
+    def default_repr(self) -> str:
+        if self.default is None:
+            return "(unset)"
+        if self.kind == "bool":
+            return "1" if self.default else "0"
+        return str(self.default)
+
+
+KNOBS: Dict[str, EnvKnob] = {}
+
+
+def _knob(name: str, kind: str, default, doc: str) -> str:
+    if not name.startswith("SW_"):
+        raise ValueError(f"env knob {name!r} must start with SW_")
+    if kind not in KNOB_KINDS:
+        raise ValueError(f"env knob {name}: bad kind {kind!r}")
+    if not doc or "\n" in doc:
+        raise ValueError(f"env knob {name}: doc must be one line")
+    if name in KNOBS:
+        raise ValueError(f"env knob {name} registered twice")
+    KNOBS[name] = EnvKnob(name, kind, default, doc)
+    return name
+
+
+# server / transport
+_knob("SW_PULSE_S", "float", 5.0,
+      "Default heartbeat/prune pulse seconds for servers constructed "
+      "without an explicit pulse_seconds.")
+_knob("SW_HTTP_POLL_S", "float", 0.5,
+      "HTTP accept-loop poll interval; server shutdown latency is "
+      "bounded by it.")
+_knob("SW_FILER_TICK_S", "float", 1.0,
+      "Filer background deletion/notification loop tick seconds.")
+_knob("SW_HTTP_POOL_MAX_IDLE_S", "float", 60.0,
+      "Idle age after which pooled keep-alive connections are evicted.")
+_knob("SW_HTTP_PLANE_LIB", "str", None,
+      "Override path to the native HTTP plane shared library (e.g. an "
+      "ASAN build); must exist when set.")
+_knob("SW_RETRY_BACKOFF_SCALE", "float", 1.0,
+      "Multiplier on internal retry-backoff sleeps (uploads, streams, "
+      "vid-map refresh, notification queues); 0 retries immediately.")
+_knob("SW_CLUSTER_SCRAPE_S", "float", 15.0,
+      "Master metrics-scrape sweep interval for /cluster/metrics.")
+_knob("SW_REPAIR_INTERVAL_S", "float", 5.0,
+      "Master repair-queue drain tick seconds; <= 0 disables the loop.")
+_knob("SW_REPAIR_AT_RISK_SCORE", "float", 0.4,
+      "Holder health score below which an advisory at_risk_holder "
+      "incident is queued.")
+
+# EC data path
+_knob("SW_EC_SMALL_DISPATCH_BYTES", "int", 256 << 10,
+      "Width below which device codecs answer reconstruct() on the "
+      "host instead of dispatching.")
+_knob("SW_EC_SMALL_DISPATCH_AUTO", "bool", False,
+      "Let the tuner's fitted host/device crossover supersede "
+      "SW_EC_SMALL_DISPATCH_BYTES live.")
+_knob("SW_EC_GATHER_WINDOW", "int", 4,
+      "Bounded in-flight stripe prefetch window for streaming gathers.")
+_knob("SW_EC_GATHER_MODE", "str", "stream",
+      "ec.rebuild default transfer mode: stream or copy.")
+_knob("SW_EC_HEDGE_MS", "float", 0.0,
+      "Hedge a duplicate survivor range read after this many ms; 0 "
+      "disables hedging.")
+_knob("SW_EC_SPREAD_WINDOW", "int", 4,
+      "Bounded per-target send-queue window for streaming encode "
+      "spread.")
+_knob("SW_EC_SPREAD_MODE", "str", "stream",
+      "ec.encode default transfer mode: stream or copy.")
+_knob("SW_EC_REPAIR_MODE", "str", "auto",
+      "Single-shard rebuild mode: auto (trace with fallback), trace, "
+      "or full.")
+_knob("SW_EC_DEGRADED_CACHE_BYTES", "int", 64 << 20,
+      "Byte budget of the reconstructed-slab LRU; 0 disables caching.")
+_knob("SW_EC_DEGRADED_SLAB_BYTES", "int", 128 << 10,
+      "Reconstructed-slab granularity of the degraded-read engine.")
+_knob("SW_EC_DEGRADED_BATCH_MS", "float", 2.0,
+      "Degraded-read leader coalescing window in milliseconds.")
+_knob("SW_EC_DEGRADED_READ_TIMEOUT_S", "float", 10.0,
+      "Per-holder budget for degraded-read survivor fetches.")
+_knob("SW_EC_DEGRADED_READAHEAD_SLABS", "int", 1,
+      "Neighbor slabs reconstructed per degraded batch beyond the "
+      "requested range; 0 disables.")
+_knob("SW_EC_DEGRADED_MODE", "str", "batch",
+      "Degraded-read serving mode: batch (engine) or naive (per-read "
+      "exactly-k fallback).")
+_knob("SW_EC_SCRUB_RATE_MBPS", "float", 8.0,
+      "Gather-bandwidth ceiling for a scrub pass; 0 disables pacing.")
+_knob("SW_EC_SCRUB_IDLE_S", "float", 300.0,
+      "Sleep between background scrub passes; <= 0 disables the loop "
+      "(manual POST /admin/ec/scrub still works).")
+_knob("SW_EC_SCRUB_SLAB_BYTES", "int", 1 << 20,
+      "Scrub verification slab size in bytes.")
+_knob("SW_EC_HEALTH_REF_MS", "float", 50.0,
+      "Holder fetch latency that scores 0.5 on the health board.")
+_knob("SW_EC_HEALTH_ROUTING", "bool", False,
+      "Consult holder health scores when routing gathers and choosing "
+      "rebuild survivors.")
+
+# debug / tooling
+_knob("SW_PROFILE_DIR", "str", None,
+      "Directory for jax.profiler traces; profiling is off when unset.")
+_knob("SW_LOCK_DEBUG", "bool", False,
+      "Record the cross-thread lock-acquisition graph (util/locks.py) "
+      "for deadlock detection; auto-on under pytest.")
+_knob("SW_LOCK_GRAPH_DIR", "str", None,
+      "Directory where instrumented processes dump their lock graph at "
+      "exit for cross-process cycle checks.")
+
+# bench.py drills
+_knob("SW_BENCH_TRIALS", "int", 2,
+      "Best-of trials per timed bench pass.")
+_knob("SW_BENCH_DAT_MB", "int", 4096,
+      "Bench volume size in MB for the headline configs.")
+_knob("SW_BENCH_SLAB_MB", "int", 8,
+      "Bench device slab per shard row in MB.")
+_knob("SW_BENCH_INIT_TIMEOUT", "float", 180.0,
+      "Seconds to wait for device backend init before falling back.")
+_knob("SW_BENCH_INIT_RETRIES", "int", 5,
+      "Legacy alias for SW_BENCH_DEVICE_INIT_RETRIES.")
+_knob("SW_BENCH_DEVICE_INIT_RETRIES", "int", 5,
+      "Device-init attempts before the CPU fallback is recorded.")
+_knob("SW_BENCH_INIT_RETRY_TIMEOUT", "float", 120.0,
+      "Per-attempt timeout for device-init retries.")
+_knob("SW_BENCH_INIT_RETRY_SPACING", "float", 15.0,
+      "Base spacing between device-init retries (doubles per attempt).")
+_knob("SW_BENCH_INIT_RETRY_MAX_SPACING", "float", 120.0,
+      "Cap on the exponential device-init retry spacing.")
+_knob("SW_BENCH_DIR", "str", None,
+      "Bench working directory (default: a fresh temp dir).")
+_knob("SW_BENCH_KEEP", "bool", False,
+      "Keep the bench working directory instead of deleting it.")
+_knob("SW_BENCH_GEO_MB", "int", 256,
+      "Volume MB for the RS-geometry sweep configs.")
+_knob("SW_BENCH_SMALL_VOLS", "int", 4,
+      "Volumes in the batched small-needle config.")
+_knob("SW_BENCH_SMALL_NEEDLES", "int", 8192,
+      "4 KB needles per volume in the batched small-needle config.")
+_knob("SW_BENCH_CLUSTER_MB", "int", 256,
+      "Volume MB for the live-cluster rebuild drill.")
+_knob("SW_BENCH_CLUSTER_TPU_MB", "int", 64,
+      "Volume MB for the TPU live-cluster rebuild drill.")
+_knob("SW_BENCH_CLUSTER_SERVERS", "int", 4,
+      "Volume servers in the live-cluster drills.")
+_knob("SW_BENCH_CLUSTER_BACKEND", "str", "mesh",
+      "EC backend for the live-cluster rebuild drill.")
+_knob("SW_BENCH_DRILL_TIMEOUT", "float", 900.0,
+      "Subprocess timeout for each cluster drill phase.")
+_knob("SW_BENCH_DP_SECONDS", "float", 5.0,
+      "Duration of each data-plane saturation pass.")
+_knob("SW_BENCH_DP_CONNS", "int", 12,
+      "Concurrent connections in the data-plane saturation pass.")
+_knob("SW_BENCH_DEGRADED_NEEDLES", "int", 24,
+      "Needles written for the degraded-read drill.")
+_knob("SW_BENCH_DEGRADED_KB", "int", 64,
+      "Needle KB for the degraded-read drill.")
+_knob("SW_BENCH_DEGRADED_READERS", "int", 8,
+      "Concurrent readers in the degraded-read drill.")
+_knob("SW_BENCH_DEGRADED_ROUNDS", "int", 3,
+      "Read rounds per phase in the degraded-read drill.")
+_knob("SW_BENCH_DEGRADED_BACKEND", "str", "numpy",
+      "EC backend for the degraded-read drill.")
+_knob("SW_BENCH_SCRUB_VOLUMES", "int", 3,
+      "EC volumes in the scrub/repair drill.")
+_knob("SW_BENCH_SCRUB_NEEDLES", "int", 8,
+      "Needles per volume in the scrub/repair drill.")
+_knob("SW_BENCH_SCRUB_KB", "int", 64,
+      "Needle KB in the scrub/repair drill.")
+_knob("SW_BENCH_SCRUB_READERS", "int", 4,
+      "Concurrent foreground readers in the scrub/repair drill.")
+
+_UNSET = object()
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def _lookup(name: str, kind: str, fallback):
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise KeyError(
+            f"env knob {name} is not registered in util/config.py — "
+            f"declare it with _knob() (tools/analyze.py enforces this)")
+    if knob.kind != kind:
+        raise TypeError(
+            f"env knob {name} is registered as {knob.kind}, read as "
+            f"{kind}")
+    raw = os.environ.get(name)
+    default = knob.default if fallback is _UNSET else fallback
+    return raw, default
+
+
+def env_str(name: str, fallback=_UNSET) -> Optional[str]:
+    raw, default = _lookup(name, "str", fallback)
+    return raw if raw is not None else default
+
+
+def env_int(name: str, fallback=_UNSET) -> Optional[int]:
+    raw, default = _lookup(name, "int", fallback)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, fallback=_UNSET) -> Optional[float]:
+    raw, default = _lookup(name, "float", fallback)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, fallback=_UNSET) -> bool:
+    raw, default = _lookup(name, "bool", fallback)
+    if raw is None:
+        return bool(default)
+    return raw.strip().lower() in _TRUTHY
+
+
+def retry_backoff_s(seconds: float) -> float:
+    """Internal retry-backoff sleeps route through here so one knob
+    (SW_RETRY_BACKOFF_SCALE) can compress them — the tier-1 conftest
+    zeroes it; a congested deployment can stretch it."""
+    return max(0.0, seconds * env_float("SW_RETRY_BACKOFF_SCALE"))
+
+
+def env_is_set(name: str) -> bool:
+    """Whether the (registered) knob is explicitly set in the
+    environment — for override-must-fail-loudly semantics."""
+    _lookup(name, KNOBS[name].kind if name in KNOBS else "str", _UNSET)
+    return name in os.environ
+
+
+def env_table() -> str:
+    """The README env-knob table, generated from the registry (one
+    source of truth; tools/analyze.py fails when the committed copy is
+    stale)."""
+    rows = ["| Variable | Type | Default | Description |",
+            "| --- | --- | --- | --- |"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        rows.append(
+            f"| `{k.name}` | {k.kind} | `{k.default_repr()}` | "
+            f"{k.doc} |")
+    return "\n".join(rows)
+
 
 def _flatten(d: dict, prefix: str = "") -> Dict[str, object]:
     out: Dict[str, object] = {}

@@ -13,13 +13,14 @@ import inspect
 import os
 import sys
 import threading
+from .locks import make_lock
 import time
 from typing import Dict, TextIO
 
 _verbosity = 0
 _vmodule: Dict[str, int] = {}
 _stream: TextIO = sys.stderr
-_lock = threading.Lock()
+_lock = make_lock("glog._lock")
 
 
 def set_verbosity(v: int):

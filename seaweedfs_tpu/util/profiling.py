@@ -26,6 +26,8 @@ import contextlib
 import cProfile
 import os
 import threading
+from . import config
+from .locks import make_lock
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -34,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 def maybe_trace(label: str = "trace", profile_dir: Optional[str] = None):
     """Capture a jax.profiler trace into ``$SW_PROFILE_DIR/<label>`` (or
     ``profile_dir``) when configured; otherwise do nothing."""
-    out = profile_dir or os.environ.get("SW_PROFILE_DIR")
+    out = profile_dir or config.env_str("SW_PROFILE_DIR")
     if not out:
         yield
         return
@@ -132,7 +134,7 @@ class StageTimer:
         self.bytes: Dict[str, int] = {}
         self.intervals: Dict[str, List[Tuple[float, float]]] = {}
         self._t0 = time.perf_counter()
-        self._lock = threading.Lock()  # stages report from worker threads
+        self._lock = make_lock("profiling._lock")  # stages report from worker threads
 
     def add(self, stage: str, dt: float, nbytes: int = 0,
             interval: Optional[Tuple[float, float]] = None):

@@ -31,6 +31,7 @@ import contextlib
 import contextvars
 import os
 import threading
+from .locks import make_lock
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
@@ -217,7 +218,7 @@ class TraceRing:
     def __init__(self, max_traces: int = 64, max_spans: int = 512):
         self.max_traces = max_traces
         self.max_spans = max_spans
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracing._lock")
         self._traces: "OrderedDict[str, List[Dict]]" = OrderedDict()
 
     def add(self, span_dict: Dict):

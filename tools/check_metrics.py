@@ -1,10 +1,7 @@
 #!/usr/bin/env python
-"""Lint the metric registries against the Prometheus naming rules.
-
-Imports every per-role registry (stats/metrics.py), checks metric and
-label names against the upstream data-model rules, and renders each
-registry to confirm the exposition text parses line-by-line. Run by
-tier-1 tests (tests/test_stats.py) and usable standalone:
+"""Thin shim: the metrics lint moved into tools/analyze.py (the
+``metrics`` sub-checker).  Kept so existing callers — tests and
+muscle memory — keep working:
 
     python tools/check_metrics.py
 """
@@ -12,237 +9,20 @@ tier-1 tests (tests/test_stats.py) and usable standalone:
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# https://prometheus.io/docs/concepts/data_model/
-METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
-# exposition sample line: name{labels} value  (HELP/TYPE checked apart)
-SAMPLE_RE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
-    r' -?[0-9.eE+-]+(e[+-]?[0-9]+)?$|'
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \+?-?Inf$|'
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? NaN$')
-RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
-
-# families the streaming-gather observability contract depends on: the
-# dashboards/bench assertions reference them by name, so renaming or
-# dropping one must fail the lint, not silently flatline a panel
-REQUIRED_FAMILIES = {
-    "master": (
-        "SeaweedFS_master_cluster_scrape_total",
-        "SeaweedFS_master_cluster_scrape_seconds",
-        "SeaweedFS_master_cluster_node_up",
-        "SeaweedFS_master_cluster_scraped_nodes",
-        "SeaweedFS_master_repair_queue_incidents_total",
-        "SeaweedFS_master_repair_queue_open",
-        "SeaweedFS_master_repair_queue_ttr_seconds",
-    ),
-    "volume": (
-        "SeaweedFS_volumeServer_ec_holder_health",
-        "SeaweedFS_volumeServer_ec_holder_latency_ewma_ms",
-        "SeaweedFS_volumeServer_ec_holder_events_total",
-        "SeaweedFS_volumeServer_ec_phase_seconds_total",
-        "SeaweedFS_volumeServer_ec_gather_total",
-        "SeaweedFS_volumeServer_ec_gather_seconds_total",
-        "SeaweedFS_volumeServer_ec_gather_mbps",
-        "SeaweedFS_volumeServer_ec_overlap_frac",
-        "SeaweedFS_volumeServer_http_pool_churn_total",
-        "SeaweedFS_volumeServer_ec_spread_total",
-        "SeaweedFS_volumeServer_ec_spread_seconds_total",
-        "SeaweedFS_volumeServer_ec_spread_mbps",
-        "SeaweedFS_volumeServer_ec_encode_overlap_frac",
-        "SeaweedFS_volumeServer_ec_repair_total",
-        "SeaweedFS_volumeServer_ec_repair_seconds_total",
-        "SeaweedFS_volumeServer_ec_repair_bytes_frac",
-        "SeaweedFS_volumeServer_ec_repair_symbol_bits_total",
-        "SeaweedFS_volumeServer_ec_degraded_total",
-        "SeaweedFS_volumeServer_ec_degraded_read_seconds",
-        "SeaweedFS_volumeServer_ec_degraded_batch_width",
-        "SeaweedFS_volumeServer_ec_degraded_cache_hit_ratio",
-        "SeaweedFS_volumeServer_ec_degraded_readahead_hit_ratio",
-        "SeaweedFS_volumeServer_ec_scrub_total",
-        "SeaweedFS_volumeServer_ec_scrub_mbps",
-        "SeaweedFS_volumeServer_ec_scrub_last_pass_unixtime",
-    ),
-}
-
-# every EC admin route registered on the volume server must appear as a
-# literal path in at least one test: an unexercised route is dead code
-# at best and an untested failure mode at worst
-EC_ROUTE_RE = re.compile(
-    r'router\.add\(\s*"(?:GET|POST|\*)"\s*,\s*\n?\s*"(/admin/ec/[^"]+)"')
-
-
-def check_route_coverage(repo_root: str) -> list:
-    vs_py = os.path.join(repo_root, "seaweedfs_tpu", "server",
-                         "volume_server.py")
-    with open(vs_py, encoding="utf-8") as f:
-        routes = EC_ROUTE_RE.findall(f.read())
-    if not routes:
-        return [f"route-coverage: no /admin/ec/ routes found in {vs_py}"]
-    tests_dir = os.path.join(repo_root, "tests")
-    corpus = []
-    for name in sorted(os.listdir(tests_dir)):
-        if name.endswith(".py"):
-            with open(os.path.join(tests_dir, name),
-                      encoding="utf-8") as f:
-                corpus.append(f.read())
-    blob = "\n".join(corpus)
-    problems = [f"route-coverage: {route} is registered in "
-                f"volume_server.py but no test references it"
-                for route in routes if route not in blob]
-    # the repair-read route carries a mini-protocol (ranged projected
-    # reads, 416 beyond-shard, 400 bad masks/range, 404 wrong shard) —
-    # a test must exercise the ranged form AND the error responses, not
-    # just mention the path
-    repair_route = "/admin/ec/shard_repair_read"
-    if repair_route in routes and repair_route in blob:
-        repair_files = [c for c in corpus if repair_route in c]
-        if not any("offset=" in c for c in repair_files):
-            problems.append(
-                f"route-coverage: no test exercises {repair_route} "
-                f"with a ranged (offset=) request")
-        for status in ("416", "404", "400"):
-            if not any(status in c for c in repair_files):
-                problems.append(
-                    f"route-coverage: no test covering {repair_route} "
-                    f"asserts a {status} error response")
-    # the degraded-read engine has no route of its own — reads enter
-    # through the public needle GET and fall through
-    # _reconstruct_shard_range — so the route scan above can't see it.
-    # Require tests to exercise the engine, the serving fallthrough and
-    # its metric families by name, like the repair mini-protocol above.
-    degraded_py = os.path.join(repo_root, "seaweedfs_tpu", "ec",
-                               "degraded.py")
-    if os.path.exists(degraded_py):
-        for token, what in (
-                ("DegradedReadEngine", "the engine"),
-                ("_reconstruct_shard_range", "the serving fallthrough"),
-                ("ec_degraded_", "the ec_degraded_* metric families")):
-            if token not in blob:
-                problems.append(
-                    f"degraded-coverage: no test under tests/ "
-                    f"references {token} ({what})")
-    # integrity plane: the scrub engine and the master's repair queue
-    # back the /cluster/repairs view and the corruption drill — each
-    # surface must be exercised by name, same contract as above
-    scrub_py = os.path.join(repo_root, "seaweedfs_tpu", "ec", "scrub.py")
-    if os.path.exists(scrub_py):
-        for token, what in (
-                ("ScrubEngine", "the scrub engine"),
-                ("ec_scrub_", "the ec_scrub_* metric families"),
-                ("RepairQueue", "the master repair queue"),
-                ("repair_queue_", "the repair_queue_* metric families")):
-            if token not in blob:
-                problems.append(
-                    f"scrub-coverage: no test under tests/ "
-                    f"references {token} ({what})")
-    # fleet health plane: every observability route must be exercised by
-    # a test — these feed dashboards and the health-routing decision, so
-    # an untested one can silently serve garbage
-    master_py = os.path.join(repo_root, "seaweedfs_tpu", "server",
-                             "master.py")
-    with open(master_py, encoding="utf-8") as f:
-        master_src = f.read()
-    for route, src, src_name in (
-            ("/cluster/metrics", master_src, "master.py"),
-            ("/cluster/health", master_src, "master.py"),
-            ("/cluster/repairs", master_src, "master.py"),
-            ("/admin/traces/export", master_src, "master.py")):
-        if f'"{route}"' not in src:
-            problems.append(
-                f"route-coverage: {route} is not registered in "
-                f"{src_name}")
-        elif route not in blob:
-            problems.append(
-                f"route-coverage: {route} is registered in {src_name} "
-                f"but no test references it")
-    return problems
-
-
-def check_required(role: str, registry) -> list:
-    names = {m.name for m in registry._metrics}
-    return [f"{role}: required metric family missing: {want}"
-            for want in REQUIRED_FAMILIES.get(role, ())
-            if want not in names]
-
-
-def check_registry(role: str, registry) -> list:
-    problems = []
-    seen = {}
-    for m in registry._metrics:
-        where = f"{role}:{m.name}"
-        if not METRIC_NAME_RE.match(m.name):
-            problems.append(f"{where}: invalid metric name")
-        if m.name.startswith("__"):
-            problems.append(f"{where}: reserved __ metric prefix")
-        if m.kind == "counter" and not m.name.endswith("_total"):
-            problems.append(f"{where}: counter must end in _total")
-        if m.kind == "histogram" and \
-                m.name.endswith(RESERVED_SUFFIXES):
-            problems.append(
-                f"{where}: histogram base name ends in a reserved "
-                f"series suffix")
-        prev = seen.get(m.name)
-        if prev is not None and prev != (m.kind, m.label_names):
-            problems.append(
-                f"{where}: duplicate registration with different "
-                f"kind/labels {prev} vs {(m.kind, m.label_names)}")
-        seen[m.name] = (m.kind, m.label_names)
-        for ln in m.label_names:
-            if not LABEL_NAME_RE.match(ln):
-                problems.append(f"{where}: invalid label name {ln!r}")
-            if ln.startswith("__"):
-                problems.append(f"{where}: reserved __ label {ln!r}")
-            if m.kind == "histogram" and ln == "le":
-                problems.append(
-                    f"{where}: 'le' is reserved for histogram buckets")
-    return problems
-
-
-def check_render(role: str, registry) -> list:
-    problems = []
-    for i, line in enumerate(registry.render().splitlines()):
-        if not line:
-            continue
-        if line.startswith("# HELP ") or line.startswith("# TYPE "):
-            continue
-        if not SAMPLE_RE.match(line):
-            problems.append(
-                f"{role} render line {i + 1}: unparseable exposition "
-                f"text: {line!r}")
-    return problems
+from analyze import run_metrics_checks  # noqa: E402
 
 
 def main() -> int:
-    from seaweedfs_tpu.stats import metrics
-
-    registries = {
-        "master": metrics.MASTER_GATHER,
-        "volume": metrics.VOLUME_SERVER_GATHER,
-        "filer": metrics.FILER_GATHER,
-    }
-    problems = []
-    for role, reg in registries.items():
-        problems += check_registry(role, reg)
-        problems += check_render(role, reg)
-        problems += check_required(role, reg)
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    problems += check_route_coverage(repo_root)
+    problems = run_metrics_checks()
     if problems:
         for p in problems:
             print(f"check_metrics: {p}", file=sys.stderr)
         return 1
-    total = sum(len(r._metrics) for r in registries.values())
-    print(f"check_metrics: {total} metrics across "
-          f"{len(registries)} registries OK")
+    print("check_metrics: metrics sub-checker OK (see tools/analyze.py)")
     return 0
 
 
