@@ -1342,6 +1342,133 @@ def measure_data_plane(seconds: float = None) -> dict:
         _shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _plane_quantile_us(buckets, total: int, q: float) -> float:
+    """Quantile estimate from the plane's non-cumulative latency
+    buckets ([(bound_us or None, count), ...]); returns the upper bound
+    of the bucket the quantile falls in."""
+    if not total:
+        return 0.0
+    target = q * total
+    cum = 0
+    last = 0.0
+    for bound, count in buckets:
+        cum += count
+        if cum >= target:
+            return float(bound) if bound is not None else last * 2
+        if bound is not None:
+            last = float(bound)
+    return last
+
+
+def measure_cluster_plane_read() -> dict:
+    """`cluster_plane_read`: the hot-path observability drill — keep-
+    alive GETs against the native plane with telemetry on, reporting the
+    plane's OWN latency quantiles (from the in-plane histogram), the
+    redirect ratio and slow-ring depth, then the same read pass with
+    telemetry off (the SW_PLANE_STATS=0 escape hatch toggles the same
+    atomic) to assert the counters+clock cost is in-noise."""
+    import http.client
+    import shutil as _shutil
+    from seaweedfs_tpu.server import native_plane
+    from seaweedfs_tpu.server.http_util import post_json, post_multipart
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    if not native_plane.available():
+        raise RuntimeError("native plane unavailable")
+    workdir = tempfile.mkdtemp(prefix="swplane_")
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = None
+    try:
+        vs = VolumeServer(port=0,
+                          directories=[os.path.join(workdir, "v")],
+                          master_url=master.url, pulse_seconds=1,
+                          max_volume_counts=[8],
+                          ec_backend="numpy").start()
+        assert vs.fast_plane is not None, "plane failed to start"
+        paths = []
+        deadline = time.monotonic() + 15
+        for i in range(128):
+            while True:
+                try:
+                    a = post_json(f"http://{master.url}/dir/assign", {})
+                    break
+                except Exception:  # noqa: BLE001 - cluster assembling
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            post_multipart(f"http://{a['url']}/{a['fid']}", "b.bin",
+                           b"plane-bench|%04d|" % i * 64,
+                           "application/octet-stream")
+            paths.append("/" + a["fid"])
+        host, port = vs.fast_url.split(":")
+
+        def read_pass(n):
+            lat = []
+            c = http.client.HTTPConnection(host, int(port), timeout=10)
+            try:
+                for i in range(n):
+                    t0 = time.perf_counter()
+                    c.request("GET", paths[i % len(paths)])
+                    r = c.getresponse()
+                    r.read()
+                    lat.append(time.perf_counter() - t0)
+                    if r.status != 200:
+                        raise RuntimeError(f"plane status {r.status}")
+            finally:
+                c.close()
+            lat.sort()
+            return lat
+
+        read_pass(200)   # warm the mirror, the page cache, the client
+        n = 2000
+        on_p50, off_p50 = [], []
+        client_lat = None
+        for _ in range(max(2, config.env_int("SW_BENCH_TRIALS"))):
+            vs.fast_plane.set_stats_enabled(True)
+            lat = read_pass(n)
+            client_lat = lat
+            on_p50.append(lat[len(lat) // 2])
+            vs.fast_plane.set_stats_enabled(False)
+            lat = read_pass(n)
+            off_p50.append(lat[len(lat) // 2])
+        vs.fast_plane.set_stats_enabled(True)
+        snap = vs.fast_plane.stats()
+        total = snap["lat_count"]
+        requests = max(1, snap["requests"])
+        out = {
+            "reads": n * len(on_p50),
+            "plane_p50_us": _plane_quantile_us(snap["buckets"], total,
+                                               0.50),
+            "plane_p99_us": _plane_quantile_us(snap["buckets"], total,
+                                               0.99),
+            "client_p50_us": round(client_lat[len(client_lat) // 2]
+                                   * 1e6, 1),
+            "client_p99_us": round(
+                client_lat[int(len(client_lat) * 0.99)] * 1e6, 1),
+            "redirect_ratio": round(snap["redirects"] / requests, 4),
+            "slow_ring_depth": len(vs.fast_plane.slow_requests()),
+        }
+        # best-of-trials is stable against scheduler noise; the
+        # telemetry cost per request is tens of ns against a >=50us
+        # loopback request, so anything past 15%+10us is a regression,
+        # not noise
+        best_on, best_off = min(on_p50), min(off_p50)
+        out["stats_on_p50_us"] = round(best_on * 1e6, 1)
+        out["stats_off_p50_us"] = round(best_off * 1e6, 1)
+        out["overhead_pct"] = round(
+            (best_on - best_off) / best_off * 100, 2)
+        out["in_noise"] = best_on <= best_off * 1.15 + 10e-6
+        assert out["in_noise"], \
+            f"plane telemetry overhead out of noise: {out}"
+        log(f"cluster plane read: {out}")
+        return out
+    finally:
+        if vs is not None:
+            vs.stop()
+        master.stop()
+        _shutil.rmtree(workdir, ignore_errors=True)
+
+
 def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
     """BASELINE configs 3-5 plus the reference's own req/s headline,
     each scaled by env and individually fault-isolated (they report
@@ -1363,6 +1490,13 @@ def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
             config.env_int("SW_BENCH_SMALL_NEEDLES"))
     except Exception as e:  # noqa: BLE001 - secondary
         log(f"small-needle bench failed: {e!r}")
+    # hot-path observability drill: the plane's own latency quantiles,
+    # redirect ratio and slow-ring depth, plus the telemetry-overhead
+    # in-noise assertion vs the SW_PLANE_STATS=0 escape hatch
+    try:
+        extras["cluster_plane_read"] = measure_cluster_plane_read()
+    except Exception as e:  # noqa: BLE001 - secondary
+        log(f"cluster plane-read bench failed: {e!r}")
     # loss-masked reads under live traffic: healthy vs degraded p99,
     # batched engine vs naive per-read reconstruct
     try:

@@ -25,8 +25,10 @@ from ..filer.filer import FilerError, NotFoundError
 from ..filer.log_buffer import LogBuffer, event_notification
 from ..filer.filerstore import make_store
 from ..filer.stream import read_chunked
+from ..util import tracing
 from .http_util import (HttpError, HttpServer, Request, Response,
-                        Router, traces_export_handler, traces_handler)
+                        Router, profile_handler, traces_export_handler,
+                        traces_handler)
 
 CHUNK_SIZE_DEFAULT = 32 << 20  # reference -maxMB=32 autochunk default
 
@@ -59,13 +61,15 @@ class FilerServer:
         router.add("GET", "/stats/integrity", self.stats_integrity)
         router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/admin/traces/export", traces_export_handler)
+        router.add("POST", "/admin/profile", profile_handler)
         router.set_fallback(self.data_handler)
         from ..stats.metrics import (FILER_REQUEST_COUNTER,
                                      FILER_REQUEST_HISTOGRAM)
 
         def observe(label, seconds, ok):
             FILER_REQUEST_COUNTER.inc(label if ok else label + " error")
-            FILER_REQUEST_HISTOGRAM.observe(seconds, label)
+            FILER_REQUEST_HISTOGRAM.observe(
+                seconds, label, trace_id=tracing.current_trace_id())
         router.observe = observe
         self.server = HttpServer(port, router, host)
         self.port = self.server.port

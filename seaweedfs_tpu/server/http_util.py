@@ -208,6 +208,36 @@ def traces_export_handler(req: Request) -> dict:
     return trace_export.chrome_trace_events(tracing.RING.get(tid))
 
 
+# one profile at a time per process — concurrent samplers would double
+# the GIL-held stack-walk overhead and interleave their sample counts
+_PROFILE_LOCK = make_lock("http_util._profile_lock")
+
+
+def profile_handler(req: Request) -> "Response":
+    """On-demand all-thread sampling profile, shared by every server
+    role: ``POST /admin/profile?seconds=N`` samples for N seconds
+    (clamped to SW_PROFILE_MAX_S) and returns collapsed stacks as
+    text/plain — the folded format flamegraph.pl and speedscope ingest.
+    A second request while one is running gets 409 instead of stacking
+    sampler threads."""
+    from ..util.profiling import SamplingProfiler
+    try:
+        seconds = float(req.query.get("seconds", "2"))
+    except ValueError:
+        raise HttpError(400, "seconds must be a number")
+    if seconds <= 0:
+        raise HttpError(400, "seconds must be > 0")
+    seconds = min(seconds, config.env_float("SW_PROFILE_MAX_S"))
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise HttpError(409, "a profile is already running")
+    try:
+        folded = SamplingProfiler.run_for(seconds)
+    finally:
+        _PROFILE_LOCK.release()
+    return Response(folded.encode("utf-8"), 200,
+                    "text/plain; charset=utf-8")
+
+
 def process_memory_stats() -> dict:
     """Peak RSS of this process (reference statsMemoryHandler).
     ru_maxrss is kilobytes on Linux but BYTES on macOS/BSD."""

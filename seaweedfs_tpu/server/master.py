@@ -15,11 +15,11 @@ from ..util.locks import make_lock
 import time
 
 from ..storage.types import TTL, ReplicaPlacement
-from ..util import config
+from ..util import config, tracing
 from ..topology.topology import RaftSequencer, Topology
 from ..topology.volume_growth import NoFreeSlots, find_empty_slots
 from .http_util import (HttpError, HttpServer, Request, Response,
-                        Router, post_json, post_multipart,
+                        Router, post_json, post_multipart, profile_handler,
                         traces_export_handler, traces_handler)
 
 
@@ -75,6 +75,7 @@ class MasterServer:
                    self.cluster_scrub_report)
         router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/admin/traces/export", traces_export_handler)
+        router.add("POST", "/admin/profile", profile_handler)
         router.add("GET", "/", self.ui_handler)
         router.add("GET", "/ui", self.ui_handler)
         # GET /<fid> on the master redirects to a holder (reference
@@ -102,7 +103,8 @@ class MasterServer:
 
         def observe(label, seconds, ok):
             MASTER_REQUEST_COUNTER.inc(label if ok else label + " error")
-            MASTER_REQUEST_HISTOGRAM.observe(seconds, label)
+            MASTER_REQUEST_HISTOGRAM.observe(
+                seconds, label, trace_id=tracing.current_trace_id())
         router.observe = observe
         self.server = HttpServer(port, router, host)
         self.port = self.server.port

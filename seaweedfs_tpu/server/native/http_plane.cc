@@ -255,6 +255,68 @@ struct VolumeRec {
   }
 };
 
+// ------------------------------------------------------------- telemetry
+// Request telemetry for the hot path: plain relaxed atomics on the fast
+// path (one cache line of fetch_adds per request, no locks), a
+// fixed-bucket latency histogram in µs, and a bounded slow-request ring
+// whose mutex is taken only when a request crosses the slow threshold.
+// The µs bucket bounds must cover both the in-memory hit (~tens of µs)
+// and a degraded/redirected tail (seconds); the Python side reads them
+// via swhp_lat_bounds so the two never drift.
+constexpr uint64_t kLatBoundsUs[] = {50,     100,    250,    500,
+                                     1000,   2500,   5000,   10000,
+                                     25000,  50000,  100000, 250000,
+                                     1000000, 5000000};
+constexpr int kLatBuckets =
+    static_cast<int>(sizeof(kLatBoundsUs) / sizeof(kLatBoundsUs[0]));
+constexpr int kSlowRing = 64;
+
+struct SlowEntry {
+  char method[8] = {0};
+  char target[96] = {0};
+  int status = 0;
+  uint64_t bytes = 0;
+  uint64_t micros = 0;
+  uint64_t unix_ms = 0;
+};
+
+struct PlaneStats {
+  std::atomic<bool> enabled{true};
+  std::atomic<uint64_t> slow_us{10000};
+  std::atomic<uint64_t> requests{0};
+  std::atomic<uint64_t> by_class[6] = {};  // [1..5] = 1xx..5xx
+  std::atomic<uint64_t> bytes_sent{0};
+  std::atomic<uint64_t> index_misses{0};
+  std::atomic<uint64_t> lat_count{0};
+  std::atomic<uint64_t> lat_sum_us{0};
+  std::atomic<uint64_t> lat_buckets[kLatBuckets + 1] = {};  // +1: overflow
+  std::mutex slow_mu;
+  SlowEntry slow[kSlowRing];
+  uint64_t slow_seq = 0;  // total slow entries ever; guarded by slow_mu
+};
+
+// Handlers funnel their response through respond_simple (or write the
+// 200/206 head themselves); these thread-locals carry status+payload
+// size back to handle_conn's per-request record without threading an
+// out-param through every serve_* signature. Thread-per-connection
+// makes them race-free.
+thread_local int tl_status = 0;
+thread_local uint64_t tl_bytes = 0;
+
+uint64_t mono_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000ull +
+         static_cast<uint64_t>(ts.tv_nsec) / 1000;
+}
+
+uint64_t wall_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000ull +
+         static_cast<uint64_t>(ts.tv_nsec) / 1000000;
+}
+
 struct Server {
   int listen_fd = -1;
   uint16_t port = 0;
@@ -268,6 +330,7 @@ struct Server {
   std::thread acceptor;
   std::unordered_map<uint32_t, std::shared_ptr<VolumeRec>> vols;
   mutable std::shared_mutex vols_mu;
+  PlaneStats stats;
 
   std::shared_ptr<VolumeRec> find(uint32_t vid) const {
     std::shared_lock<std::shared_mutex> l(vols_mu);
@@ -318,6 +381,32 @@ struct Request {
   bool chunked = false;
   bool has_pair_headers = false;  // any Seaweed-* header present
 };
+
+void record_request(Server* s, const Request& req, int status,
+                    uint64_t bytes, uint64_t us) {
+  PlaneStats& st = s->stats;
+  st.requests.fetch_add(1, std::memory_order_relaxed);
+  int cls = status / 100;
+  if (cls >= 1 && cls <= 5)
+    st.by_class[cls].fetch_add(1, std::memory_order_relaxed);
+  st.bytes_sent.fetch_add(bytes, std::memory_order_relaxed);
+  st.lat_count.fetch_add(1, std::memory_order_relaxed);
+  st.lat_sum_us.fetch_add(us, std::memory_order_relaxed);
+  int b = 0;
+  while (b < kLatBuckets && us > kLatBoundsUs[b]) b++;
+  st.lat_buckets[b].fetch_add(1, std::memory_order_relaxed);
+  if (us >= st.slow_us.load(std::memory_order_relaxed)) {
+    std::lock_guard<std::mutex> g(st.slow_mu);
+    SlowEntry& e = st.slow[st.slow_seq % kSlowRing];
+    snprintf(e.method, sizeof e.method, "%s", req.method.c_str());
+    snprintf(e.target, sizeof e.target, "%s", req.target.c_str());
+    e.status = status;
+    e.bytes = bytes;
+    e.micros = us;
+    e.unix_ms = wall_ms();
+    st.slow_seq++;
+  }
+}
 
 // Reads one request off the socket (blocking). Returns 1 ok, 0 clean EOF,
 // -1 error/overflow.
@@ -409,6 +498,8 @@ void respond_simple(int fd, int code, const char* reason,
                     const std::string& body, bool keepalive,
                     const std::string& extra_headers = "",
                     const char* ctype = "text/plain") {
+  tl_status = code;
+  tl_bytes += body.size();
   std::string head = "HTTP/1.1 " + std::to_string(code) + " " + reason +
                      "\r\nContent-Length: " + std::to_string(body.size()) +
                      "\r\nContent-Type: " + ctype + "\r\n" + extra_headers +
@@ -557,6 +648,7 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
       // miss therefore redirects to the authoritative Python server —
       // a true miss still ends as its 404, a windowed miss is served.
       l.unlock();
+      s->stats.index_misses.fetch_add(1, std::memory_order_relaxed);
       redirect_to_fallback(s, fd, req);
       return;
     }
@@ -721,11 +813,14 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
   head += req.keepalive ? "Connection: keep-alive\r\n\r\n"
                         : "Connection: close\r\n\r\n";
   s->served++;  // before the send — see the IMS 304 comment
-  if (req.method == "HEAD")
+  tl_status = ranged ? 206 : 200;
+  if (req.method == "HEAD") {
     send_all(fd, head.data(), head.size());
-  else
+  } else {
+    tl_bytes += static_cast<uint64_t>(length);
     send_two(fd, head.data(), head.size(), body + start,
              static_cast<size_t>(length));
+  }
 }
 
 // ----------------------------------------------------------------- write
@@ -1229,6 +1324,12 @@ void handle_conn(Server* s, int fd) {
     Request req;
     int r = read_request(fd, &acc, &req);
     if (r <= 0) break;
+    // time from request-parsed to response handed to the kernel; the
+    // enabled check keeps the counters-off path clock-free
+    bool stats_on = s->stats.enabled.load(std::memory_order_relaxed);
+    uint64_t t0 = stats_on ? mono_us() : 0;
+    tl_status = 0;
+    tl_bytes = 0;
     if (req.chunked) req.keepalive = false;  // body framing not parsed
     uint32_t vid = 0, cookie = 0;
     uint64_t key = 0;
@@ -1269,6 +1370,8 @@ void handle_conn(Server* s, int fd) {
       }
       if (short_read) break;  // torn upload: nothing was appended
       serve_write(s, fd, req, body, vid, key, cookie);
+      if (stats_on)
+        record_request(s, req, tl_status, tl_bytes, mono_us() - t0);
       if (!req.keepalive) break;
       continue;
     }
@@ -1305,6 +1408,8 @@ void handle_conn(Server* s, int fd) {
     } else {
       redirect_to_fallback(s, fd, req);
     }
+    if (stats_on)
+      record_request(s, req, tl_status, tl_bytes, mono_us() - t0);
     if (!req.keepalive) break;
   }
   close(fd);
@@ -1557,6 +1662,103 @@ uint64_t swhp_redirected(void* h) {
   return static_cast<Server*>(h)->redirected;
 }
 uint64_t swhp_written(void* h) { return static_cast<Server*>(h)->written; }
+
+// ---- hot-path telemetry ------------------------------------------------
+
+// Flat snapshot of the plane's request telemetry (one relaxed load per
+// slot — values from concurrent requests may be mutually torn, which is
+// fine for monotonic counters). Layout, all uint64:
+//   [0] requests_total          [1..5] status classes 1xx..5xx
+//   [6] bytes_sent              [7] redirects_to_python
+//   [8] index_misses            [9] latency observation count
+//   [10] latency sum (µs)       [11..] per-bucket counts, last = +Inf
+// Returns the number of values written, -1 if `out` is too small
+// (size with swhp_stats_len()).
+int swhp_stats_len() { return 11 + kLatBuckets + 1; }
+
+int swhp_stats(void* h, uint64_t* out, int n) {
+  if (!h || n < 11 + kLatBuckets + 1) return -1;
+  Server* s = static_cast<Server*>(h);
+  PlaneStats& st = s->stats;
+  out[0] = st.requests.load(std::memory_order_relaxed);
+  for (int c = 1; c <= 5; c++)
+    out[c] = st.by_class[c].load(std::memory_order_relaxed);
+  out[6] = st.bytes_sent.load(std::memory_order_relaxed);
+  out[7] = s->redirected.load(std::memory_order_relaxed);
+  out[8] = st.index_misses.load(std::memory_order_relaxed);
+  out[9] = st.lat_count.load(std::memory_order_relaxed);
+  out[10] = st.lat_sum_us.load(std::memory_order_relaxed);
+  for (int b = 0; b <= kLatBuckets; b++)
+    out[11 + b] = st.lat_buckets[b].load(std::memory_order_relaxed);
+  return 11 + kLatBuckets + 1;
+}
+
+// µs upper bounds of the latency buckets (the +Inf bucket is implicit).
+int swhp_lat_bounds(uint64_t* out, int n) {
+  if (!out || n < kLatBuckets) return -1;
+  for (int b = 0; b < kLatBuckets; b++) out[b] = kLatBoundsUs[b];
+  return kLatBuckets;
+}
+
+void swhp_set_stats_enabled(void* h, int on) {
+  static_cast<Server*>(h)->stats.enabled.store(
+      on != 0, std::memory_order_relaxed);
+}
+
+void swhp_set_slow_us(void* h, uint64_t us) {
+  static_cast<Server*>(h)->stats.slow_us.store(
+      us, std::memory_order_relaxed);
+}
+
+// Newest-first JSON array of the slow-request ring. Writes at most
+// buflen-1 bytes plus a NUL; returns the body length, or -1 when the
+// buffer cannot hold the whole ring (callers pass 64 KB — 64 entries
+// at ~300 bytes each always fit).
+int swhp_slow_ring(void* h, char* buf, int buflen) {
+  if (!h || !buf || buflen < 3) return -1;
+  PlaneStats& st = static_cast<Server*>(h)->stats;
+  auto jsonable = [](const char* in) {
+    // targets/methods are raw wire bytes: escape quotes/backslashes and
+    // blank out control chars so the ring always parses as JSON
+    std::string out;
+    for (const char* p = in; *p; p++) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"' || c == '\\') {
+        out.push_back('\\');
+        out.push_back(*p);
+      } else if (c < 0x20) {
+        out.push_back('?');
+      } else {
+        out.push_back(*p);
+      }
+    }
+    return out;
+  };
+  std::string out = "[";
+  {
+    std::lock_guard<std::mutex> g(st.slow_mu);
+    uint64_t have = std::min<uint64_t>(st.slow_seq, kSlowRing);
+    for (uint64_t i = 0; i < have; i++) {
+      const SlowEntry& e = st.slow[(st.slow_seq - 1 - i) % kSlowRing];
+      char item[320];
+      snprintf(item, sizeof item,
+               "%s{\"method\": \"%s\", \"target\": \"%s\", "
+               "\"status\": %d, \"bytes\": %llu, \"micros\": %llu, "
+               "\"unix_ms\": %llu}",
+               i ? ", " : "", jsonable(e.method).c_str(),
+               jsonable(e.target).c_str(), e.status,
+               static_cast<unsigned long long>(e.bytes),
+               static_cast<unsigned long long>(e.micros),
+               static_cast<unsigned long long>(e.unix_ms));
+      out += item;
+    }
+  }
+  out += "]";
+  if (out.size() + 1 > static_cast<size_t>(buflen)) return -1;
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return static_cast<int>(out.size());
+}
 
 void swhp_stop(void* h) {
   Server* s = static_cast<Server*>(h);

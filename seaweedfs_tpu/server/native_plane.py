@@ -19,6 +19,7 @@ The index the plane serves from is a mirror, pushed from Python:
 from __future__ import annotations
 
 import ctypes
+import json
 import os
 import threading
 from ..util import config
@@ -34,10 +35,42 @@ _LIB_PATH = config.env_str(
 
 _lib = None
 _lib_lock = make_lock("native_plane._lib_lock")
+# True once the one-time build (or load) failed and the server fell back
+# to the Python path — mirrored into /metrics as the
+# SeaweedFS_volumeServer_plane_build_failed gauge so a fleet silently
+# running GIL-bound data planes is visible on a dashboard
+BUILD_FAILED = False
+
+
+def build_failed() -> bool:
+    return BUILD_FAILED
+
+
+def _compile():
+    """One-shot g++ build of the library (build.sh also builds the
+    loadgen tool, which server startup must not wait for). On failure
+    the compiler's stderr is logged at warning level — a silent fall
+    back to the Python path used to swallow it entirely."""
+    import subprocess
+    from ..util import glog
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+             "-pthread", "-o", _LIB_PATH,
+             os.path.join(_LIB_DIR, "http_plane.cc")],
+            check=True, capture_output=True, timeout=60)
+    except Exception as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        glog.warningf(
+            "native plane build failed (%s: %s) — falling back to the "
+            "Python data plane; compiler stderr:\n%s",
+            type(e).__name__, e,
+            stderr.decode("utf-8", "replace").strip() or "(empty)")
+        raise
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, BUILD_FAILED
     with _lib_lock:
         if _lib is not None:
             return _lib or None
@@ -49,17 +82,20 @@ def _load() -> Optional[ctypes.CDLL]:
             raise FileNotFoundError(
                 f"SW_HTTP_PLANE_LIB={_LIB_PATH} does not exist")
         try:
+            src = os.path.join(_LIB_DIR, "http_plane.cc")
             if not os.path.exists(_LIB_PATH):
-                # compile only the library (build.sh also builds the
-                # loadgen tool, which server startup must not wait for)
-                import subprocess
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
-                     "-pthread", "-o", _LIB_PATH,
-                     os.path.join(_LIB_DIR, "http_plane.cc")],
-                    check=True, capture_output=True, timeout=60)
+                _compile()
+            elif not config.env_is_set("SW_HTTP_PLANE_LIB") and \
+                    os.path.getmtime(_LIB_PATH) < os.path.getmtime(src):
+                # stale build from before a source (possibly ABI)
+                # change; rebuild before the first dlopen — replacing
+                # the file after loading would keep serving the old
+                # mapping for the process lifetime
+                os.remove(_LIB_PATH)
+                _compile()
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception:
+            BUILD_FAILED = True
             _lib = False
             return None
         lib.swhp_start.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
@@ -117,12 +153,46 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.swhp_writer_counters.restype = ctypes.c_int
         lib.swhp_stop.argtypes = [ctypes.c_void_p]
         lib.swhp_stop.restype = None
+        # telemetry ABI — absent only in an explicitly overridden
+        # pre-telemetry build (SW_HTTP_PLANE_LIB), where the wrapper
+        # degrades to stats()=None instead of refusing to serve
+        if hasattr(lib, "swhp_stats"):
+            lib.swhp_stats_len.argtypes = []
+            lib.swhp_stats_len.restype = ctypes.c_int
+            lib.swhp_stats.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint64),
+                                       ctypes.c_int]
+            lib.swhp_stats.restype = ctypes.c_int
+            lib.swhp_lat_bounds.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+            lib.swhp_lat_bounds.restype = ctypes.c_int
+            lib.swhp_slow_ring.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p, ctypes.c_int]
+            lib.swhp_slow_ring.restype = ctypes.c_int
+            lib.swhp_set_stats_enabled.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int]
+            lib.swhp_set_stats_enabled.restype = None
+            lib.swhp_set_slow_us.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint64]
+            lib.swhp_set_slow_us.restype = None
         _lib = lib
         return lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def lat_bounds_us() -> tuple:
+    """µs upper bounds of the plane's latency buckets (the +Inf bucket
+    is implicit). Empty when the library is unavailable or predates the
+    telemetry ABI."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "swhp_lat_bounds"):
+        return ()
+    buf = (ctypes.c_uint64 * 32)()
+    n = lib.swhp_lat_bounds(buf, 32)
+    return tuple(int(buf[i]) for i in range(max(0, n)))
 
 
 class NativeReadPlane:
@@ -141,6 +211,15 @@ class NativeReadPlane:
                 f"native read plane failed to listen on {host}:{port}")
         self.host = host
         self.port = lib.swhp_port(self._h)
+        self._has_stats = hasattr(lib, "swhp_stats")
+        if self._has_stats:
+            # SW_PLANE_STATS=0 is the escape hatch that takes even the
+            # relaxed-atomic bumps off the request path (the bench's
+            # overhead assertion compares against this build)
+            lib.swhp_set_stats_enabled(
+                self._h, 1 if config.env_bool("SW_PLANE_STATS") else 0)
+            lib.swhp_set_slow_us(
+                self._h, max(0, config.env_int("SW_PLANE_SLOW_US")))
 
     # -- volume lifecycle --------------------------------------------------
     def register_volume(self, volume) -> bool:
@@ -249,6 +328,57 @@ class NativeReadPlane:
     def written(self) -> int:
         h = self._h
         return int(self._lib.swhp_written(h)) if h else 0
+
+    # field order of swhp_stats's flat export, ahead of the buckets
+    _STATS_HEAD = ("requests", "status_1xx", "status_2xx", "status_3xx",
+                   "status_4xx", "status_5xx", "bytes_sent", "redirects",
+                   "index_misses", "lat_count", "lat_sum_us")
+
+    def stats(self) -> Optional[dict]:
+        """Telemetry snapshot: the flat counters plus the µs latency
+        histogram as non-cumulative ``(bound_us, count)`` pairs, the
+        trailing pair carrying ``None`` for the +Inf bucket. None when
+        the plane is stopped or the loaded library predates the
+        telemetry ABI."""
+        h = self._h
+        if not h or not self._has_stats:
+            return None
+        n = int(self._lib.swhp_stats_len())
+        buf = (ctypes.c_uint64 * n)()
+        if self._lib.swhp_stats(h, buf, n) != n:
+            return None
+        vals = [int(x) for x in buf]
+        out = dict(zip(self._STATS_HEAD, vals))
+        counts = vals[len(self._STATS_HEAD):]
+        bounds = list(lat_bounds_us())[:len(counts) - 1]
+        out["buckets"] = list(zip(bounds + [None], counts))
+        return out
+
+    def slow_requests(self) -> list:
+        """Newest-first decoded slow-request ring (method, target,
+        status, bytes, micros, unix_ms per entry)."""
+        h = self._h
+        if not h or not self._has_stats:
+            return []
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.swhp_slow_ring(h, buf, len(buf))
+        if n <= 0:
+            return []
+        try:
+            return json.loads(buf.raw[:n].decode("utf-8", "replace"))
+        except ValueError:
+            return []
+
+    def set_stats_enabled(self, on: bool):
+        h = self._h
+        if h and self._has_stats:
+            self._lib.swhp_set_stats_enabled(h, 1 if on else 0)
+
+    def set_slow_us(self, us: int):
+        """Runtime override of the SW_PLANE_SLOW_US ring threshold."""
+        h = self._h
+        if h and self._has_stats:
+            self._lib.swhp_set_slow_us(h, max(0, int(us)))
 
     def stop(self):
         if self._h:

@@ -19,12 +19,13 @@ import numpy as np
 
 from ..ec.constants import DATA_SHARDS, TOTAL_SHARDS, to_ext
 from ..ops.codec import get_codec
+from ..util import tracing
 from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.types import parse_file_id
 from ..storage.volume import NotFound, VolumeError, volume_file_prefix
 from .http_util import (HttpError, HttpServer, Request, Response, Router,
-                        get_json, http_call, post_json,
+                        get_json, http_call, post_json, profile_handler,
                         traces_export_handler, traces_handler)
 
 
@@ -84,6 +85,8 @@ class VolumeServer:
         router.add("GET", "/metrics", self.metrics_handler)
         router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/admin/traces/export", traces_export_handler)
+        router.add("GET", "/admin/plane/slow", self.admin_plane_slow)
+        router.add("POST", "/admin/profile", profile_handler)
         router.add("GET", "/stats/disk", self.stats_disk)
         router.add("GET", "/stats/memory", self.stats_memory)
         router.add("GET", "/ui", self.ui_handler)
@@ -95,7 +98,10 @@ class VolumeServer:
 
         def observe(label, seconds, ok):
             VOLUME_REQUEST_COUNTER.inc(label if ok else label + " error")
-            VOLUME_REQUEST_HISTOGRAM.observe(seconds, label)
+            # the router's server span is still current here, so the
+            # bucket this lands in carries its trace id as an exemplar
+            VOLUME_REQUEST_HISTOGRAM.observe(
+                seconds, label, trace_id=tracing.current_trace_id())
         router.observe = observe
 
         self.server = HttpServer(port, router, host)
@@ -148,7 +154,8 @@ class VolumeServer:
             codec=lambda: self.store.codec or get_codec(DATA_SHARDS, 4),
             loc_cache=self._ec_loc_cache,
             self_url=lambda: self.url,
-            on_read=lambda s: DEGRADED_READ_HISTOGRAM.observe(s))
+            on_read=lambda s: DEGRADED_READ_HISTOGRAM.observe(
+                s, trace_id=tracing.current_trace_id()))
         # a shard (re-)registered after rebuild must win over cached
         # reconstructions immediately
         self.store.on_ec_mount = self.degraded.invalidate
@@ -563,6 +570,16 @@ class VolumeServer:
                                          "redirected")
             FAST_PLANE_COUNTER.set_total(self.fast_plane.written,
                                          "written")
+        # native-plane telemetry (in-plane counters + latency buckets,
+        # mirrored so /cluster/metrics sums them fleet-wide)
+        from . import native_plane as _np
+        from ..stats.metrics import observe_plane
+        if self.fast_plane is not None:
+            observe_plane(self.fast_plane.stats(),
+                          len(self.fast_plane.slow_requests()),
+                          _np.build_failed())
+        else:
+            observe_plane(None, 0, _np.build_failed())
         # device-codec telemetry (process-global monotonic counters)
         # mirrors onto the scrape so dispatches / bitmat uploads / host
         # fallbacks are visible without running a rebuild through bench
@@ -589,6 +606,16 @@ class VolumeServer:
         export_board()
         return Response(VOLUME_SERVER_GATHER.render().encode(),
                         content_type="text/plain; version=0.0.4")
+
+    def admin_plane_slow(self, req: Request):
+        """Newest-first contents of the native plane's slow-request ring
+        (requests that took >= SW_PLANE_SLOW_US, bounded at 64 entries)
+        plus the stats snapshot the ring indexes into."""
+        if self.fast_plane is None:
+            return {"plane": False, "slow": []}
+        return {"plane": True,
+                "slow": self.fast_plane.slow_requests(),
+                "stats": self.fast_plane.stats()}
 
     def admin_assign_volume(self, req: Request):
         vid = int(req.query["volume"])

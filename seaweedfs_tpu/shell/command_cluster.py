@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from typing import List
 
-from ..server.http_util import HttpError
+from ..server.http_util import HttpError, http_call
 from ..util import trace_export
 from .command_env import CommandEnv, command, parse_flags
 
@@ -81,6 +81,51 @@ def cluster_repairs(env: CommandEnv, args: List[str]):
                   f"{inc.get('volume')}.{inc.get('shard')} via "
                   f"{inc.get('via')} "
                   f"ttr={inc.get('time_to_re_protection_s', 0.0):.1f}s")
+
+
+@command("cluster.profile",
+         "[-seconds 2] [-o <file>]: sample every server's Python "
+         "threads (POST /admin/profile) and merge the collapsed stacks "
+         "into one flamegraph/speedscope-ready folded file, each stack "
+         "prefixed with its node")
+def cluster_profile_cmd(env: CommandEnv, args: List[str]):
+    flags = parse_flags(args)
+    try:
+        seconds = float(flags.get("seconds", "2"))
+    except ValueError:
+        env.write("usage: cluster.profile [-seconds N] [-o <file>]")
+        return
+    out_path = flags.get("o") or "cluster_profile.folded"
+    targets = [env.master_url] + \
+        [n["url"] for n in env.cluster_nodes()]
+    # serial on purpose: the profiler is serialized per PROCESS (409 on
+    # overlap), and a test cluster runs every server in one process —
+    # a parallel fan-out there would profile one node and bounce off
+    # the rest
+    merged: List[str] = []
+    sampled = 0
+    for url in targets:
+        try:
+            folded = http_call(
+                "POST",
+                f"http://{url}/admin/profile?seconds={seconds:g}",
+                timeout=seconds + 30.0).decode("utf-8", "replace")
+        except Exception as e:  # noqa: BLE001 - a down node must not
+            # abort the sweep
+            env.write(f"  {url}  unreachable: {e}")
+            continue
+        lines = [ln for ln in folded.splitlines() if ln.strip()]
+        if lines:
+            sampled += 1
+        merged.extend(f"{url};{ln}" for ln in lines)
+    if not merged:
+        env.write("cluster.profile: no samples collected")
+        return
+    with open(out_path, "w") as f:
+        f.write("\n".join(merged) + "\n")
+    env.write(f"cluster.profile: {len(merged)} stacks from "
+              f"{sampled}/{len(targets)} nodes over {seconds:g}s "
+              f"-> {out_path}")
 
 
 @command("trace.export",

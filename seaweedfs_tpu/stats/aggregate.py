@@ -208,6 +208,12 @@ class ClusterMetricsAggregator:
                     for sample_name, labels, value in fam["samples"]:
                         key = (sample_name, labels)
                         acc[key] = acc.get(key, 0.0) + value
+                    # newest exemplar per merged series wins — a fresh
+                    # trace id beats a stale one from another node
+                    for key, ex in (fam.get("exemplars") or {}).items():
+                        held = out.setdefault("exemplars", {}).get(key)
+                        if held is None or ex[2] >= held[2]:
+                            out["exemplars"][key] = ex
                 else:   # gauge / untyped: keep per-node
                     for sample_name, labels, value in fam["samples"]:
                         out["samples"].append(

@@ -105,6 +105,66 @@ def _parse_value(text: str) -> float:
     return float(t)
 
 
+def _fmt_exemplar(labels, value, ts) -> str:
+    """OpenMetrics-style exemplar suffix for a sample line:
+    `` # {trace_id="..."} <observed value> <unix ts>``. Appended to
+    ``_bucket`` series so a tail-latency bucket carries the trace id of
+    the request that landed in it."""
+    body = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in labels)
+    return f" # {{{body}}} {_fmt_value(value)} {_fmt_value(ts)}"
+
+
+def _label_block_end(line: str, start: int) -> int:
+    """Index just past the ``}`` closing the label block whose ``{`` is
+    at ``start``, honoring quoted and escaped label values."""
+    i = start + 1
+    n = len(line)
+    in_q = False
+    while i < n:
+        c = line[i]
+        if in_q:
+            if c == "\\":
+                i += 1
+            elif c == '"':
+                in_q = False
+        elif c == '"':
+            in_q = True
+        elif c == "}":
+            return i + 1
+        i += 1
+    return -1
+
+
+def _split_exemplar(line: str):
+    """Split a sample line into (sample part, exemplar or None).
+
+    The exemplar tail is `` # {labels} value ts``. The marker search
+    starts AFTER the sample's own label block, so a label VALUE
+    containing " # {" never mis-splits."""
+    i = 0
+    n = len(line)
+    while i < n and line[i] not in "{ ":
+        i += 1
+    if i < n and line[i] == "{":
+        i = _label_block_end(line, i)
+        if i < 0:
+            raise ValueError(f"unterminated label block in {line!r}")
+    idx = line.find(" # {", i)
+    if idx < 0:
+        return line, None
+    open_b = idx + 3
+    close = _label_block_end(line, open_b)
+    if close < 0:
+        raise ValueError(f"malformed exemplar in {line!r}")
+    labels = _parse_labels(line[open_b + 1:close - 1])
+    rest = line[close:].split()
+    if len(rest) != 2:
+        raise ValueError(f"malformed exemplar in {line!r}")
+    return line[:idx], (labels, _parse_value(rest[0]),
+                        _parse_value(rest[1]))
+
+
 def _parse_labels(body: str) -> Tuple[Tuple[str, str], ...]:
     """Parse the inside of a {...} label block, honoring escapes."""
     pairs = []
@@ -148,9 +208,13 @@ def parse_prometheus_text(text: str) -> List[Dict]:
          "samples": [(sample_name, ((label, value), ...), float), ...]}
 
     Histogram child series (`_bucket`/`_sum`/`_count`) are grouped under
-    their family.  Designed as the exact inverse of Registry.render():
-    render -> parse -> render_families is a fixed point, so the cluster
-    aggregator can merge scraped text without dropping samples."""
+    their family.  Exemplar tails (`` # {trace_id="..."} v ts``) are
+    kept out-of-band — samples stay 3-tuples for every existing
+    consumer — in the family's ``"exemplars"`` dict, keyed by
+    ``(sample_name, labels)``.  Designed as the exact inverse of
+    Registry.render(): render -> parse -> render_families is a fixed
+    point, so the cluster aggregator can merge scraped text without
+    dropping samples (or their exemplars)."""
     families: List[Dict] = []
     by_name: Dict[str, Dict] = {}
 
@@ -199,7 +263,8 @@ def parse_prometheus_text(text: str) -> List[Dict]:
             continue
         if line.startswith("#"):
             continue
-        # sample line: name[{labels}] value
+        # sample line: name[{labels}] value [# {exemplar} v ts]
+        line, exemplar = _split_exemplar(line)
         brace = line.find("{")
         if brace >= 0:
             close = line.rfind("}")
@@ -212,8 +277,11 @@ def parse_prometheus_text(text: str) -> List[Dict]:
             sample_name, _, value_text = line.partition(" ")
             labels = ()
             value = _parse_value(value_text)
-        family_for_sample(sample_name)["samples"].append(
-            (sample_name, labels, value))
+        fam = family_for_sample(sample_name)
+        fam["samples"].append((sample_name, labels, value))
+        if exemplar is not None:
+            fam.setdefault("exemplars", {})[(sample_name, labels)] = \
+                exemplar
     return families
 
 
@@ -225,13 +293,18 @@ def render_families(families: List[Dict]) -> str:
     for fam in families:
         lines.append(f"# HELP {fam['name']} {_escape_help(fam['help'])}")
         lines.append(f"# TYPE {fam['name']} {fam['kind']}")
+        exemplars = fam.get("exemplars") or {}
         for sample_name, labels, value in fam["samples"]:
             if labels:
                 body = ",".join(
                     f'{k}="{_escape_label_value(v)}"' for k, v in labels)
-                lines.append(f"{sample_name}{{{body}}} {_fmt_value(value)}")
+                line = f"{sample_name}{{{body}}} {_fmt_value(value)}"
             else:
-                lines.append(f"{sample_name} {_fmt_value(value)}")
+                line = f"{sample_name} {_fmt_value(value)}"
+            ex = exemplars.get((sample_name, labels))
+            if ex is not None:
+                line += _fmt_exemplar(*ex)
+            lines.append(line)
     return "\n".join(lines) + "\n"
 
 
@@ -326,8 +399,12 @@ class Histogram(_Metric):
         self._counts: Dict[tuple, List[int]] = {}
         self._sums: Dict[tuple, float] = {}
         self._totals: Dict[tuple, int] = {}
+        # label_values -> bucket index -> (labels, value, ts); index
+        # len(self.buckets) is the +Inf bucket. Newest observation wins.
+        self._exemplars: Dict[tuple, Dict[int, tuple]] = {}
 
-    def observe(self, value: float, *label_values):
+    def observe(self, value: float, *label_values,
+                trace_id: Optional[str] = None):
         with self._lock:
             counts = self._counts.setdefault(
                 label_values, [0] * len(self.buckets))
@@ -338,22 +415,53 @@ class Histogram(_Metric):
                 self._sums.get(label_values, 0.0) + value
             self._totals[label_values] = \
                 self._totals.get(label_values, 0) + 1
+            if trace_id:
+                # one exemplar per bucket, newest wins: a p99 outlier
+                # lands in a top bucket and stays referable until a
+                # slower request replaces it
+                self._exemplars.setdefault(label_values, {})[i] = (
+                    (("trace_id", str(trace_id)),), float(value),
+                    time.time())
+
+    def set_buckets(self, counts, total: int, sum_value: float,
+                    *label_values):
+        """Snapshot-mirror a histogram maintained elsewhere (the native
+        read plane keeps per-bucket atomics): ``counts`` are
+        NON-cumulative per-bucket counts aligned with ``self.buckets``
+        (any overflow beyond the last bound is implied by ``total``),
+        plus the observation count and value sum."""
+        with self._lock:
+            store = [0] * len(self.buckets)
+            for i, c in enumerate(counts[:len(store)]):
+                store[i] = int(c)
+            self._counts[label_values] = store
+            self._totals[label_values] = int(total)
+            self._sums[label_values] = float(sum_value)
 
     def render(self) -> List[str]:
         out = self.header()
         with self._lock:
             for lv in sorted(self._counts):
+                ex_map = self._exemplars.get(lv, {})
                 cumulative = 0
-                for bound, c in zip(self.buckets, self._counts[lv]):
+                for i, (bound, c) in enumerate(
+                        zip(self.buckets, self._counts[lv])):
                     cumulative += c
                     labels = _fmt_labels(
                         self.label_names + ("le",),
                         lv + (f"{bound:g}",))
-                    out.append(f"{self.name}_bucket{labels} {cumulative}")
+                    line = f"{self.name}_bucket{labels} {cumulative}"
+                    ex = ex_map.get(i)
+                    if ex is not None:
+                        line += _fmt_exemplar(*ex)
+                    out.append(line)
                 labels = _fmt_labels(self.label_names + ("le",),
                                      lv + ("+Inf",))
-                out.append(
-                    f"{self.name}_bucket{labels} {self._totals[lv]}")
+                line = f"{self.name}_bucket{labels} {self._totals[lv]}"
+                ex = ex_map.get(len(self.buckets))
+                if ex is not None:
+                    line += _fmt_exemplar(*ex)
+                out.append(line)
                 base = _fmt_labels(self.label_names, lv)
                 out.append(f"{self.name}_sum{base} "
                            f"{_fmt_value(self._sums[lv])}")
@@ -722,6 +830,65 @@ def observe_scrub(snap: Dict):
         VOLUME_EC_SCRUB_COUNTER.set_total(snap.get(kind, 0), kind)
     VOLUME_EC_SCRUB_MBPS_GAUGE.set(snap.get("last_pass_mbps", 0.0))
     VOLUME_EC_SCRUB_LAST_PASS_GAUGE.set(snap.get("last_pass_at", 0.0))
+
+
+# -- native read plane telemetry (server/native_plane.py via observe_plane) --
+
+# Mirror of kLatBoundsUs in server/native/http_plane.cc, in seconds.
+# test_observability pins this against swhp_lat_bounds so the two can
+# never drift silently.
+PLANE_LAT_BUCKETS_S = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                       0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0, 5.0)
+
+PLANE_REQUEST_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_request_total",
+    "Native-plane requests by status class (1xx..5xx).",
+    labels=("class",))
+PLANE_BYTES_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_bytes_total",
+    "Bytes written to sockets by the native plane (headers + bodies).")
+PLANE_EVENT_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_events_total",
+    "Native-plane off-fast-path events by kind (redirects to the "
+    "Python server, index misses).",
+    labels=("kind",))
+PLANE_REQUEST_HISTOGRAM = VOLUME_SERVER_GATHER.histogram(
+    "SeaweedFS_volumeServer_plane_request_seconds",
+    "Bucketed latency of native-plane requests, measured request-parse "
+    "to response-written inside the C++ plane.",
+    buckets=PLANE_LAT_BUCKETS_S)
+PLANE_SLOW_RING_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_plane_slow_ring_depth",
+    "Entries currently held in the native slow-request ring "
+    "(GET /admin/plane/slow; threshold SW_PLANE_SLOW_US).")
+PLANE_BUILD_FAILED_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_plane_build_failed",
+    "1 if the one-time g++ build of the native plane failed and reads "
+    "fell back to the Python path (stderr logged at warning).")
+
+
+def observe_plane(snap: Optional[Dict], slow_depth: int = 0,
+                  build_failed: bool = False):
+    """Mirror one native-plane stats snapshot (NativeReadPlane.stats())
+    onto the volume registry; plane counters are process-monotonic so
+    set_total, and the native bucket counts snapshot-replace the
+    histogram via set_buckets."""
+    PLANE_BUILD_FAILED_GAUGE.set(1 if build_failed else 0)
+    if not snap:
+        return
+    for cls in ("1xx", "2xx", "3xx", "4xx", "5xx"):
+        PLANE_REQUEST_COUNTER.set_total(
+            snap.get(f"status_{cls}", 0), cls)
+    PLANE_BYTES_COUNTER.set_total(snap.get("bytes_sent", 0))
+    PLANE_EVENT_COUNTER.set_total(snap.get("redirects", 0), "redirect")
+    PLANE_EVENT_COUNTER.set_total(
+        snap.get("index_misses", 0), "index_miss")
+    buckets = snap.get("buckets") or ()
+    PLANE_REQUEST_HISTOGRAM.set_buckets(
+        [c for _bound, c in buckets[:len(PLANE_LAT_BUCKETS_S)]],
+        snap.get("lat_count", 0),
+        snap.get("lat_sum_us", 0) / 1e6)
+    PLANE_SLOW_RING_GAUGE.set(slow_depth)
 
 
 # -- repair queue (stats/repair_queue.py via observe_repair_queue) -----------

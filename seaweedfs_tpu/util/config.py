@@ -145,6 +145,14 @@ _knob("SW_EC_HEALTH_ROUTING", "bool", False,
 # debug / tooling
 _knob("SW_PROFILE_DIR", "str", None,
       "Directory for jax.profiler traces; profiling is off when unset.")
+_knob("SW_PROFILE_MAX_S", "float", 30.0,
+      "Ceiling on POST /admin/profile?seconds=N sampling windows.")
+_knob("SW_PLANE_STATS", "bool", True,
+      "Native-plane telemetry (counters, latency histogram, slow ring); "
+      "0 removes even the clock reads from the fast path.")
+_knob("SW_PLANE_SLOW_US", "int", 10000,
+      "Native-plane requests at or above this many microseconds enter "
+      "the slow-request ring (GET /admin/plane/slow).")
 _knob("SW_LOCK_DEBUG", "bool", False,
       "Record the cross-thread lock-acquisition graph (util/locks.py) "
       "for deadlock detection; auto-on under pytest.")

@@ -83,7 +83,7 @@ class SamplingProfiler:
     sample (~10-50us), fine at the default 10ms period.
     """
 
-    def __init__(self, path: str, interval: float = 0.01):
+    def __init__(self, path: Optional[str], interval: float = 0.01):
         self.path = path
         self.interval = float(interval)
         self.counts: Dict[str, int] = {}
@@ -114,13 +114,32 @@ class SamplingProfiler:
                 key = ";".join(reversed(frames))
                 self.counts[key] = self.counts.get(key, 0) + 1
 
+    def report(self) -> str:
+        """Collapsed-stack text (``frame;frame;... count`` per line,
+        hottest first) from the samples gathered so far."""
+        return "".join(
+            f"{stack} {n}\n"
+            for stack, n in sorted(self.counts.items(),
+                                   key=lambda kv: -kv[1]))
+
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=5)
-        with open(self.path, "w") as out:
-            for stack, n in sorted(self.counts.items(),
-                                   key=lambda kv: -kv[1]):
-                out.write(f"{stack} {n}\n")
+        if self.path:
+            with open(self.path, "w") as out:
+                out.write(self.report())
+
+    @classmethod
+    def run_for(cls, seconds: float,
+                interval: float = 0.01) -> str:
+        """Sample every thread for ``seconds`` and return the collapsed
+        stacks — the `POST /admin/profile` path, no file involved."""
+        prof = cls(None, interval=interval).start()
+        try:
+            time.sleep(max(0.0, float(seconds)))
+        finally:
+            prof.stop()
+        return prof.report()
 
 
 class StageTimer:

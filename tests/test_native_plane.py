@@ -44,6 +44,19 @@ def assign_and_upload(master, data, filename="f.bin",
     return a["fid"], a["url"]
 
 
+def wait_until(pred, timeout=5.0, interval=0.01):
+    """Poll an asynchronously-updated condition. The plane records
+    telemetry AFTER the response bytes are on the wire (the timing spans
+    the full write), so a client can observe its reply before the
+    counters or the slow ring move."""
+    deadline = time.monotonic() + timeout
+    while True:
+        v = pred()
+        if v or time.monotonic() >= deadline:
+            return v
+        time.sleep(interval)
+
+
 def raw_get(hostport, path, headers=None, method="GET"):
     """Single-socket HTTP roundtrip WITHOUT redirect following, so
     the plane's own status codes are observable."""
@@ -314,6 +327,123 @@ class TestDirectVolume:
                       r'(\d+)', body)
         assert m, body[-500:]
         assert int(m.group(1)) >= before + 1
+
+
+class TestPlaneTelemetry:
+    """In-plane counters, latency histogram, and the slow-request ring
+    (ISSUE 14 native-plane telemetry)."""
+
+    def test_concurrent_counter_consistency(self, cluster):
+        """N threads of mixed traffic; the relaxed-atomic counters must
+        sum exactly — a lost update would silently skew the fleet
+        dashboards forever."""
+        import threading
+        master, vs = cluster
+        fids = [assign_and_upload(master, b"count-%d" % i)[0]
+                for i in range(8)]
+        base = vs.fast_plane.stats()
+        assert base is not None, "telemetry ABI missing"
+        n_threads, per_thread = 8, 50
+
+        def worker(tid):
+            for i in range(per_thread):
+                if i % 10 == 9:
+                    # query string -> off-fast-path 307 (status_3xx +
+                    # redirects both move)
+                    raw_get(vs.fast_url,
+                            f"/{fids[i % len(fids)]}?cm=false")
+                else:
+                    st, _, _ = raw_get(vs.fast_url,
+                                       "/" + fids[(tid + i) % len(fids)])
+                    assert st == 200
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(not t.is_alive() for t in threads)
+        total = n_threads * per_thread
+        redirects = n_threads * (per_thread // 10)
+        wait_until(lambda: vs.fast_plane.stats()["requests"]
+                   - base["requests"] >= total)
+        snap = vs.fast_plane.stats()
+        assert snap["requests"] - base["requests"] == total
+        assert snap["status_2xx"] - base["status_2xx"] == \
+            total - redirects
+        assert snap["status_3xx"] - base["status_3xx"] == redirects
+        assert snap["redirects"] - base["redirects"] == redirects
+        assert snap["lat_count"] - base["lat_count"] == total
+        # bucket counts are non-cumulative and must sum to lat_count
+        assert sum(c for _, c in snap["buckets"]) == snap["lat_count"]
+        assert snap["bytes_sent"] > base["bytes_sent"]
+
+    def test_stats_disabled_freezes_counters(self, cluster):
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"frozen")
+        vs.fast_plane.set_stats_enabled(False)
+        try:
+            base = vs.fast_plane.stats()
+            raw_get(vs.fast_url, f"/{fid}")
+            snap = vs.fast_plane.stats()
+            assert snap["requests"] == base["requests"]
+            assert snap["lat_count"] == base["lat_count"]
+        finally:
+            vs.fast_plane.set_stats_enabled(True)
+        raw_get(vs.fast_url, f"/{fid}")
+        assert wait_until(lambda: vs.fast_plane.stats()["requests"]
+                          > base["requests"])
+
+    def test_slow_ring_and_admin_endpoint(self, cluster):
+        """With the threshold floored, every request is 'slow': the
+        ring captures it and GET /admin/plane/slow serves it newest-
+        first through the Python server."""
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"slowpoke" * 16)
+        vs.fast_plane.set_slow_us(0)
+        try:
+            raw_get(vs.fast_url, f"/{fid}")
+            slow = wait_until(vs.fast_plane.slow_requests)
+            assert slow, "floored threshold captured nothing"
+            hit = next(e for e in slow if e["target"] == f"/{fid}")
+            assert hit["method"] == "GET"
+            assert hit["status"] == 200
+            assert hit["bytes"] > 0
+            assert hit["unix_ms"] > 0
+            view = get_json(f"http://{vs.url}/admin/plane/slow")
+            assert view["plane"] is True
+            assert any(e["target"] == f"/{fid}" for e in view["slow"])
+            assert view["stats"]["requests"] > 0
+        finally:
+            # restore the default so later tests don't churn the ring
+            vs.fast_plane.set_slow_us(10000)
+
+    def test_plane_families_exported_on_metrics(self, cluster):
+        import re
+        master, vs = cluster
+        fid, _ = assign_and_upload(master, b"famous")
+        base = vs.fast_plane.stats()["status_2xx"]
+        raw_get(vs.fast_url, f"/{fid}")
+        assert wait_until(lambda: vs.fast_plane.stats()["status_2xx"]
+                          > base)
+        body = raw_get(vs.url, "/metrics")[2].decode()
+        m = re.search(r'SeaweedFS_volumeServer_plane_request_total'
+                      r'\{class="2xx"\} (\d+)', body)
+        assert m and int(m.group(1)) >= 1, body[-800:]
+        assert "SeaweedFS_volumeServer_plane_request_seconds_bucket" \
+            in body
+        assert "SeaweedFS_volumeServer_plane_bytes_total" in body
+        # ^-anchored: the unanchored pattern would match the family's
+        # own HELP text ("1 if the one-time g++ build ... failed")
+        m = re.search(r'^SeaweedFS_volumeServer_plane_build_failed (\d)',
+                      body, re.M)
+        assert m and m.group(1) == "0"
+        # histogram totals mirror the native lat_count exactly
+        snap = vs.fast_plane.stats()
+        m = re.search(r'SeaweedFS_volumeServer_plane_request_seconds_'
+                      r'count (\d+)', body)
+        assert m and int(m.group(1)) <= snap["lat_count"]
 
 
 class TestHostileInput:

@@ -1,0 +1,284 @@
+"""Hot-path observability: exemplar-linked histograms (render/parse
+fixed point, aggregator newest-wins), the on-demand sampling-profiler
+endpoint (POST /admin/profile), and the native-plane latency-bucket
+contract between C++ and Python."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.stats.aggregate import ClusterMetricsAggregator
+from seaweedfs_tpu.stats.metrics import (PLANE_LAT_BUCKETS_S, Registry,
+                                         parse_prometheus_text,
+                                         render_families)
+from seaweedfs_tpu.util.profiling import SamplingProfiler
+
+
+class TestExemplars:
+    def _assert_fixed_point(self, text):
+        fams = parse_prometheus_text(text)
+        assert render_families(fams) == text
+        assert render_families(parse_prometheus_text(
+            render_families(fams))) == render_families(fams)
+
+    def test_observe_with_trace_id_renders_exemplar(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", "latency", labels=("op",),
+                        buckets=(0.01, 0.5, 2.0))
+        h.observe(0.25, "get", trace_id="ab" * 16)
+        h.observe(9.0, "get", trace_id="cd" * 16)
+        h.observe(0.001, "get")          # no exemplar on this bucket
+        text = r.render()
+        lines = text.splitlines()
+        b_025 = next(l for l in lines if 'le="0.5"' in l)
+        b_inf = next(l for l in lines if 'le="+Inf"' in l)
+        b_001 = next(l for l in lines if 'le="0.01"' in l)
+        assert f' # {{trace_id="{"ab" * 16}"}} 0.25 ' in b_025
+        assert f' # {{trace_id="{"cd" * 16}"}} 9 ' in b_inf
+        assert " # {" not in b_001
+        # _sum/_count never carry exemplars
+        assert " # {" not in next(l for l in lines if "_sum" in l)
+
+    def test_newest_observation_wins_per_bucket(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", buckets=(1.0,))
+        h.observe(0.5, trace_id="old0" * 8)
+        h.observe(0.7, trace_id="new1" * 8)
+        text = r.render()
+        assert 'trace_id="new1' in text
+        assert 'trace_id="old0' not in text
+
+    def test_render_parse_render_fixed_point(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", "latency", labels=("op",),
+                        buckets=(0.01, 0.5))
+        h.observe(0.25, "get", trace_id="12" * 16)
+        h.observe(5.0, "put", trace_id="34" * 16)
+        r.counter("req_total", labels=("op",)).inc("get")
+        text = r.render()
+        assert " # {" in text
+        self._assert_fixed_point(text)
+        # parsed exemplars surface out-of-band, samples stay 3-tuples
+        fams = parse_prometheus_text(text)
+        hist = next(f for f in fams if f["name"] == "lat_seconds")
+        assert hist["exemplars"]
+        assert all(len(s) == 3 for s in hist["samples"])
+
+    def test_fixed_point_without_exemplars_unchanged(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", buckets=(0.5,))
+        h.observe(0.25)
+        text = r.render()
+        assert " # {" not in text
+        self._assert_fixed_point(text)
+
+    def test_label_value_containing_hash_brace_not_split(self):
+        """A label VALUE containing ' # {' must not be mistaken for an
+        exemplar separator — the split point is after the closing
+        quote+brace of the label set."""
+        r = Registry()
+        c = r.counter("odd_total", labels=("q",))
+        c.inc("a # {weird} 1 2")
+        text = r.render()
+        fams = parse_prometheus_text(text)
+        (_, labels, value), = fams[-1]["samples"]
+        assert dict(labels)["q"] == "a # {weird} 1 2"
+        assert render_families(fams) == text
+
+    HIST_OLD = ("# TYPE lat_seconds histogram\n"
+                'lat_seconds_bucket{le="0.5"} 1 '
+                '# {trace_id="aaaa"} 0.25 100\n'
+                'lat_seconds_bucket{le="+Inf"} 2\n'
+                "lat_seconds_sum 5.25\nlat_seconds_count 2\n")
+    HIST_NEW = ("# TYPE lat_seconds histogram\n"
+                'lat_seconds_bucket{le="0.5"} 4 '
+                '# {trace_id="bbbb"} 0.3 200\n'
+                'lat_seconds_bucket{le="+Inf"} 4\n'
+                "lat_seconds_sum 0.75\nlat_seconds_count 4\n")
+
+    def test_aggregator_keeps_newest_exemplar(self):
+        texts = {"n1:1": self.HIST_OLD, "n2:2": self.HIST_NEW}
+        agg = ClusterMetricsAggregator(
+            lambda: list(texts), interval_s=60,
+            fetch=lambda url: texts[url])
+        assert agg.scrape_once() == 2
+        out = agg.render()
+        # counts merged bucket-wise, newest exemplar (ts 200) kept
+        assert 'lat_seconds_bucket{le="0.5"} 5' in out
+        assert 'trace_id="bbbb"' in out
+        assert 'trace_id="aaaa"' not in out
+        # the merged exposition still round-trips
+        assert render_families(parse_prometheus_text(out)) == out
+
+    def test_server_request_histogram_carries_trace_exemplar(
+            self, tmp_path):
+        """The router observes under the live server span, so every
+        request histogram bucket links to a replayable trace id that
+        /admin/traces/export resolves."""
+        import re
+        from seaweedfs_tpu.server.http_util import get_json, http_call
+        from seaweedfs_tpu.server.master import MasterServer
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        try:
+            get_json(f"http://{master.url}/dir/status")
+            text = http_call(
+                "GET", f"http://{master.url}/metrics").decode()
+            ids = re.findall(
+                r'SeaweedFS_master_request_seconds_bucket\{[^}]*\} \d+'
+                r' # \{trace_id="([0-9a-f]{32})"\}', text)
+            assert ids, "no exemplar on the master request histogram"
+            # the registry is process-global: exemplars observed by an
+            # earlier master in this process survive on the family, so
+            # require that at least one (the fresh one) resolves here
+            assert any(
+                get_json(f"http://{master.url}/admin/traces"
+                         f"?trace={tid}")["spans"]
+                for tid in ids), \
+                "no exemplar trace id resolved in this server's ring"
+        finally:
+            master.stop()
+
+
+class TestProfileEndpoint:
+    def _busy(self, stop):
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    def test_run_for_returns_collapsed_stacks(self):
+        stop = threading.Event()
+        t = threading.Thread(target=self._busy, args=(stop,),
+                             daemon=True, name="busy-beaver")
+        t.start()
+        try:
+            folded = SamplingProfiler.run_for(0.3, interval=0.005)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        lines = [ln for ln in folded.splitlines() if ln.strip()]
+        assert lines, "no samples collected"
+        # folded format: 'frame;frame;... count'
+        for ln in lines:
+            assert ln.rsplit(" ", 1)[1].isdigit()
+        assert any("_busy" in ln for ln in lines), folded[:500]
+
+    def test_admin_profile_endpoint(self, tmp_path):
+        from seaweedfs_tpu.server.http_util import HttpError, http_call
+        from seaweedfs_tpu.server.master import MasterServer
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        stop = threading.Event()
+        t = threading.Thread(target=self._busy, args=(stop,),
+                             daemon=True, name="busy-beaver")
+        t.start()
+        try:
+            folded = http_call(
+                "POST",
+                f"http://{master.url}/admin/profile?seconds=0.4"
+            ).decode()
+            lines = [ln for ln in folded.splitlines() if ln.strip()]
+            assert lines, "profile returned no stacks"
+            assert any("_busy" in ln for ln in lines), folded[:500]
+            with pytest.raises(HttpError) as ei:
+                http_call("POST", f"http://{master.url}/admin/profile"
+                                  f"?seconds=bogus")
+            assert ei.value.status == 400
+            with pytest.raises(HttpError) as ei:
+                http_call("POST", f"http://{master.url}/admin/profile"
+                                  f"?seconds=0")
+            assert ei.value.status == 400
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            master.stop()
+
+    def test_concurrent_profile_gets_409(self, tmp_path):
+        from seaweedfs_tpu.server import http_util
+        from seaweedfs_tpu.server.http_util import HttpError, http_call
+        from seaweedfs_tpu.server.master import MasterServer
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        try:
+            assert http_util._PROFILE_LOCK.acquire(blocking=False)
+            try:
+                with pytest.raises(HttpError) as ei:
+                    http_call("POST", f"http://{master.url}"
+                                      f"/admin/profile?seconds=0.1")
+                assert ei.value.status == 409
+            finally:
+                http_util._PROFILE_LOCK.release()
+        finally:
+            master.stop()
+
+    def test_cluster_profile_merges_all_nodes(self, tmp_path):
+        """Shell cluster.profile fans out serially (one profiler per
+        process — every server here shares this process) and merges
+        node-prefixed folded stacks from master + every volume server
+        into one file."""
+        import io
+        from seaweedfs_tpu.server.master import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.shell.command_env import (CommandEnv,
+                                                     run_command)
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        servers = [VolumeServer(
+            port=0, directories=[str(tmp_path / f"v{i}")],
+            master_url=master.url, pulse_seconds=1,
+            max_volume_counts=[4], ec_backend="numpy").start()
+            for i in range(2)]
+        stop = threading.Event()
+        t = threading.Thread(target=self._busy, args=(stop,),
+                             daemon=True, name="busy-beaver")
+        t.start()
+        try:
+            env = CommandEnv(master.url, out=io.StringIO())
+            deadline = time.monotonic() + 15
+            while len(env.cluster_nodes()) < 2 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert len(env.cluster_nodes()) == 2
+            out_path = str(tmp_path / "prof.folded")
+            run_command(env,
+                        f"cluster.profile -seconds 0.3 -o {out_path}")
+            summary = env.out.getvalue()
+            assert "3/3 nodes" in summary, summary
+            with open(out_path) as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.strip()]
+            assert lines
+            # every stack carries its node prefix; all 3 are present
+            nodes = {ln.split(";", 1)[0] for ln in lines}
+            assert nodes == {master.url, *(s.url for s in servers)}
+            for ln in lines:
+                assert ln.rsplit(" ", 1)[1].isdigit()
+            assert any("_busy" in ln for ln in lines)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            for s in servers:
+                s.stop()
+            master.stop()
+
+    def test_seconds_clamped_by_max_knob(self, monkeypatch):
+        """SW_PROFILE_MAX_S bounds the sampling window — an operator
+        typo must not pin a production server for an hour."""
+        monkeypatch.setenv("SW_PROFILE_MAX_S", "0.2")
+        from seaweedfs_tpu.server.http_util import http_call
+        from seaweedfs_tpu.server.master import MasterServer
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        try:
+            t0 = time.monotonic()
+            http_call("POST",
+                      f"http://{master.url}/admin/profile?seconds=3600")
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            master.stop()
+
+
+class TestPlaneBucketContract:
+    def test_python_mirror_matches_native_bounds(self):
+        from seaweedfs_tpu.server import native_plane
+        if not native_plane.available():
+            pytest.skip("libseaweed_http.so unavailable")
+        bounds_us = native_plane.lat_bounds_us()
+        assert bounds_us, "telemetry ABI missing from the built plane"
+        assert tuple(b / 1e6 for b in bounds_us) == \
+            pytest.approx(PLANE_LAT_BUCKETS_S)
