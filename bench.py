@@ -12,7 +12,9 @@ context under "e2e_tunnel" — on this sandbox it saturates the shared
 axon link (e2e_vs_link_bound=1.0), which is an environmental bound,
 not a kernel result. Fallback headlines are explicitly marked
 (headline_kind: cpu_e2e_device_unreachable / ..._failed_midrun /
-tpu_e2e_tunnel_bound).
+tpu_e2e_tunnel_bound). Pass --require-tpu to turn every CPU-fallback
+headline into a hard failure (exit 2) — for perf gates that must never
+record a CPU number as the run's result.
 
 Prints ONE JSON line:
   {"metric": "ec_encode_rs10_4_mbps", "value": <MB/s>, "unit": "MB/s",
@@ -873,7 +875,8 @@ def measure_cluster_degraded_read(n_needles: int = None,
         serving = next(s for s in servers if s.url not in holders and
                        s.store.find_ec_volume(vid) is not None)
 
-        def drill(fid_list, mode_note):
+        def drill(fid_list, mode_note, base_url=None):
+            base = base_url or serving.url
             lat, errs = [], []
             lock = threading.Lock()
 
@@ -886,7 +889,7 @@ def measure_cluster_degraded_read(n_needles: int = None,
                         t0 = time.perf_counter()
                         try:
                             got = http_call(
-                                "GET", f"http://{serving.url}/{fid}",
+                                "GET", f"http://{base}/{fid}",
                                 timeout=60)
                         except Exception as e:  # noqa: BLE001
                             with lock:
@@ -944,6 +947,66 @@ def measure_cluster_degraded_read(n_needles: int = None,
         # warm re-read: the slab LRU serves without another gather
         warm_p50, warm_p99, _ = drill(degraded_fids, "warm")
         warm = eng.snapshot()
+
+        # plane trial set: the same warm reads served entirely by the
+        # native plane's slab cache — 200 straight from C++, never the
+        # 307 hop back to Python
+        plane = {}
+        if serving.fast_plane is not None and \
+                serving.fast_plane.cache_stats() is not None:
+            import http.client as _hc
+
+            def plane_status(fid):
+                """One-shot GET without redirect following, so the
+                plane's own verdict (200 vs 307) is observable."""
+                host, port = serving.fast_url.rsplit(":", 1)
+                c = _hc.HTTPConnection(host, int(port), timeout=30)
+                try:
+                    c.request("GET", f"/{fid}")
+                    r = c.getresponse()
+                    r.read()
+                    return r.status
+                finally:
+                    c.close()
+
+            # warm the plane (a followed read re-publishes any slab
+            # evicted since the cold batch), then keep the fids it can
+            # serve end-to-end: fully covered by cached + local shards
+            for fid in degraded_fids:
+                http_call("GET", f"http://{serving.fast_url}/{fid}",
+                          timeout=60)
+            plane_fids = [f for f in degraded_fids
+                          if plane_status(f) == 200]
+            if plane_fids:
+                cbase = serving.fast_plane.cache_stats()
+                tele_base = serving.fast_plane.redirected
+                pw_p50, pw_p99, _ = drill(plane_fids, "plane-warm",
+                                          base_url=serving.fast_url)
+                csnap = serving.fast_plane.cache_stats()
+                n_reads = readers * rounds * len(plane_fids)
+                served_d = (csnap["degraded_served"]
+                            - cbase["degraded_served"])
+                plane = {
+                    "plane_fids": len(plane_fids),
+                    "plane_warm_p50_ms": round(pw_p50, 2),
+                    "plane_warm_p99_ms": round(pw_p99, 2),
+                    "plane_reads": n_reads,
+                    "plane_served": served_d,
+                    "plane_degraded_redirects": (
+                        csnap["degraded_redirected"]
+                        - cbase["degraded_redirected"]),
+                    # the acceptance triple: every read served in-plane,
+                    # zero hops back to Python, counter == reads exactly
+                    "plane_zero_redirect": bool(
+                        served_d == n_reads
+                        and csnap["degraded_redirected"]
+                        == cbase["degraded_redirected"]
+                        and serving.fast_plane.redirected == tele_base),
+                    "plane_speedup_vs_python_warm": round(
+                        warm_p99 / max(pw_p99, 1e-6), 2),
+                    "plane_beats_python_warm": bool(pw_p99 < warm_p99),
+                }
+
         out = {"servers": n_servers, "backend": backend,
                "needles": n_needles, "needle_kb": needle_kb,
                "degraded_needles": len(degraded_fids),
@@ -968,6 +1031,7 @@ def measure_cluster_degraded_read(n_needles: int = None,
                "warm_p99_ms": round(warm_p99, 2),
                "batched_beats_naive": bool(batch_wall < naive_wall
                                            and batch_p99 < naive_p99)}
+        out.update(plane)
         log(f"cluster degraded read: {out}")
         return out
     finally:
@@ -1531,6 +1595,12 @@ def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
 
 
 def main():
+    # --require-tpu: CI/perf-gate mode. The default behavior degrades to
+    # a clearly-labeled CPU line when the device tunnel is down, which
+    # is right for exploratory runs but lets a regression gate silently
+    # measure the wrong backend. With the flag, a CPU fallback is a
+    # hard failure instead.
+    require_tpu = "--require-tpu" in sys.argv[1:]
     dat_mb = config.env_int("SW_BENCH_DAT_MB")
     slab_mb = config.env_int("SW_BENCH_SLAB_MB")
     init_timeout = config.env_float("SW_BENCH_INIT_TIMEOUT")
@@ -1556,10 +1626,19 @@ def main():
                       "ok": devices is not None}]
         if devices is None:
             # device-free phases run while the tunnel gets more chances
-            # to come up; the retry window is spent, not slept away
-            late_secondary = secondary_configs(False, {})
+            # to come up; the retry window is spent, not slept away —
+            # except under --require-tpu, where a gate wants the
+            # verdict, not CPU-only side figures it would discard
+            late_secondary = {} if require_tpu \
+                else secondary_configs(False, {})
             devices = init_device_retrying(retry_log)
             if devices is None:
+                if require_tpu:
+                    log("FATAL: --require-tpu set but the device "
+                        f"backend never came up ({len(retry_log)} "
+                        "attempts); refusing to emit a CPU fallback "
+                        "line")
+                    raise SystemExit(2)
                 # the emitted line must never pass off the CPU number as
                 # a healthy TPU result: mark the condition explicitly
                 emit(cpu_mbps, 1.0, "cpu_e2e_device_unreachable",
@@ -1599,6 +1678,10 @@ def main():
                 # the headline rs(K,M) kernel (or the CPU denominator)
                 # failed — but keep whatever secondary geometries DID
                 # measure; they are paid-for device evidence
+                if require_tpu:
+                    log("FATAL: --require-tpu set but the headline "
+                        "device measurement failed after late init")
+                    raise SystemExit(2)
                 emit(cpu_mbps, 1.0, "cpu_e2e_device_failed_midrun",
                      note="device up on retry but the headline rs(10,4)"
                           " kernel measurement failed; value is the "
@@ -1646,6 +1729,10 @@ def main():
                           "chained-slope measured before it",
                      **secondary)
             else:
+                if require_tpu:
+                    log("FATAL: --require-tpu set but the TPU e2e "
+                        f"phase failed mid-run: {e!r:.120}")
+                    raise SystemExit(2)
                 emit(cpu_mbps, 1.0, "cpu_e2e_device_failed_midrun",
                      note=f"TPU bench failed mid-run ({e!r:.120}); "
                           "value is the native CPU e2e path",

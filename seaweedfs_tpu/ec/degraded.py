@@ -211,7 +211,7 @@ class DegradedReadEngine:
                  batch_ms: Optional[float] = None,
                  hedge_ms: Optional[float] = None,
                  readahead: Optional[int] = None,
-                 on_read=None):
+                 on_read=None, on_slabs=None):
         self.store = store
         self._locations = locations
         self._codec = codec
@@ -229,6 +229,12 @@ class DegradedReadEngine:
         self._ra_keys: set = set()
         self.size_cache = ShardSizeCache(timeout=degraded_read_timeout_s())
         self.on_read = on_read
+        # on_slabs(vid, sid, {slab_idx: bytes}) fires after every fresh
+        # reconstruction — the volume server publishes the slabs into
+        # the native plane's cache so the NEXT read of these bytes never
+        # leaves the plane. Invalidation is paired: everything that
+        # invalidates self.cache also invalidates the plane's copy.
+        self.on_slabs = on_slabs
         self._lock = make_lock("degraded.Engine._lock")
         self._batches: Dict[Tuple[int, int], _Batch] = {}
         self._latencies: deque = deque(maxlen=512)
@@ -448,6 +454,11 @@ class DegradedReadEngine:
             slabs = self._split(runs, out, shard_size)
             for idx, data in slabs.items():
                 self.cache.put((vid, sid, idx), data)
+            if self.on_slabs is not None:
+                try:
+                    self.on_slabs(vid, sid, slabs)
+                except Exception:
+                    pass  # publish is best-effort; the read must serve
 
             width = sum(w for _, w, _m in runs)
             with self._lock:

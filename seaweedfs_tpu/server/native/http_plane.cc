@@ -54,6 +54,7 @@
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <list>
 #include <memory>
 #include <mutex>
 #include <shared_mutex>
@@ -255,6 +256,88 @@ struct VolumeRec {
   }
 };
 
+// ------------------------------------------------------------ EC volumes
+// Mirror of an EC-mounted volume: the .ecx needle index (key ->
+// (dat offset, size)) plus the striping geometry (ec/locate.py) so a
+// needle's logical .dat range maps to (shard id, offset in shard file)
+// without Python in the loop. Locally-held data shards are read straight
+// from their files; a lost shard's bytes come from the reconstructed-slab
+// cache below — if every covering slab is resident the GET never leaves
+// the plane.
+constexpr int kDataShards = 10;   // ec/constants.py DATA_SHARDS
+constexpr int kMaxEcShards = 32;  // data+parity ceiling (codec max)
+
+struct EcVolumeRec {
+  int version = 3;
+  int64_t dat_size = 0;  // original .dat size (drives the row split)
+  int64_t large_block = 0, small_block = 0;
+  int64_t slab_bytes = 0;  // cache slab size (SW_EC_DEGRADED_SLAB_BYTES)
+  int shard_fds[kMaxEcShards];  // -1 = shard not local (lost or remote)
+  std::unordered_map<uint64_t, std::pair<uint64_t, uint32_t>> index;
+  mutable std::shared_mutex mu;  // guards index + shard_fds
+  EcVolumeRec() {
+    for (int i = 0; i < kMaxEcShards; i++) shard_fds[i] = -1;
+  }
+  ~EcVolumeRec() {
+    for (int i = 0; i < kMaxEcShards; i++)
+      if (shard_fds[i] >= 0) close(shard_fds[i]);
+  }
+};
+
+// encoder-exact large-row count (ec/locate.py n_large_rows_for)
+int64_t ec_n_large_rows(int64_t dat_size, int64_t large_block) {
+  if (dat_size <= 0) return 0;
+  return (dat_size - 1) / (large_block * kDataShards);
+}
+
+// ------------------------------------------------------------ slab cache
+// Byte-budgeted LRU of reconstructed slabs, keyed (vid, sid, slab index),
+// fed from Python (swhp_cache_put publishes what DegradedReadEngine just
+// reconstructed) and invalidated on mount/rebuild. One plain mutex guards
+// the map, the recency list AND the counters: the counters must be exact
+// (tests hammer put/invalidate under concurrent reads and assert totals),
+// and the critical sections are tiny — values are shared_ptrs, so readers
+// copy outside the lock and an invalidate can never tear an in-flight
+// read.
+struct SlabKey {
+  uint64_t vs;  // vid << 32 | sid
+  uint64_t idx;
+  bool operator==(const SlabKey& o) const {
+    return vs == o.vs && idx == o.idx;
+  }
+};
+struct SlabKeyHash {
+  size_t operator()(const SlabKey& k) const {
+    uint64_t x = (k.vs ^ (k.idx * 0x9E3779B97F4A7C15ull)) + k.idx;
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+    x ^= x >> 33;
+    return static_cast<size_t>(x);
+  }
+};
+
+struct SlabCache {
+  mutable std::mutex mu;
+  using Entry = std::pair<SlabKey, std::shared_ptr<std::vector<uint8_t>>>;
+  std::list<Entry> lru;  // MRU at front
+  std::unordered_map<SlabKey, std::list<Entry>::iterator, SlabKeyHash> map;
+  uint64_t max_bytes = 0;  // 0 = cache disabled
+  uint64_t bytes = 0;
+  uint64_t puts = 0, put_bytes = 0, hits = 0, misses = 0, evictions = 0,
+           invalidated = 0;
+
+  // callers hold mu
+  void evict_to_budget() {
+    while (bytes > max_bytes && !lru.empty()) {
+      Entry& tail = lru.back();
+      bytes -= tail.second->size();
+      map.erase(tail.first);
+      lru.pop_back();
+      evictions++;
+    }
+  }
+};
+
 // ------------------------------------------------------------- telemetry
 // Request telemetry for the hot path: plain relaxed atomics on the fast
 // path (one cache line of fetch_adds per request, no locks), a
@@ -331,11 +414,25 @@ struct Server {
   std::unordered_map<uint32_t, std::shared_ptr<VolumeRec>> vols;
   mutable std::shared_mutex vols_mu;
   PlaneStats stats;
+  std::unordered_map<uint32_t, std::shared_ptr<EcVolumeRec>> ec_vols;
+  mutable std::shared_mutex ec_mu;
+  SlabCache cache;
+  // EC serving outcomes, bumped BEFORE the response bytes leave (same
+  // rule as `served`): degraded = at least one lost-shard byte came from
+  // the slab cache; local = all shards were local files.
+  std::atomic<uint64_t> ec_degraded_served{0};
+  std::atomic<uint64_t> ec_degraded_redirected{0};
+  std::atomic<uint64_t> ec_local_served{0};
 
   std::shared_ptr<VolumeRec> find(uint32_t vid) const {
     std::shared_lock<std::shared_mutex> l(vols_mu);
     auto it = vols.find(vid);
     return it == vols.end() ? nullptr : it->second;
+  }
+  std::shared_ptr<EcVolumeRec> find_ec(uint32_t vid) const {
+    std::shared_lock<std::shared_mutex> l(ec_mu);
+    auto it = ec_vols.find(vid);
+    return it == ec_vols.end() ? nullptr : it->second;
   }
 };
 
@@ -628,11 +725,332 @@ void quote_escape(const std::string& in, std::string* out) {
   }
 }
 
+// Shared response tail for the plain and EC fast paths: parse + validate
+// the raw needle record and emit the HTTP response. Returns false when
+// the request must be redirected to Python instead (semantics beyond the
+// fast path; in `lenient` mode also any corruption/crc failure — the EC
+// path assembles bytes from cached reconstructions, so Python, not a
+// 500, stays authoritative when they don't check out). `also`, when
+// non-null, is bumped alongside `served` before every send so EC
+// outcome counters keep the same observer guarantee.
+bool respond_needle_blob(Server* s, int fd, const Request& req,
+                         uint32_t cookie, const uint8_t* blob, size_t blen,
+                         int version, uint32_t size, bool lenient,
+                         std::atomic<uint64_t>* also) {
+  ParsedNeedle n;
+  if (parse_needle(blob, blen, version, &n) != 0 || n.size != size) {
+    if (lenient) return false;
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error", "corrupt needle",
+                   req.keepalive);
+    return true;
+  }
+  if (n.cookie != cookie) {
+    respond_simple(fd, 404, "Not Found", "cookie mismatch", req.keepalive);
+    return true;
+  }
+  if (size > 0 && masked_crc(crc32c(n.data, n.data_size)) != n.checksum) {
+    if (lenient) return false;
+    s->errors++;
+    respond_simple(fd, 500, "Internal Server Error", "crc mismatch",
+                   req.keepalive);
+    return true;
+  }
+  // TTL expiry (volume.read_needle)
+  if ((n.flags & kFlagHasTtl) && (n.flags & kFlagHasLastModified)) {
+    int64_t mins = ttl_minutes(n.ttl_count, n.ttl_unit);
+    if (mins > 0 &&
+        time(nullptr) - n.last_modified > mins * 60) {
+      respond_simple(fd, 404, "Not Found", "needle expired", req.keepalive);
+      return true;
+    }
+  }
+  // semantics beyond the fast path live in Python
+  if (n.flags & (kFlagGzip | kFlagChunkManifest | kFlagHasPairs))
+    return false;
+  char etag[16];
+  snprintf(etag, sizeof etag, "%02x%02x%02x%02x", n.checksum >> 24 & 0xFF,
+           n.checksum >> 16 & 0xFF, n.checksum >> 8 & 0xFF,
+           n.checksum & 0xFF);
+  // Last-Modified + If-Modified-Since, checked before the etag
+  // (reference volume_server_handlers_read.go:99-109)
+  std::string lm_header;
+  if ((n.flags & kFlagHasLastModified) && n.last_modified > 0) {
+    char buf[64];
+    time_t t = static_cast<time_t>(n.last_modified);
+    struct tm tmv;
+    gmtime_r(&t, &tmv);
+    strftime(buf, sizeof buf, "%a, %d %b %Y %H:%M:%S GMT", &tmv);
+    lm_header = buf;
+    if (!req.if_modified_since.empty()) {
+      struct tm ims{};
+      if (strptime(req.if_modified_since.c_str(),
+                   "%a, %d %b %Y %H:%M:%S GMT", &ims) != nullptr) {
+        if (timegm(&ims) >= n.last_modified) {
+          std::string hdr = "Last-Modified: " + lm_header +
+                            "\r\nEtag: \"" + etag + "\"\r\n";
+          // counters bump BEFORE the response bytes leave: an observer
+          // that has received the response must see the count (a
+          // post-send bump races clients on a loaded single-core host)
+          s->served++;
+          if (also) (*also)++;
+          respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
+                         "application/octet-stream");
+          return true;
+        }
+      }
+    }
+  }
+  // conditional GET (RFC7232 comma list, weak validators, "*")
+  if (!req.if_none_match.empty()) {
+    std::string quoted = std::string("\"") + etag + "\"";
+    std::string inm = req.if_none_match;
+    bool match = false;
+    size_t pos = 0;
+    while (pos <= inm.size()) {
+      size_t comma = inm.find(',', pos);
+      std::string c = inm.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      // trim + strip weak prefix
+      size_t b = c.find_first_not_of(" \t");
+      size_t e = c.find_last_not_of(" \t");
+      if (b != std::string::npos) {
+        c = c.substr(b, e - b + 1);
+        if (c.compare(0, 2, "W/") == 0) c = c.substr(2);
+        if (c == "*" || c == quoted) {
+          match = true;
+          break;
+        }
+      }
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+    if (match) {
+      // header set mirrors the Python 304 (Etag + default octet-stream)
+      std::string hdr = "Etag: " + quoted + "\r\n";
+      s->served++;  // before the send — see the IMS 304 comment
+      if (also) (*also)++;
+      respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
+                     "application/octet-stream");
+      return true;
+    }
+  }
+  const char* ctype = "application/octet-stream";
+  std::string mime_hold;
+  if ((n.flags & kFlagHasMime) && !n.mime.empty()) {
+    mime_hold = n.mime;
+    ctype = mime_hold.c_str();
+  }
+  // image resize queries never reach here (any '?' redirects), so a
+  // plain GET of an image serves stored bytes — same as Python with no
+  // width/height args.
+  const uint8_t* body = n.data;
+  int64_t total = n.data_size;
+  int64_t start = 0, length = total;
+  bool ranged = false;
+  if (!req.range.empty()) {
+    if (parse_range_header(req.range, total, &start, &length)) {
+      ranged = true;
+    } else if (req.range.compare(0, 6, "bytes=") == 0) {
+      // unsatisfiable/multi range: Python answers 416 for bad single
+      // ranges; multi-ranges fall through to full body there. Redirect
+      // so every edge keeps one source of truth.
+      return false;
+    }
+  }
+  std::string head;
+  head.reserve(512);
+  head += ranged ? "HTTP/1.1 206 Partial Content\r\n" : "HTTP/1.1 200 OK\r\n";
+  head += "Content-Length: " + std::to_string(length) + "\r\n";
+  head += "Content-Type: ";
+  head += ctype;
+  head += "\r\nEtag: \"";
+  head += etag;
+  head += "\"\r\nAccept-Ranges: bytes\r\n";
+  if (!lm_header.empty())
+    head += "Last-Modified: " + lm_header + "\r\n";
+  if (n.flags & kFlagHasName) {
+    std::string esc;
+    quote_escape(n.name, &esc);
+    head += "Content-Disposition: inline; filename=\"" + esc + "\"\r\n";
+  }
+  if (ranged)
+    head += "Content-Range: bytes " + std::to_string(start) + "-" +
+            std::to_string(start + length - 1) + "/" +
+            std::to_string(total) + "\r\n";
+  head += req.keepalive ? "Connection: keep-alive\r\n\r\n"
+                        : "Connection: close\r\n\r\n";
+  s->served++;  // before the send — see the IMS 304 comment
+  if (also) (*also)++;
+  tl_status = ranged ? 206 : 200;
+  if (req.method == "HEAD") {
+    send_all(fd, head.data(), head.size());
+  } else {
+    tl_bytes += static_cast<uint64_t>(length);
+    send_two(fd, head.data(), head.size(), body + start,
+             static_cast<size_t>(length));
+  }
+  return true;
+}
+
+// Copies [shard_off, shard_off+take) of a LOST shard's byte stream out of
+// the slab cache into dst. Every covering slab must be resident; a slab
+// shorter than the logical slab size (shard tail) leaves dst's zero-fill
+// in place, mirroring the Python engine's zero-padding. Hit/miss counts
+// are per-slab-lookup and exact (under the cache mutex).
+bool copy_from_cache(Server* s, uint32_t vid, int sid, int64_t slab,
+                     int64_t shard_off, int64_t take, uint8_t* dst) {
+  if (slab <= 0) return false;
+  uint64_t vs = static_cast<uint64_t>(vid) << 32 |
+                static_cast<uint32_t>(sid);
+  int64_t lo = shard_off, hi = shard_off + take;
+  for (int64_t idx = lo / slab; idx * slab < hi; idx++) {
+    std::shared_ptr<std::vector<uint8_t>> data;
+    {
+      std::lock_guard<std::mutex> g(s->cache.mu);
+      auto it = s->cache.map.find(
+          SlabKey{vs, static_cast<uint64_t>(idx)});
+      if (it == s->cache.map.end()) {
+        s->cache.misses++;
+        return false;
+      }
+      s->cache.hits++;
+      s->cache.lru.splice(s->cache.lru.begin(), s->cache.lru, it->second);
+      data = it->second->second;
+    }
+    int64_t s_lo = std::max(lo, idx * slab);
+    int64_t s_hi = std::min(hi, (idx + 1) * slab);
+    int64_t in_lo = s_lo - idx * slab;
+    int64_t in_hi = s_hi - idx * slab;
+    int64_t avail = std::min<int64_t>(
+        in_hi, static_cast<int64_t>(data->size()));
+    if (avail > in_lo)
+      memcpy(dst + (s_lo - lo), data->data() + in_lo,
+             static_cast<size_t>(avail - in_lo));
+  }
+  return true;
+}
+
+// In-plane EC needle GET. Walks the needle's logical .dat range through
+// the striping math (exact mirror of ec/locate.py: encoder-derived large
+// row count, row-major block walk, large->small rollover), reading local
+// shards via pread and lost shards from the slab cache. Any gap — index
+// miss, unregistered shard with no resident slabs, oversize, validation
+// failure — redirects to Python exactly as before this path existed.
+// Adds NO clock reads: timing stays in handle_conn behind the stats
+// gate.
+void serve_ec_needle(Server* s, int fd, const Request& req,
+                     const std::shared_ptr<EcVolumeRec>& ev, uint32_t vid,
+                     uint64_t key, uint32_t cookie) {
+  uint64_t offset;
+  uint32_t size;
+  {
+    std::shared_lock<std::shared_mutex> l(ev->mu);
+    auto it = ev->index.find(key);
+    if (it == ev->index.end() || it->second.second == kTombstoneSize) {
+      // mirror semantics match the plain path: Python's .ecx is
+      // authoritative for misses/tombstones (404 vs re-sync window)
+      l.unlock();
+      s->stats.index_misses.fetch_add(1, std::memory_order_relaxed);
+      redirect_to_fallback(s, fd, req);
+      return;
+    }
+    offset = it->second.first;
+    size = it->second.second;
+  }
+  int64_t want = actual_size(size, ev->version);
+  if (want > s->max_fastpath_bytes ||
+      static_cast<int64_t>(offset) + want > ev->dat_size) {
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  std::vector<uint8_t> blob(static_cast<size_t>(want), 0);
+  int64_t large_row = ev->large_block * kDataShards;
+  int64_t n_large = ec_n_large_rows(ev->dat_size, ev->large_block);
+  int64_t block_index, inner;
+  bool is_large;
+  if (static_cast<int64_t>(offset) < n_large * large_row) {
+    block_index = static_cast<int64_t>(offset) / ev->large_block;
+    is_large = true;
+    inner = static_cast<int64_t>(offset) % ev->large_block;
+  } else {
+    int64_t off2 = static_cast<int64_t>(offset) - n_large * large_row;
+    block_index = off2 / ev->small_block;
+    is_large = false;
+    inner = off2 % ev->small_block;
+  }
+  bool used_cache = false;
+  bool cache_gap = false;  // lost shard whose slabs weren't resident
+  bool ok = true;
+  int64_t pos = 0, remaining = want;
+  {
+    // shared lock across the assembly: swhp_ec_set_shard swaps fds under
+    // the unique lock, so no pread can race a close
+    std::shared_lock<std::shared_mutex> l(ev->mu);
+    while (remaining > 0) {
+      int64_t blk = is_large ? ev->large_block : ev->small_block;
+      int64_t take = std::min(remaining, blk - inner);
+      int sid = static_cast<int>(block_index % kDataShards);
+      int64_t row = block_index / kDataShards;
+      int64_t shard_off =
+          inner + (is_large ? row * ev->large_block
+                            : n_large * ev->large_block +
+                                  row * ev->small_block);
+      int sfd = ev->shard_fds[sid];
+      if (sfd >= 0) {
+        // a short read past the shard tail leaves the zero-fill, same
+        // as the engine's zero-padded slab pieces
+        if (pread(sfd, blob.data() + pos, static_cast<size_t>(take),
+                  static_cast<off_t>(shard_off)) < 0) {
+          ok = false;
+          break;
+        }
+      } else {
+        if (!copy_from_cache(s, vid, sid, ev->slab_bytes, shard_off, take,
+                             blob.data() + pos)) {
+          ok = false;
+          cache_gap = true;
+          break;
+        }
+        used_cache = true;
+      }
+      pos += take;
+      remaining -= take;
+      if (remaining <= 0) break;
+      block_index++;
+      if (is_large && block_index == n_large * kDataShards) {
+        is_large = false;
+        block_index = 0;
+      }
+      inner = 0;
+    }
+  }
+  if (!ok) {
+    if (cache_gap)
+      s->ec_degraded_redirected.fetch_add(1, std::memory_order_relaxed);
+    redirect_to_fallback(s, fd, req);
+    return;
+  }
+  std::atomic<uint64_t>* outcome =
+      used_cache ? &s->ec_degraded_served : &s->ec_local_served;
+  if (!respond_needle_blob(s, fd, req, cookie, blob.data(), blob.size(),
+                           ev->version, size, /*lenient=*/true, outcome)) {
+    if (used_cache)
+      s->ec_degraded_redirected.fetch_add(1, std::memory_order_relaxed);
+    redirect_to_fallback(s, fd, req);
+  }
+}
+
 void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
                   uint64_t key, uint32_t cookie) {
   auto vol = s->find(vid);
   if (!vol) {
-    redirect_to_fallback(s, fd, req);  // EC / remote / replica logic
+    auto ev = s->find_ec(vid);
+    if (ev) {
+      serve_ec_needle(s, fd, req, ev, vid, key, cookie);
+      return;
+    }
+    redirect_to_fallback(s, fd, req);  // remote / replica logic
     return;
   }
   uint64_t offset;
@@ -669,158 +1087,10 @@ void serve_needle(Server* s, int fd, const Request& req, uint32_t vid,
                    req.keepalive);
     return;
   }
-  ParsedNeedle n;
-  if (parse_needle(blob.data(), blob.size(), vol->version, &n) != 0 ||
-      n.size != size) {
-    s->errors++;
-    respond_simple(fd, 500, "Internal Server Error", "corrupt needle",
-                   req.keepalive);
-    return;
-  }
-  if (n.cookie != cookie) {
-    respond_simple(fd, 404, "Not Found", "cookie mismatch", req.keepalive);
-    return;
-  }
-  if (size > 0 && masked_crc(crc32c(n.data, n.data_size)) != n.checksum) {
-    s->errors++;
-    respond_simple(fd, 500, "Internal Server Error", "crc mismatch",
-                   req.keepalive);
-    return;
-  }
-  // TTL expiry (volume.read_needle)
-  if ((n.flags & kFlagHasTtl) && (n.flags & kFlagHasLastModified)) {
-    int64_t mins = ttl_minutes(n.ttl_count, n.ttl_unit);
-    if (mins > 0 &&
-        time(nullptr) - n.last_modified > mins * 60) {
-      respond_simple(fd, 404, "Not Found", "needle expired", req.keepalive);
-      return;
-    }
-  }
-  // semantics beyond the fast path live in Python
-  if (n.flags & (kFlagGzip | kFlagChunkManifest | kFlagHasPairs)) {
+  if (!respond_needle_blob(s, fd, req, cookie, blob.data(), blob.size(),
+                           vol->version, size, /*lenient=*/false,
+                           nullptr))
     redirect_to_fallback(s, fd, req);
-    return;
-  }
-  char etag[16];
-  snprintf(etag, sizeof etag, "%02x%02x%02x%02x", n.checksum >> 24 & 0xFF,
-           n.checksum >> 16 & 0xFF, n.checksum >> 8 & 0xFF,
-           n.checksum & 0xFF);
-  // Last-Modified + If-Modified-Since, checked before the etag
-  // (reference volume_server_handlers_read.go:99-109)
-  std::string lm_header;
-  if ((n.flags & kFlagHasLastModified) && n.last_modified > 0) {
-    char buf[64];
-    time_t t = static_cast<time_t>(n.last_modified);
-    struct tm tmv;
-    gmtime_r(&t, &tmv);
-    strftime(buf, sizeof buf, "%a, %d %b %Y %H:%M:%S GMT", &tmv);
-    lm_header = buf;
-    if (!req.if_modified_since.empty()) {
-      struct tm ims{};
-      if (strptime(req.if_modified_since.c_str(),
-                   "%a, %d %b %Y %H:%M:%S GMT", &ims) != nullptr) {
-        if (timegm(&ims) >= n.last_modified) {
-          std::string hdr = "Last-Modified: " + lm_header +
-                            "\r\nEtag: \"" + etag + "\"\r\n";
-          // counters bump BEFORE the response bytes leave: an observer
-          // that has received the response must see the count (a
-          // post-send bump races clients on a loaded single-core host)
-          s->served++;
-          respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
-                         "application/octet-stream");
-          return;
-        }
-      }
-    }
-  }
-  // conditional GET (RFC7232 comma list, weak validators, "*")
-  if (!req.if_none_match.empty()) {
-    std::string quoted = std::string("\"") + etag + "\"";
-    std::string inm = req.if_none_match;
-    bool match = false;
-    size_t pos = 0;
-    while (pos <= inm.size()) {
-      size_t comma = inm.find(',', pos);
-      std::string c = inm.substr(
-          pos, comma == std::string::npos ? std::string::npos : comma - pos);
-      // trim + strip weak prefix
-      size_t b = c.find_first_not_of(" \t");
-      size_t e = c.find_last_not_of(" \t");
-      if (b != std::string::npos) {
-        c = c.substr(b, e - b + 1);
-        if (c.compare(0, 2, "W/") == 0) c = c.substr(2);
-        if (c == "*" || c == quoted) {
-          match = true;
-          break;
-        }
-      }
-      if (comma == std::string::npos) break;
-      pos = comma + 1;
-    }
-    if (match) {
-      // header set mirrors the Python 304 (Etag + default octet-stream)
-      std::string hdr = "Etag: " + quoted + "\r\n";
-      s->served++;  // before the send — see the IMS 304 comment
-      respond_simple(fd, 304, "Not Modified", "", req.keepalive, hdr,
-                     "application/octet-stream");
-      return;
-    }
-  }
-  const char* ctype = "application/octet-stream";
-  std::string mime_hold;
-  if ((n.flags & kFlagHasMime) && !n.mime.empty()) {
-    mime_hold = n.mime;
-    ctype = mime_hold.c_str();
-  }
-  // image resize queries never reach here (any '?' redirects), so a
-  // plain GET of an image serves stored bytes — same as Python with no
-  // width/height args.
-  const uint8_t* body = n.data;
-  int64_t total = n.data_size;
-  int64_t start = 0, length = total;
-  bool ranged = false;
-  if (!req.range.empty()) {
-    if (parse_range_header(req.range, total, &start, &length)) {
-      ranged = true;
-    } else if (req.range.compare(0, 6, "bytes=") == 0) {
-      // unsatisfiable/multi range: Python answers 416 for bad single
-      // ranges; multi-ranges fall through to full body there. Redirect
-      // so every edge keeps one source of truth.
-      redirect_to_fallback(s, fd, req);
-      return;
-    }
-  }
-  std::string head;
-  head.reserve(512);
-  head += ranged ? "HTTP/1.1 206 Partial Content\r\n" : "HTTP/1.1 200 OK\r\n";
-  head += "Content-Length: " + std::to_string(length) + "\r\n";
-  head += "Content-Type: ";
-  head += ctype;
-  head += "\r\nEtag: \"";
-  head += etag;
-  head += "\"\r\nAccept-Ranges: bytes\r\n";
-  if (!lm_header.empty())
-    head += "Last-Modified: " + lm_header + "\r\n";
-  if (n.flags & kFlagHasName) {
-    std::string esc;
-    quote_escape(n.name, &esc);
-    head += "Content-Disposition: inline; filename=\"" + esc + "\"\r\n";
-  }
-  if (ranged)
-    head += "Content-Range: bytes " + std::to_string(start) + "-" +
-            std::to_string(start + length - 1) + "/" +
-            std::to_string(total) + "\r\n";
-  head += req.keepalive ? "Connection: keep-alive\r\n\r\n"
-                        : "Connection: close\r\n\r\n";
-  s->served++;  // before the send — see the IMS 304 comment
-  tl_status = ranged ? 206 : 200;
-  if (req.method == "HEAD") {
-    send_all(fd, head.data(), head.size());
-  } else {
-    tl_bytes += static_cast<uint64_t>(length);
-    send_two(fd, head.data(), head.size(), body + start,
-             static_cast<size_t>(length));
-  }
 }
 
 // ----------------------------------------------------------------- write
@@ -1758,6 +2028,190 @@ int swhp_slow_ring(void* h, char* buf, int buflen) {
   memcpy(buf, out.data(), out.size());
   buf[out.size()] = '\0';
   return static_cast<int>(out.size());
+}
+
+// ---- EC volumes + reconstructed-slab cache -----------------------------
+
+// Registers (or re-registers after a mount change) an EC volume's
+// striping geometry. The index starts empty — push .ecx entries with
+// swhp_ec_put_bulk, attach local shard files with swhp_ec_set_shard.
+// dat_size is the ORIGINAL .dat size (drives the encoder-exact
+// large/small row split); slab_bytes must equal the Python engine's
+// SW_EC_DEGRADED_SLAB_BYTES or cached slabs will be mis-addressed.
+int swhp_ec_register(void* h, uint32_t vid, int version, int64_t dat_size,
+                     int64_t large_block, int64_t small_block,
+                     int64_t slab_bytes) {
+  if (!h || dat_size <= 0 || large_block <= 0 || small_block <= 0 ||
+      slab_bytes <= 0)
+    return -1;
+  Server* s = static_cast<Server*>(h);
+  auto rec = std::make_shared<EcVolumeRec>();
+  rec->version = version;
+  rec->dat_size = dat_size;
+  rec->large_block = large_block;
+  rec->small_block = small_block;
+  rec->slab_bytes = slab_bytes;
+  std::unique_lock<std::shared_mutex> l(s->ec_mu);
+  s->ec_vols[vid] = std::move(rec);
+  return 0;
+}
+
+// Attaches (path non-empty) or detaches (path null/empty) a local shard
+// file. A detached data shard is "lost" from the plane's viewpoint: its
+// bytes must come from the slab cache or the request redirects.
+int swhp_ec_set_shard(void* h, uint32_t vid, int sid,
+                      const char* shard_path) {
+  if (sid < 0 || sid >= kMaxEcShards) return -1;
+  Server* s = static_cast<Server*>(h);
+  auto ev = s->find_ec(vid);
+  if (!ev) return -1;
+  int fd = -1;
+  if (shard_path && *shard_path) {
+    fd = open(shard_path, O_RDONLY);
+    if (fd < 0) return -1;
+  }
+  std::unique_lock<std::shared_mutex> l(ev->mu);
+  if (ev->shard_fds[sid] >= 0) close(ev->shard_fds[sid]);
+  ev->shard_fds[sid] = fd;
+  return 0;
+}
+
+// Bulk .ecx index push: parallel arrays of key / BYTE offset in the
+// logical .dat / size. Assign (not insert-only): the EC index mirrors a
+// point-in-time .ecx snapshot taken under Python's ecx lock, and
+// tombstones are pushed as kTombstoneSize entries rather than omitted.
+int swhp_ec_put_bulk(void* h, uint32_t vid, const uint64_t* keys,
+                     const uint64_t* offsets, const uint32_t* sizes,
+                     int64_t count) {
+  Server* s = static_cast<Server*>(h);
+  auto ev = s->find_ec(vid);
+  if (!ev) return -1;
+  std::unique_lock<std::shared_mutex> l(ev->mu);
+  ev->index.reserve(ev->index.size() + static_cast<size_t>(count));
+  for (int64_t i = 0; i < count; i++)
+    ev->index[keys[i]] = {offsets[i], sizes[i]};
+  return 0;
+}
+
+// Mirrors an EC delete: tombstone (not erase), matching the in-place
+// .ecx tombstone Python just wrote.
+int swhp_ec_delete(void* h, uint32_t vid, uint64_t key) {
+  Server* s = static_cast<Server*>(h);
+  auto ev = s->find_ec(vid);
+  if (!ev) return -1;
+  std::unique_lock<std::shared_mutex> l(ev->mu);
+  auto it = ev->index.find(key);
+  if (it != ev->index.end()) it->second.second = kTombstoneSize;
+  return 0;
+}
+
+uint64_t swhp_cache_invalidate(void* h, uint32_t vid, int sid);
+
+int swhp_ec_unregister(void* h, uint32_t vid) {
+  Server* s = static_cast<Server*>(h);
+  {
+    std::unique_lock<std::shared_mutex> l(s->ec_mu);
+    if (!s->ec_vols.erase(vid)) return -1;
+  }
+  // defense in depth: Python invalidates explicitly on mount/rebuild,
+  // but a dropped registration must never strand stale slabs either
+  swhp_cache_invalidate(h, vid, -1);
+  return 0;
+}
+
+// Sets the cache byte budget (SW_PLANE_CACHE_BYTES); shrinking evicts
+// down immediately. 0 disables the cache (and with it the in-plane
+// degraded path — every lost-shard read misses and redirects).
+void swhp_cache_configure(void* h, uint64_t max_bytes) {
+  SlabCache& c = static_cast<Server*>(h)->cache;
+  std::lock_guard<std::mutex> g(c.mu);
+  c.max_bytes = max_bytes;
+  c.evict_to_budget();
+}
+
+// Publishes one reconstructed slab (overwriting any prior entry). len 0
+// is valid — a past-tail slab cached as "known empty" so reads covering
+// it stay in-plane. Returns 0 ok, -1 rejected (cache disabled or the
+// slab alone exceeds the whole budget).
+int swhp_cache_put(void* h, uint32_t vid, int sid, uint64_t idx,
+                   const uint8_t* data, uint64_t len) {
+  if (sid < 0 || sid >= kMaxEcShards || (len > 0 && !data)) return -1;
+  SlabCache& c = static_cast<Server*>(h)->cache;
+  auto blob = std::make_shared<std::vector<uint8_t>>(data, data + len);
+  SlabKey k{static_cast<uint64_t>(vid) << 32 | static_cast<uint32_t>(sid),
+            idx};
+  std::lock_guard<std::mutex> g(c.mu);
+  if (c.max_bytes == 0 || len > c.max_bytes) return -1;
+  auto it = c.map.find(k);
+  if (it != c.map.end()) {
+    c.bytes -= it->second->second->size();
+    c.lru.erase(it->second);
+    c.map.erase(it);
+  }
+  c.lru.emplace_front(k, std::move(blob));
+  c.map[k] = c.lru.begin();
+  c.bytes += len;
+  c.puts++;
+  c.put_bytes += len;
+  c.evict_to_budget();
+  return 0;
+}
+
+// Drops every slab of (vid, sid), or of the whole vid when sid < 0.
+// Returns the number of entries removed. In-flight reads that already
+// grabbed a slab's shared_ptr finish with the bytes they started with —
+// callers serialize rebuild-then-invalidate-then-serve ordering above.
+uint64_t swhp_cache_invalidate(void* h, uint32_t vid, int sid) {
+  SlabCache& c = static_cast<Server*>(h)->cache;
+  uint64_t vs = static_cast<uint64_t>(vid) << 32 |
+                static_cast<uint32_t>(sid < 0 ? 0 : sid);
+  uint64_t removed = 0;
+  std::lock_guard<std::mutex> g(c.mu);
+  for (auto it = c.lru.begin(); it != c.lru.end();) {
+    bool match = sid < 0 ? (it->first.vs >> 32) == vid : it->first.vs == vs;
+    if (match) {
+      c.bytes -= it->second->size();
+      c.map.erase(it->first);
+      it = c.lru.erase(it);
+      removed++;
+    } else {
+      ++it;
+    }
+  }
+  c.invalidated += removed;
+  return removed;
+}
+
+// Flat snapshot of the slab cache + EC serving outcomes, all uint64:
+//   [0] puts        [1] put_bytes   [2] hits         [3] misses
+//   [4] evictions   [5] invalidated [6] entries      [7] bytes
+//   [8] max_bytes   [9] degraded_served (in-plane, cache-fed)
+//   [10] degraded_redirected (lost shard, slabs absent or bad)
+//   [11] ec_local_served (all shards local)
+// The first nine are one consistent snapshot (taken under the cache
+// mutex — exact, not torn); the last three are relaxed atomics.
+int swhp_cache_stats_len() { return 12; }
+
+int swhp_cache_stats(void* h, uint64_t* out, int n) {
+  if (!h || n < 12) return -1;
+  Server* s = static_cast<Server*>(h);
+  SlabCache& c = s->cache;
+  {
+    std::lock_guard<std::mutex> g(c.mu);
+    out[0] = c.puts;
+    out[1] = c.put_bytes;
+    out[2] = c.hits;
+    out[3] = c.misses;
+    out[4] = c.evictions;
+    out[5] = c.invalidated;
+    out[6] = c.map.size();
+    out[7] = c.bytes;
+    out[8] = c.max_bytes;
+  }
+  out[9] = s->ec_degraded_served.load(std::memory_order_relaxed);
+  out[10] = s->ec_degraded_redirected.load(std::memory_order_relaxed);
+  out[11] = s->ec_local_served.load(std::memory_order_relaxed);
+  return 12;
 }
 
 void swhp_stop(void* h) {

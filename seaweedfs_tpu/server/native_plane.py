@@ -175,6 +175,47 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.swhp_set_slow_us.argtypes = [ctypes.c_void_p,
                                              ctypes.c_uint64]
             lib.swhp_set_slow_us.restype = None
+        # EC + reconstructed-slab cache ABI — absent in an explicitly
+        # overridden pre-cache build (SW_HTTP_PLANE_LIB); the wrapper
+        # then keeps every EC read on the redirect path as before
+        if hasattr(lib, "swhp_cache_put"):
+            lib.swhp_ec_register.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64]
+            lib.swhp_ec_register.restype = ctypes.c_int
+            lib.swhp_ec_set_shard.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
+                ctypes.c_char_p]
+            lib.swhp_ec_set_shard.restype = ctypes.c_int
+            lib.swhp_ec_put_bulk.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+            lib.swhp_ec_put_bulk.restype = ctypes.c_int
+            lib.swhp_ec_delete.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32,
+                                           ctypes.c_uint64]
+            lib.swhp_ec_delete.restype = ctypes.c_int
+            lib.swhp_ec_unregister.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_uint32]
+            lib.swhp_ec_unregister.restype = ctypes.c_int
+            lib.swhp_cache_configure.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_uint64]
+            lib.swhp_cache_configure.restype = None
+            lib.swhp_cache_put.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
+                ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]
+            lib.swhp_cache_put.restype = ctypes.c_int
+            lib.swhp_cache_invalidate.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_uint32,
+                                                  ctypes.c_int]
+            lib.swhp_cache_invalidate.restype = ctypes.c_uint64
+            lib.swhp_cache_stats_len.argtypes = []
+            lib.swhp_cache_stats_len.restype = ctypes.c_int
+            lib.swhp_cache_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int]
+            lib.swhp_cache_stats.restype = ctypes.c_int
         _lib = lib
         return lib
 
@@ -220,6 +261,10 @@ class NativeReadPlane:
                 self._h, 1 if config.env_bool("SW_PLANE_STATS") else 0)
             lib.swhp_set_slow_us(
                 self._h, max(0, config.env_int("SW_PLANE_SLOW_US")))
+        self._has_cache = hasattr(lib, "swhp_cache_put")
+        if self._has_cache:
+            lib.swhp_cache_configure(
+                self._h, max(0, config.env_int("SW_PLANE_CACHE_BYTES")))
 
     # -- volume lifecycle --------------------------------------------------
     def register_volume(self, volume) -> bool:
@@ -310,6 +355,118 @@ class NativeReadPlane:
         if not h:
             return -1
         return int(self._lib.swhp_disable_writer(h, vid))
+
+    # -- EC volumes + reconstructed-slab cache -----------------------------
+    def register_ec_volume(self, ev, slab_bytes: int) -> bool:
+        """Push an EC volume's geometry, local shard files and .ecx
+        index mirror into the plane. slab_bytes must match the Python
+        engine's slab size — cached slabs are addressed by index.
+
+        Safe to call repeatedly (every mount/unmount re-syncs): a fresh
+        record replaces the old one, so the shard set and index can
+        never go stale. Index misses redirect to Python, so the
+        register-then-fill window is served, never 404'd."""
+        h = self._h
+        if not h or not self._has_cache:
+            return False
+        from ..ec.constants import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+                                    TOTAL_SHARDS)
+        try:
+            dat_size = ev._dat_size_hint()
+        except Exception:
+            return False
+        rc = self._lib.swhp_ec_register(
+            h, ev.vid, ev.version, dat_size, LARGE_BLOCK_SIZE,
+            SMALL_BLOCK_SIZE, int(slab_bytes))
+        if rc != 0:
+            return False
+        for sid in range(TOTAL_SHARDS):
+            shard = ev.shards.get(sid)
+            self._lib.swhp_ec_set_shard(
+                h, ev.vid, sid,
+                shard.path.encode() if shard is not None else None)
+        return self._bulk_load_ecx(ev)
+
+    def _bulk_load_ecx(self, ev) -> bool:
+        """Snapshot the .ecx under its lock and push every entry —
+        tombstones included, so a deleted needle redirects (Python
+        404s) instead of being resurrected by a re-sync."""
+        import numpy as np
+        from ..storage.needle_map import bytes_to_entry
+        from ..storage.types import entry_size
+        rec_size = entry_size(ev.offset_width)
+        with ev.ecx_lock:
+            ev.ecx_file.seek(0)
+            raw = ev.ecx_file.read(ev.ecx_size)
+        keys, offsets, sizes = [], [], []
+
+        def put_chunk():
+            ka = np.asarray(keys, dtype=np.uint64)
+            oa = np.asarray(offsets, dtype=np.uint64)
+            sa = np.asarray(sizes, dtype=np.uint32)
+            self._lib.swhp_ec_put_bulk(
+                self._h, ev.vid,
+                ka.ctypes.data_as(ctypes.c_void_p),
+                oa.ctypes.data_as(ctypes.c_void_p),
+                sa.ctypes.data_as(ctypes.c_void_p), len(keys))
+
+        for pos in range(0, len(raw) - rec_size + 1, rec_size):
+            key, offset, size = bytes_to_entry(raw[pos:pos + rec_size])
+            keys.append(key)
+            offsets.append(offset)
+            sizes.append(size)
+            if len(keys) >= (1 << 20):  # bound the staging lists
+                put_chunk()
+                keys, offsets, sizes = [], [], []
+        if keys:
+            put_chunk()
+        return True
+
+    def unregister_ec_volume(self, vid: int):
+        h = self._h
+        if h and self._has_cache:
+            self._lib.swhp_ec_unregister(h, vid)
+
+    def ec_delete(self, vid: int, key: int):
+        """Mirror an EC needle delete (tombstone, matching .ecx)."""
+        h = self._h
+        if h and self._has_cache:
+            self._lib.swhp_ec_delete(h, vid, key)
+
+    def cache_put(self, vid: int, sid: int, idx: int, data: bytes) -> bool:
+        """Publish one reconstructed slab into the plane cache."""
+        h = self._h
+        if not h or not self._has_cache:
+            return False
+        return self._lib.swhp_cache_put(
+            h, vid, sid, idx, data, len(data)) == 0
+
+    def cache_invalidate(self, vid: int, sid: int = -1) -> int:
+        """Drop cached slabs of (vid, sid), or all of vid when sid < 0.
+        Returns the number of entries removed."""
+        h = self._h
+        if not h or not self._has_cache:
+            return 0
+        return int(self._lib.swhp_cache_invalidate(h, vid, sid))
+
+    # field order of swhp_cache_stats's flat export
+    _CACHE_STATS_FIELDS = (
+        "puts", "put_bytes", "hits", "misses", "evictions", "invalidated",
+        "entries", "bytes", "max_bytes", "degraded_served",
+        "degraded_redirected", "ec_local_served")
+
+    def cache_stats(self) -> Optional[dict]:
+        """Slab-cache counters + EC serving outcomes, or None when the
+        plane is stopped or the loaded library predates the cache ABI."""
+        h = self._h
+        if not h or not self._has_cache:
+            return None
+        n = int(self._lib.swhp_cache_stats_len())
+        buf = (ctypes.c_uint64 * n)()
+        if self._lib.swhp_cache_stats(h, buf, n) != n:
+            return None
+        return dict(zip(self._CACHE_STATS_FIELDS,
+                        (int(x) for x in buf)))
 
     # -- stats / lifecycle -------------------------------------------------
     @property

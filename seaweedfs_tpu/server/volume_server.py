@@ -86,6 +86,7 @@ class VolumeServer:
         router.add("GET", "/admin/traces", traces_handler)
         router.add("GET", "/admin/traces/export", traces_export_handler)
         router.add("GET", "/admin/plane/slow", self.admin_plane_slow)
+        router.add("GET", "/admin/plane/cache", self.admin_plane_cache)
         router.add("POST", "/admin/profile", profile_handler)
         router.add("GET", "/stats/disk", self.stats_disk)
         router.add("GET", "/stats/memory", self.stats_memory)
@@ -155,10 +156,13 @@ class VolumeServer:
             loc_cache=self._ec_loc_cache,
             self_url=lambda: self.url,
             on_read=lambda s: DEGRADED_READ_HISTOGRAM.observe(
-                s, trace_id=tracing.current_trace_id()))
+                s, trace_id=tracing.current_trace_id()),
+            on_slabs=self._publish_slabs)
         # a shard (re-)registered after rebuild must win over cached
-        # reconstructions immediately
-        self.store.on_ec_mount = self.degraded.invalidate
+        # reconstructions immediately — in the engine's LRU AND in the
+        # native plane's slab cache (_on_ec_mount re-syncs the plane's
+        # shard set first, then invalidates both)
+        self.store.on_ec_mount = self._on_ec_mount
         # background integrity scrub: paced H·x=0 syndrome verification
         # of every local EC volume, findings pushed to the master's
         # repair queue (ec/scrub.py)
@@ -194,6 +198,9 @@ class VolumeServer:
                         with v.lock:
                             self.fast_plane.register_volume(v)
                             self._writer_acquire(v)
+                for loc in self.store.locations:
+                    for vid in list(loc.ec_volumes):
+                        self._fast_ec_sync(vid)
             except Exception as e:  # noqa: BLE001 - plane is optional
                 from ..util import config as _config
                 if _config.env_is_set("SW_HTTP_PLANE_LIB"):
@@ -325,6 +332,56 @@ class VolumeServer:
         if v is not None:
             self._writer_release(v)
         self.fast_plane.unregister_volume(vid)
+
+    # -- native-plane EC mirror + slab cache -------------------------------
+    def _fast_ec_sync(self, vid: int):
+        """Re-register an EC volume's geometry, local shard set and
+        .ecx mirror in the plane (or unregister it when it's gone).
+        Runs after every mount/unmount/rebuild: the plane must learn a
+        rebuilt shard is local BEFORE the stale cached slabs for it are
+        invalidated, or a read in the window would re-miss to Python."""
+        if self.fast_plane is None:
+            return
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            self.fast_plane.unregister_ec_volume(vid)
+            return
+        try:
+            self.fast_plane.register_ec_volume(ev, self.degraded.slab)
+        except Exception:  # noqa: BLE001 - mirror is optional
+            self.fast_plane.unregister_ec_volume(vid)
+
+    def _fast_ec_delete(self, vid: int, nid: int):
+        if self.fast_plane is not None:
+            self.fast_plane.ec_delete(vid, nid)
+
+    def _publish_slabs(self, vid: int, sid: int, slabs: dict):
+        """DegradedReadEngine on_slabs hook: push freshly reconstructed
+        slabs into the plane cache so the next read of these bytes is
+        served in-plane with zero redirects."""
+        if self.fast_plane is None:
+            return
+        for idx, data in slabs.items():
+            self.fast_plane.cache_put(vid, sid, int(idx), data)
+
+    def _invalidate_reconstructions(self, vid: int, shard_ids):
+        """Drop every cached reconstruction of these shards — the
+        plane's slab cache AND the engine's LRU — after a mount or
+        rebuild made them stale. Ordering matters: re-sync the plane's
+        shard set FIRST, then drop the plane's slabs, then the
+        engine's. A reader in the window sees either fresh local bytes
+        or a miss (redirect to Python, which reconstructs from the
+        fresh shards), never stale data."""
+        self._fast_ec_sync(vid)
+        if self.fast_plane is not None:
+            for sid in shard_ids:
+                self.fast_plane.cache_invalidate(vid, sid)
+        self.degraded.invalidate(vid, shard_ids)
+
+    def _on_ec_mount(self, vid: int, shard_ids):
+        """store.on_ec_mount: a (re-)mounted shard must win over every
+        cached reconstruction immediately."""
+        self._invalidate_reconstructions(vid, shard_ids)
 
     def _heartbeat_loop(self):
         from ..util import glog
@@ -580,6 +637,11 @@ class VolumeServer:
                           _np.build_failed())
         else:
             observe_plane(None, 0, _np.build_failed())
+        # in-plane degraded serving + slab-cache counters (same mirror
+        # pattern; None when the plane is off or predates the cache ABI)
+        from ..stats.metrics import observe_plane_cache
+        observe_plane_cache(self.fast_plane.cache_stats()
+                            if self.fast_plane is not None else None)
         # device-codec telemetry (process-global monotonic counters)
         # mirrors onto the scrape so dispatches / bitmat uploads / host
         # fallbacks are visible without running a rebuild through bench
@@ -616,6 +678,14 @@ class VolumeServer:
         return {"plane": True,
                 "slow": self.fast_plane.slow_requests(),
                 "stats": self.fast_plane.stats()}
+
+    def admin_plane_cache(self, req: Request):
+        """Native-plane reconstructed-slab cache counters + EC serving
+        outcomes (swhp_cache_stats), for the degraded fast-path debug
+        loop: did the read hit the plane cache or redirect to Python?"""
+        if self.fast_plane is None:
+            return {"plane": False, "cache": None}
+        return {"plane": True, "cache": self.fast_plane.cache_stats()}
 
     def admin_assign_volume(self, req: Request):
         vid = int(req.query["volume"])
@@ -868,6 +938,7 @@ class VolumeServer:
         shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
                      if s != ""]
         out = self.store.unmount_ec_shards(vid, shard_ids)
+        self._fast_ec_sync(vid)  # the plane must stop preading those fds
         self.heartbeat_once()
         return {"volume": vid, "unmounted": out}
 
@@ -901,8 +972,8 @@ class VolumeServer:
                 vid, collection, stats=stats)
         if rebuilt:
             # rebuilt shards serve from disk now; cached reconstructions
-            # of them are dead weight
-            self.degraded.invalidate(vid, rebuilt)
+            # of them (engine LRU + plane slabs) are dead weight
+            self._invalidate_reconstructions(vid, rebuilt)
         return {"volume": vid, "rebuilt": rebuilt, "stats": stats,
                 "trace_id": tracing.current_trace_id()}
 
@@ -935,6 +1006,9 @@ class VolumeServer:
             raise HttpError(400, "bad JSON body") from None
         body = body if isinstance(body, dict) else {}
         self.store.unmount_ec_shards(vid, [sid])
+        # the plane must drop its fd on the poisoned shard file NOW —
+        # an open fd would keep serving the quarantined bytes
+        self._fast_ec_sync(vid)
         for loc in self.store.locations:
             base = volume_file_prefix(loc.directory, collection, vid)
             for p in (base + to_ext(sid), base + to_ext(sid) + ".part"):
@@ -952,7 +1026,7 @@ class VolumeServer:
         observe_repair(stats)
         mounted = self.store.mount_ec_shards(vid, collection, rebuilt) \
             if rebuilt else []
-        self.degraded.invalidate(vid, rebuilt or [sid])
+        self._invalidate_reconstructions(vid, rebuilt or [sid])
         self.heartbeat_once()
         return {"volume": vid, "shard": sid, "rebuilt": rebuilt,
                 "mounted": mounted, "stats": stats,
@@ -1020,6 +1094,7 @@ class VolumeServer:
         shard_ids = [int(s) for s in req.query.get("shards", "").split(",")
                      if s != ""]
         self.store.unmount_ec_shards(vid, shard_ids)
+        self._fast_ec_sync(vid)
         removed = []
         for loc in self.store.locations:
             base = volume_file_prefix(loc.directory, collection, vid)
@@ -1126,6 +1201,7 @@ class VolumeServer:
         ec_decoder.write_dat_file(base, dat_size)
         ec_decoder.write_idx_file_from_ec_index(base)
         self.store.unmount_ec_shards(vid, list(range(TOTAL_SHARDS)))
+        self._fast_ec_sync(vid)  # decoded back to a plain volume
         for loc in self.store.locations:
             if os.path.dirname(base) == loc.directory:
                 loc.load_existing_volumes()
@@ -1831,6 +1907,10 @@ class VolumeServer:
         """EC delete: tombstone + journal locally, then broadcast to every
         other shard holder (reference store_ec_delete.go:15-110)."""
         found = ev.delete_needle(key)
+        if found:
+            # mirror the tombstone into the plane's .ecx mirror so the
+            # fast path redirects (and Python 404s) instead of serving
+            self._fast_ec_delete(vid, key)
         if req.query.get("type") != "replicate":
             from ..security.jwt import jwt_from_request
             from ..util.fanout import fan_out

@@ -891,6 +891,54 @@ def observe_plane(snap: Optional[Dict], slow_depth: int = 0,
     PLANE_SLOW_RING_GAUGE.set(slow_depth)
 
 
+# -- in-plane degraded serving + reconstructed-slab cache --------------------
+
+PLANE_DEGRADED_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_degraded_total",
+    "Native-plane EC read outcomes by result: served (lost-shard bytes "
+    "filled from the slab cache, zero redirects), redirected (slabs "
+    "absent or stale — Python reconstructs), local (all shards local).",
+    labels=("result",))
+PLANE_CACHE_EVENT_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_cache_events_total",
+    "Reconstructed-slab cache flow by event (puts, hits, misses, "
+    "evictions, invalidated).",
+    labels=("event",))
+PLANE_CACHE_BYTES_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_cache_put_bytes_total",
+    "Slab bytes published into the native plane's cache.")
+PLANE_CACHE_ENTRIES_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_plane_cache_entries",
+    "Slabs currently resident in the native plane's cache.")
+PLANE_CACHE_BYTES_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_plane_cache_bytes",
+    "Bytes currently resident in the native plane's cache (bounded by "
+    "SW_PLANE_CACHE_BYTES).")
+PLANE_CACHE_MAX_BYTES_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_plane_cache_max_bytes",
+    "Configured byte budget of the native plane's slab cache "
+    "(SW_PLANE_CACHE_BYTES; 0 = in-plane degraded path disabled).")
+
+
+def observe_plane_cache(snap: Optional[Dict]):
+    """Mirror one NativeReadPlane.cache_stats() snapshot onto the
+    volume registry (same set_total mirror pattern as observe_plane)."""
+    if not snap:
+        return
+    PLANE_DEGRADED_COUNTER.set_total(
+        snap.get("degraded_served", 0), "served")
+    PLANE_DEGRADED_COUNTER.set_total(
+        snap.get("degraded_redirected", 0), "redirected")
+    PLANE_DEGRADED_COUNTER.set_total(
+        snap.get("ec_local_served", 0), "local")
+    for event in ("puts", "hits", "misses", "evictions", "invalidated"):
+        PLANE_CACHE_EVENT_COUNTER.set_total(snap.get(event, 0), event)
+    PLANE_CACHE_BYTES_COUNTER.set_total(snap.get("put_bytes", 0))
+    PLANE_CACHE_ENTRIES_GAUGE.set(snap.get("entries", 0))
+    PLANE_CACHE_BYTES_GAUGE.set(snap.get("bytes", 0))
+    PLANE_CACHE_MAX_BYTES_GAUGE.set(snap.get("max_bytes", 0))
+
+
 # -- repair queue (stats/repair_queue.py via observe_repair_queue) -----------
 
 MASTER_REPAIR_QUEUE_COUNTER = MASTER_GATHER.counter(
@@ -907,6 +955,11 @@ MASTER_REPAIR_QUEUE_TTR_GAUGE = MASTER_GATHER.gauge(
     "Time-to-re-protection over recent resolved incidents (quantile "
     "label: p50, p99, max).",
     labels=("quantile",))
+MASTER_REPAIR_QUEUE_UNATTRIBUTED_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_repair_queue_unattributed",
+    "Open scrub findings with no attributable shard (shard=-1): "
+    "visible at /cluster/repairs, excluded from the drain loop until "
+    "an operator or a later scrub attributes them.")
 
 
 def observe_repair_queue(snap: Dict):
@@ -920,6 +973,8 @@ def observe_repair_queue(snap: Dict):
             counters.get(event, 0), "all", event)
     for kind, depth in snap.get("depth", {}).items():
         MASTER_REPAIR_QUEUE_OPEN_GAUGE.set(depth, kind)
+    MASTER_REPAIR_QUEUE_UNATTRIBUTED_GAUGE.set(
+        snap.get("unattributed", 0))
     ttr = snap.get("time_to_re_protection", {})
     MASTER_REPAIR_QUEUE_TTR_GAUGE.set(ttr.get("p50_s", 0.0), "p50")
     MASTER_REPAIR_QUEUE_TTR_GAUGE.set(ttr.get("p99_s", 0.0), "p99")

@@ -124,6 +124,14 @@ class RepairQueue:
                            source, detail or {},
                            detected_at if detected_at is not None
                            else time.time())
+            if shard is not None and shard < 0:
+                # scrub finding with no attributable shard (shard=-1):
+                # there is nothing to rebuild yet, so the drain loop
+                # must skip it — but it stays VISIBLE at
+                # /cluster/repairs instead of parking silently (and
+                # spinning the drain with "no holder for corrupt
+                # shard" backoffs, which is what it used to do)
+                inc.status = "unattributed"
             self._next_id += 1
             self._open[key] = inc
             self._c["reported"] += 1
@@ -164,6 +172,10 @@ class RepairQueue:
             best: Optional[Incident] = None
             for inc in self._open.values():
                 if inc.kind == "at_risk_holder":
+                    continue
+                if inc.status == "unattributed":
+                    # no shard to rebuild — actionable only once a
+                    # later scrub (or an operator) attributes it
                     continue
                 if inc.not_before > now:
                     continue
@@ -207,10 +219,13 @@ class RepairQueue:
                 key=lambda d: (d["priority"], d["detected_at"]))
             resolved = [i.to_dict() for i in self._resolved]
             counters = dict(self._c)
+            unattributed = sum(1 for i in self._open.values()
+                               if i.status == "unattributed")
         return {"open": open_incidents,
                 "resolved_recent": resolved[-32:],
                 "counters": counters,
                 "depth": self.depth_by_kind(),
+                "unattributed": unattributed,
                 "time_to_re_protection": self.ttr_stats()}
 
     def summary(self) -> dict:

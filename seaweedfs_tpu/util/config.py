@@ -153,6 +153,10 @@ _knob("SW_PLANE_STATS", "bool", True,
 _knob("SW_PLANE_SLOW_US", "int", 10000,
       "Native-plane requests at or above this many microseconds enter "
       "the slow-request ring (GET /admin/plane/slow).")
+_knob("SW_PLANE_CACHE_BYTES", "int", 32 << 20,
+      "Byte budget of the native plane's reconstructed-slab cache; 0 "
+      "disables the in-plane degraded fast path (lost-shard reads "
+      "redirect to Python as before).")
 _knob("SW_LOCK_DEBUG", "bool", False,
       "Record the cross-thread lock-acquisition graph (util/locks.py) "
       "for deadlock detection; auto-on under pytest.")

@@ -507,7 +507,9 @@ def test_cluster_degraded_read_end_to_end(cluster3, monkeypatch):
     assert snap["cache_hits"] > 0
 
     # -- shard (re-)mount invalidates that shard's cached slabs ---------
-    assert serving.store.on_ec_mount == serving.degraded.invalidate
+    # (the hook now also re-syncs the native plane and drops its slab
+    # cache before the engine's — see _invalidate_reconstructions)
+    assert serving.store.on_ec_mount == serving._on_ec_mount
     assert snap["cache_entries"] > 0
     own = next(iter(serving.store.find_ec_volume(vid).shards))
     serving.degraded.cache.put((vid, own, 0), b"stale" * 40)

@@ -140,13 +140,32 @@ def test_live_rack_aware_balance(tmp_path):
                       filename=f"f{i}")
         out = io.StringIO()
         env = CommandEnv(master.url, out=out)
+
+        def converge_14(timeout=10.0):
+            """Event-driven pulse wait: the servers are in-process, so
+            push their heartbeats and poll the master view until all 14
+            shards are registered — no fixed pulse-boundary sleep."""
+            import time
+            deadline = time.monotonic() + timeout
+            while True:
+                for vs in servers:
+                    vs.heartbeat_once()
+                try:
+                    ec = get_json(f"http://{master.url}/cluster/"
+                                  f"ec_lookup?volumeId={vid}")
+                except Exception:  # noqa: BLE001 - not registered yet
+                    ec = {"shards": {}}
+                if len(ec["shards"]) == 14:
+                    return ec
+                if time.monotonic() > deadline:
+                    raise AssertionError(f"only {len(ec['shards'])}/14 "
+                                         f"shards converged")
+                time.sleep(0.02)
+
         run_command(env, f"ec.encode -volumeId {vid}")
-        import time
-        time.sleep(1.5)
+        converge_14()   # ec.balance must see the full shard map
         run_command(env, "ec.balance -collection bal")
-        time.sleep(1.5)
-        ec = get_json(f"http://{master.url}/cluster/ec_lookup"
-                      f"?volumeId={vid}")
+        ec = converge_14()
         rack_of = {vs.url: ["r1", "r1", "r2", "r2"][i]
                    for i, vs in enumerate(servers)}
         per_rack = {}

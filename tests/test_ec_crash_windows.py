@@ -53,6 +53,34 @@ def run_shell(master, line):
     return out.getvalue()
 
 
+def converge_ec(master, servers, vid, pred, timeout=10.0):
+    """Event-driven pulse-boundary wait: push a heartbeat from every
+    in-process server, then poll the master's EC view until ``pred``
+    holds. Replaces the old fixed 1.5 s sleeps, which both over-waited
+    on fast machines and flaked on loaded ones. SW_PULSE_S semantics
+    are untouched — the background pulse keeps running; we just don't
+    wait for it."""
+    deadline = time.monotonic() + timeout
+    while True:
+        for vs in servers:
+            vs.heartbeat_once()
+        try:
+            ec = get_json(f"http://{master.url}/cluster/ec_lookup"
+                          f"?volumeId={vid}")
+        except Exception:  # noqa: BLE001 - not registered yet
+            ec = {"shards": {}}
+        if pred(ec):
+            return ec
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"master EC view never converged: {ec['shards'].keys()}")
+        time.sleep(0.02)
+
+
+def all_14(ec):
+    return len(ec["shards"]) == 14
+
+
 def test_rerun_after_interrupt_between_generate_and_spread(cluster):
     """Crash window: shards generated on the source, nothing spread or
     deleted. A later full ec.encode run must complete cleanly."""
@@ -66,7 +94,7 @@ def test_rerun_after_interrupt_between_generate_and_spread(cluster):
     # ...operator retries the whole command
     out = run_shell(master, f"ec.encode -volumeId {vid}")
     assert "ec encoded" in out
-    time.sleep(1.5)
+    converge_ec(master, servers, vid, all_14)
     for fid, data in payloads.items():
         assert op.read_file(master.url, fid) == data, fid
 
@@ -79,7 +107,7 @@ def test_rerun_after_interrupt_before_source_cleanup(cluster):
     vid, payloads = fill(master.url)
     out = run_shell(master, f"ec.encode -volumeId {vid}")
     assert "ec encoded" in out
-    time.sleep(1.5)
+    converge_ec(master, servers, vid, all_14)
     # now simulate the stale original reappearing (crash before delete
     # on one replica): remount the volume files if any survive — in
     # this build the delete already ran, so instead verify the
@@ -99,7 +127,7 @@ def test_rebuild_is_idempotent_and_converges(cluster):
     master, servers = cluster
     vid, payloads = fill(master.url)
     run_shell(master, f"ec.encode -volumeId {vid}")
-    time.sleep(1.5)
+    converge_ec(master, servers, vid, all_14)
 
     def lose_one_holder():
         ec = get_json(f"http://{master.url}/cluster/ec_lookup"
@@ -116,14 +144,15 @@ def test_rebuild_is_idempotent_and_converges(cluster):
                   f"&shards={s}")
         post_json(f"http://{victim}/admin/ec/delete_shards?volume={vid}"
                   f"&collection=cw&shards={s}")
-        time.sleep(1.5)
+        converge_ec(master, servers, vid,
+                    lambda ec: all(str(sid) not in ec["shards"]
+                                   or victim not in ec["shards"][str(sid)]
+                                   for sid in lost))
         return len(lost)
 
     assert lose_one_holder() > 0
     run_shell(master, "ec.rebuild -collection cw")
-    time.sleep(1.5)
-    ec = get_json(f"http://{master.url}/cluster/ec_lookup"
-                  f"?volumeId={vid}")
+    ec = converge_ec(master, servers, vid, all_14)
     assert len(ec["shards"]) == 14
     # idempotent second pass: nothing missing, no error
     out = run_shell(master, "ec.rebuild -collection cw")
@@ -131,9 +160,7 @@ def test_rebuild_is_idempotent_and_converges(cluster):
     # second loss round-trips too
     assert lose_one_holder() > 0
     run_shell(master, "ec.rebuild -collection cw")
-    time.sleep(1.5)
-    ec = get_json(f"http://{master.url}/cluster/ec_lookup"
-                  f"?volumeId={vid}")
+    ec = converge_ec(master, servers, vid, all_14)
     assert len(ec["shards"]) == 14
     for fid, data in payloads.items():
         assert op.read_file(master.url, fid) == data, fid

@@ -861,3 +861,419 @@ class TestNativeBenchmarkMode:
             assert vs2.fast_plane.written > 0
         finally:
             vs2.stop()
+
+
+# -- reconstructed-slab cache + in-plane degraded serving (ISSUE 15) --------
+
+
+class TestPlaneSlabCache:
+    """The plane-resident slab cache ABI driven directly: byte budget,
+    exact-count stats under concurrency, scoped invalidation."""
+
+    def _plane(self, monkeypatch, budget):
+        from seaweedfs_tpu.server.native_plane import NativeReadPlane
+        monkeypatch.setenv("SW_PLANE_CACHE_BYTES", str(budget))
+        return NativeReadPlane("127.0.0.1", 0, "127.0.0.1:1")
+
+    def test_budget_eviction_and_invalidate(self, monkeypatch):
+        plane = self._plane(monkeypatch, 8192)
+        try:
+            assert plane.cache_put(1, 0, 0, b"a" * 4096)
+            assert plane.cache_put(1, 0, 1, b"b" * 4096)
+            s = plane.cache_stats()
+            assert (s["entries"], s["bytes"]) == (2, 8192)
+            # a third slab breaches the budget: the LRU one is evicted
+            assert plane.cache_put(1, 0, 2, b"c" * 4096)
+            s = plane.cache_stats()
+            assert s["evictions"] == 1
+            assert s["entries"] == 2 and s["bytes"] <= s["max_bytes"]
+            # a slab larger than the whole budget is refused outright
+            assert not plane.cache_put(1, 0, 3, b"x" * 9000)
+            # zero-length slab ("known empty past the tail") is valid
+            assert plane.cache_put(1, 0, 4, b"")
+            # overwrite replaces in place — bytes never double-count
+            assert plane.cache_put(1, 0, 2, b"d" * 1024)
+            s = plane.cache_stats()
+            assert s["puts"] == 5
+            assert s["entries"] == 3 and s["bytes"] == 4096 + 0 + 1024
+            # shard-scoped invalidation drops exactly that shard's slabs
+            assert plane.cache_put(2, 1, 0, b"e" * 512)
+            assert plane.cache_invalidate(1, 0) == 3
+            s = plane.cache_stats()
+            assert s["entries"] == 1 and s["invalidated"] == 3
+            # volume-scoped (sid < 0) sweeps the rest
+            assert plane.cache_invalidate(2) == 1
+            assert plane.cache_stats()["entries"] == 0
+        finally:
+            plane.stop()
+
+    def test_zero_budget_disables_cache(self, monkeypatch):
+        plane = self._plane(monkeypatch, 0)
+        try:
+            assert not plane.cache_put(1, 0, 0, b"zz")
+            s = plane.cache_stats()
+            assert s["max_bytes"] == 0 and s["puts"] == 0
+        finally:
+            plane.stop()
+
+    def test_hammer_exact_counts(self, monkeypatch):
+        """8 writer threads + a sweeper racing invalidations: every
+        counter must balance exactly afterwards — the cache keeps its
+        books under one mutex precisely so a lost update is
+        impossible."""
+        import threading
+        plane = self._plane(monkeypatch, 64 << 20)
+        try:
+            n_threads, per_thread, slab = 8, 300, 1024
+            stop = threading.Event()
+            swept = [0]
+            lock = threading.Lock()
+
+            def writer(tid):
+                blob = bytes([tid]) * slab
+                for i in range(per_thread):
+                    assert plane.cache_put(tid + 1, tid % 14, i, blob)
+
+            def sweeper():
+                while not stop.is_set():
+                    for vid in range(1, n_threads + 1):
+                        n = plane.cache_invalidate(vid)
+                        with lock:
+                            swept[0] += n
+                    time.sleep(0.001)
+
+            threads = [threading.Thread(target=writer, args=(t,))
+                       for t in range(n_threads)]
+            sw = threading.Thread(target=sweeper)
+            sw.start()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            stop.set()
+            sw.join(timeout=60)
+            assert all(not t.is_alive() for t in threads + [sw])
+            # final sweep: everything still resident comes out counted
+            for vid in range(1, n_threads + 1):
+                swept[0] += plane.cache_invalidate(vid)
+            total = n_threads * per_thread
+            s = plane.cache_stats()
+            assert s["puts"] == total
+            assert s["put_bytes"] == total * slab
+            assert s["entries"] == 0 and s["bytes"] == 0
+            # ample budget + unique keys: every slab ever put was
+            # removed exactly once, by an invalidation, never eviction
+            assert s["evictions"] == 0
+            assert s["invalidated"] == total
+            assert swept[0] == total
+        finally:
+            plane.stop()
+
+
+class TestPlaneDegradedServing:
+    """Warm degraded reads served entirely in-plane: the cold read
+    redirects to Python, whose reconstruction publishes the slabs back
+    into the plane; the re-read then never leaves C++ (ISSUE 15)."""
+
+    @pytest.fixture
+    def ec_cluster(self, tmp_path):
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        servers = [
+            VolumeServer(port=0, directories=[str(tmp_path / f"e{i}")],
+                         master_url=master.url, pulse_seconds=1,
+                         max_volume_counts=[30],
+                         ec_backend="numpy").start()
+            for i in range(3)]
+        yield master, servers
+        for vs in servers:
+            vs.stop()
+        master.stop()
+
+    def _setup_degraded(self, master, servers):
+        """Upload, EC-encode, kill data shard 0 cluster-wide; returns
+        (serving server, vid, {fid: payload}, lost sid)."""
+        import io
+        import os
+        import numpy as np
+        from seaweedfs_tpu.client import operation as op
+        from seaweedfs_tpu.ec import to_ext
+        from seaweedfs_tpu.shell.command_env import (CommandEnv,
+                                                     run_command)
+        rng = np.random.default_rng(23)
+        payloads = {}
+        for i in range(12):
+            data = rng.integers(0, 256, 150_000).astype(
+                np.uint8).tobytes()
+            fid = op.upload_data(master.url, data, filename=f"p{i}",
+                                 collection="pc")
+            payloads[fid] = data
+        by_vid = {}
+        for f in payloads:
+            by_vid.setdefault(int(f.split(",")[0]), []).append(f)
+        vid = max(by_vid, key=lambda v: len(by_vid[v]))
+        payloads = {f: payloads[f] for f in by_vid[vid]}
+        env = CommandEnv(master.url, out=io.StringIO())
+        assert run_command(env, f"ec.encode -volumeId {vid}")
+        lost_sid = 0  # needle data starts at volume byte 0 -> shard 0
+        victim = next(vs for vs in servers
+                      if (ev := vs.store.find_ec_volume(vid)) is not None
+                      and lost_sid in ev.shards)
+        serving = next(vs for vs in servers if vs is not victim
+                       and vs.store.find_ec_volume(vid) is not None)
+        assert serving.fast_plane is not None
+        victim.store.unmount_ec_shards(vid, [lost_sid])
+        for loc in victim.store.locations:
+            for f in os.listdir(loc.directory):
+                if f.endswith(to_ext(lost_sid)):
+                    os.remove(os.path.join(loc.directory, f))
+        victim.heartbeat_once()
+        assert wait_until(lambda: str(lost_sid) not in (
+            (env.ec_volumes().get(str(vid)) or {"shards": {}})["shards"]
+        ), timeout=10), "master never dropped the lost shard"
+        serving._ec_loc_cache.invalidate(vid)
+        return serving, vid, payloads, lost_sid
+
+    def test_warm_degraded_reads_zero_redirect(self, ec_cluster):
+        master, servers = ec_cluster
+        serving, vid, payloads, lost_sid = self._setup_degraded(
+            master, servers)
+        cs0 = serving.fast_plane.cache_stats()
+        assert cs0 is not None, "cache ABI missing"
+
+        # -- cold pass: plane misses -> 307 -> Python reconstructs and
+        # publishes the slabs back into the plane
+        degraded_fids = []
+        for f, want in payloads.items():
+            before = serving.degraded.snapshot()["reads"]
+            data, _ = http_get_with_headers(
+                f"http://{serving.fast_url}/{f}")
+            assert data == want, f
+            if serving.degraded.snapshot()["reads"] > before:
+                degraded_fids.append(f)
+        assert degraded_fids, "no needle landed on the lost shard"
+        cs1 = serving.fast_plane.cache_stats()
+        assert cs1["puts"] > 0 and cs1["entries"] > 0
+        assert cs1["degraded_redirected"] > cs0["degraded_redirected"]
+
+        # a needle straddling into a healthy-but-remote shard still
+        # redirects (the plane only preads LOCAL shards): keep the
+        # fully cache-covered ones
+        warm = [f for f in degraded_fids
+                if raw_get(serving.fast_url, f"/{f}")[0] == 200]
+        assert warm, "no degraded needle is fully cache-covered"
+
+        # -- warm passes: zero redirects, zero Python reads, exact hit
+        # accounting, bit-identical bytes
+        base = serving.fast_plane.cache_stats()
+        py_reads = serving.degraded.snapshot()["reads"]
+        rounds = 3
+        for _ in range(rounds):
+            for f in warm:
+                st, _, body = raw_get(serving.fast_url, f"/{f}")
+                assert st == 200 and body == payloads[f], f
+        snap = serving.fast_plane.cache_stats()
+        assert snap["degraded_served"] - base["degraded_served"] == \
+            rounds * len(warm)
+        assert snap["degraded_redirected"] == base["degraded_redirected"]
+        assert snap["hits"] > base["hits"]
+        assert serving.degraded.snapshot()["reads"] == py_reads
+
+        # -- a poisoned slab can never serve wrong bytes: the needle
+        # checksum is verified before the first response byte, so a bad
+        # slab demotes to a redirect and Python answers with truth
+        hot = warm[0]
+        slab = serving.degraded.slab
+        nslabs = (1 << 20) // slab + 1
+        for i in range(nslabs):
+            assert serving.fast_plane.cache_put(
+                vid, lost_sid, i, b"\x5a" * slab)
+        st, _, _ = raw_get(serving.fast_url, f"/{hot}")
+        assert st == 307, "corrupt slab must demote, never serve"
+        data, _ = http_get_with_headers(
+            f"http://{serving.fast_url}/{hot}")
+        assert data == payloads[hot]
+
+        # recover: drop the poison and force one re-reconstruction
+        # (Python's own slab LRU would otherwise serve the redirect
+        # without re-publishing)
+        assert serving.fast_plane.cache_invalidate(vid) > 0
+        serving.degraded.invalidate(vid)
+        data, _ = http_get_with_headers(
+            f"http://{serving.fast_url}/{hot}")
+        assert data == payloads[hot]
+        st, _, body = raw_get(serving.fast_url, f"/{hot}")
+        assert st == 200 and body == payloads[hot]
+
+        # -- SW_PLANE_STATS off: the degraded path stays correct and
+        # exact-counted, with zero latency samples (no clock reads)
+        serving.fast_plane.set_stats_enabled(False)
+        try:
+            tele0 = serving.fast_plane.stats()
+            c0 = serving.fast_plane.cache_stats()
+            st, _, body = raw_get(serving.fast_url, f"/{hot}")
+            assert st == 200 and body == payloads[hot]
+            # freshness holds on the stats-off path too: poison ->
+            # demote, never wrong bytes
+            for i in range(nslabs):
+                serving.fast_plane.cache_put(vid, lost_sid, i,
+                                             b"\x33" * slab)
+            st, _, _ = raw_get(serving.fast_url, f"/{hot}")
+            assert st == 307
+            data, _ = http_get_with_headers(
+                f"http://{serving.fast_url}/{hot}")
+            assert data == payloads[hot]
+            tele1 = serving.fast_plane.stats()
+            assert tele1["requests"] == tele0["requests"]
+            assert tele1["lat_count"] == tele0["lat_count"]
+            c1 = serving.fast_plane.cache_stats()
+            assert c1["degraded_served"] == c0["degraded_served"] + 1
+        finally:
+            serving.fast_plane.set_stats_enabled(True)
+        serving.fast_plane.cache_invalidate(vid)
+        serving.degraded.invalidate(vid)
+        http_get_with_headers(f"http://{serving.fast_url}/{hot}")
+
+        # -- rebuild + mount: the plane must flip from cache-serving to
+        # local preads; the invalidation hook makes a stale slab
+        # unreachable before any read can race it
+        looked = get_json(
+            f"http://{master.url}/cluster/ec_lookup?volumeId={vid}")
+        sources = {s: urls for s, urls in looked["shards"].items()
+                   if int(s) != lost_sid}
+        out = post_json(
+            f"http://{serving.url}/admin/ec/rebuild?volume={vid}"
+            f"&collection=pc", {"sources": sources})
+        assert lost_sid in [int(s) for s in out["rebuilt"]]
+        post_json(f"http://{serving.url}/admin/ec/mount?volume={vid}"
+                  f"&collection=pc&shards={lost_sid}", {})
+        cbase = serving.fast_plane.cache_stats()
+        assert cbase["invalidated"] > 0
+        st, _, body = raw_get(serving.fast_url, f"/{hot}")
+        assert st == 200 and body == payloads[hot]
+        snap = serving.fast_plane.cache_stats()
+        assert snap["ec_local_served"] - cbase["ec_local_served"] == 1
+        assert snap["degraded_served"] == cbase["degraded_served"]
+
+        # the cache families ride the volume /metrics export
+        body = raw_get(serving.url, "/metrics")[2].decode()
+        assert "SeaweedFS_volumeServer_plane_degraded_total" in body
+        assert "SeaweedFS_volumeServer_plane_cache_bytes" in body
+
+    def test_warm_serving_consistent_under_cache_churn(self, ec_cluster):
+        """Publishers overwriting slabs + invalidations racing readers:
+        every response is either the in-plane 200 or the Python-backed
+        redirect, and the bytes are bit-identical every time — the
+        plane hands readers refcounted slab copies, so a torn read is
+        impossible by construction."""
+        import threading
+        master, servers = ec_cluster
+        serving, vid, payloads, lost_sid = self._setup_degraded(
+            master, servers)
+        hot, want = None, None
+        for f in payloads:
+            http_get_with_headers(f"http://{serving.fast_url}/{f}")
+            if raw_get(serving.fast_url, f"/{f}")[0] == 200:
+                hot, want = f, payloads[f]
+                break
+        assert hot is not None, "no warm-servable degraded needle"
+        slab = serving.degraded.slab
+        nslabs = (1 << 20) // slab + 1
+        correct = {i: serving.degraded.read(vid, lost_sid, i * slab,
+                                            slab)
+                   for i in range(nslabs)}
+        stop = threading.Event()
+        errors, hits, misses = [], [0], [0]
+
+        def publisher():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                if k % 50 == 0:
+                    serving.fast_plane.cache_invalidate(vid, lost_sid)
+                for i, data in correct.items():
+                    serving.fast_plane.cache_put(vid, lost_sid, i, data)
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    st, _, body = raw_get(serving.fast_url, f"/{hot}")
+                except Exception as e:  # noqa: BLE001 - assert below
+                    errors.append(f"read: {e}")
+                    continue
+                if st == 200:
+                    if body != want:
+                        errors.append(f"WRONG BYTES: {body[:32]!r}")
+                        stop.set()
+                    hits[0] += 1
+                elif st == 307:
+                    misses[0] += 1
+                else:
+                    errors.append(f"status {st}")
+
+        threads = ([threading.Thread(target=publisher)] +
+                   [threading.Thread(target=reader) for _ in range(4)])
+        for t in threads:
+            t.start()
+        time.sleep(3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(not t.is_alive() for t in threads), "thread leaked"
+        wrong = [e for e in errors if e.startswith("WRONG")]
+        assert not wrong, wrong
+        assert not errors, errors[:5]
+        assert hits[0] > 100, (hits, misses)
+
+
+# Frozen ABI manifest: every symbol http_plane.cc exports. Adding an
+# export without extending this list (and binding it in native_plane.py)
+# fails both this test and tools/analyze.py's plane-abi lint.
+PLANE_ABI = (
+    "swhp_start", "swhp_port", "swhp_stop",
+    "swhp_add_volume", "swhp_remove_volume",
+    "swhp_put", "swhp_put_bulk", "swhp_delete", "swhp_lookup",
+    "swhp_enable_writer", "swhp_disable_writer",
+    "swhp_set_accept_posts", "swhp_append", "swhp_writer_counters",
+    "swhp_served", "swhp_redirected", "swhp_written",
+    "swhp_stats_len", "swhp_stats", "swhp_lat_bounds",
+    "swhp_set_stats_enabled", "swhp_set_slow_us", "swhp_slow_ring",
+    "swhp_ec_register", "swhp_ec_set_shard", "swhp_ec_put_bulk",
+    "swhp_ec_delete", "swhp_ec_unregister",
+    "swhp_cache_configure", "swhp_cache_put", "swhp_cache_invalidate",
+    "swhp_cache_stats_len", "swhp_cache_stats",
+)
+
+
+def test_abi_manifest_complete_and_bound():
+    """The loaded library exposes every manifest symbol, and the source
+    exports exactly the manifest — an unbound or untracked export is a
+    signature change waiting to crash at runtime."""
+    import os
+    import re
+    from seaweedfs_tpu.server import native_plane
+    lib = native_plane._load()
+    missing = [s for s in PLANE_ABI if not hasattr(lib, s)]
+    assert not missing, f"manifest symbols absent from .so: {missing}"
+    cc = os.path.join(os.path.dirname(native_plane.__file__),
+                      "native", "http_plane.cc")
+    with open(cc, encoding="utf-8") as f:
+        src = f.read()
+    block = src[src.index('extern "C" {'):]
+    exported = set(re.findall(
+        r'^[A-Za-z_][A-Za-z0-9_* ]*?\b(swhp_[a-z0-9_]+)\s*\(',
+        block, re.M))
+    assert exported == set(PLANE_ABI), (
+        exported ^ set(PLANE_ABI),
+        "exports drifted from the manifest")
+
+
+def test_admin_plane_cache_endpoint(cluster):
+    """GET /admin/plane/cache: the slab-cache books through the Python
+    server, so operators can see budget/occupancy without a scrape."""
+    master, vs = cluster
+    view = get_json(f"http://{vs.url}/admin/plane/cache")
+    assert view["plane"] is True
+    assert set(view["cache"]) >= {"puts", "hits", "misses", "entries",
+                                  "bytes", "max_bytes",
+                                  "degraded_served"}
+    assert view["cache"]["max_bytes"] > 0
