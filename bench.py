@@ -668,6 +668,22 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
         # two-dispatch slabs shows here before it shows in wall time
         stream_s = timings.get("stream_s", 0.0)
         survivor_bytes = timings.get("survivor_bytes", 0)
+        # mesh-sharded dispatch width: recompute from the per-device
+        # byte map (survives _merge_rebuild_stats' dict overwrite
+        # semantics) with the rebuilder's derived value as fallback —
+        # width 1 here means the codec fell back to a single device and
+        # the "one dispatch drives all devices" property regressed
+        mesh_bytes = {d: b for d, b in
+                      (timings.get("mesh_device_bytes") or {}).items()
+                      if b}
+        if mesh_bytes:
+            peak = max(mesh_bytes.values())
+            width_devices = len(mesh_bytes)
+            busy_frac = {d: round(b / peak, 3)
+                         for d, b in sorted(mesh_bytes.items())}
+        else:
+            width_devices = timings.get("dispatch_width_devices", 0)
+            busy_frac = timings.get("device_busy_frac", {})
 
         # -- single-shard repair drill: the overwhelmingly common
         # failure at fleet scale. Destroy exactly ONE shard and rebuild
@@ -684,9 +700,13 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                   f"&shards={lone_sid}")
         post_json(f"http://{lone_holder}/admin/ec/delete_shards"
                   f"?volume={vid}&collection=bench&shards={lone_sid}")
+        # a lone-held shard vanishes from the lookup map entirely once
+        # its only holder drops it (lookup_ec_shards omits empty holder
+        # lists), so "key absent" IS the loss signal — a [lone_holder]
+        # default here would wait forever
         shard_map2 = poll(
             lambda: (lambda m: m if lone_holder not in
-                     m.get(lone_sid, [lone_holder]) else None)(
+                     m.get(lone_sid, []) else None)(
                 lookup_shards()),
             "single-shard loss at the master")
         repair_timings = {}
@@ -725,6 +745,9 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                "gathered_shards": timings.get("gathered_shards", 0),
                "dispatches": timings.get("dispatches", 0),
                "bitmat_uploads": timings.get("bitmat_uploads", 0),
+               "mesh_dispatches": timings.get("mesh_dispatches", 0),
+               "dispatch_width_devices": width_devices,
+               "device_busy_frac": busy_frac,
                "rebuild_device_mbps": round(
                    survivor_bytes / stream_s / 1e6) if stream_s else 0,
                # streaming-gather overlap accounting: gather_s/compute_s

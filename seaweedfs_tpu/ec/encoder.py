@@ -155,27 +155,40 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                        timer)
     outs = [] if sink is not None else \
         [open(base_name + to_ext(i), "wb") for i in range(k + m)]
+    # device-parallel compute feeding holder-parallel network: with a
+    # piecewise-draining codec (mesh) and a sink, each device shard's
+    # parity piece is routed to the per-target send queues the moment
+    # its d2h lands — the host never stages the full (m, slab) output
+    pieces = pipelined and sink is not None and \
+        hasattr(codec, "drain_pieces")
     try:
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
             pm = PipelinedMatmul(codec.matrix[k:], max_width=slab,
-                                 timer=timer, codec=codec)
+                                 timer=timer, codec=codec, pieces=pieces)
             stream = pm.stream(_coalesce_slabs(slabs, slab))
         else:
             stream = ((meta, data, codec.encode(data))
                       for meta, data in slabs)
         for _, data, parity in stream:
             t0 = time.perf_counter()
-            if sink is not None:
+            if pieces:
+                nbytes = 0
+                for lo, piece in parity:
+                    pw = piece.shape[1]
+                    sink.write_stripe(data[:, lo:lo + pw], piece)
+                    nbytes += k * pw + piece.nbytes
+            elif sink is not None:
                 sink.write_stripe(data, parity)
+                nbytes = data.nbytes + parity.nbytes
             else:
                 for i in range(k):
                     outs[i].write(data[i].tobytes())
                 for j in range(m):
                     outs[k + j].write(parity[j].tobytes())
+                nbytes = data.nbytes + parity.nbytes
             end = time.perf_counter()
-            timer.add("shard_write", end - t0,
-                      data.nbytes + parity.nbytes, interval=(t0, end))
+            timer.add("shard_write", end - t0, nbytes, interval=(t0, end))
     finally:
         for o in outs:
             o.close()
@@ -331,12 +344,15 @@ def rebuild_ec_files(base_name: str,
             coeffs = _rebuild_coeffs(codec, present, missing)
             phases["plan"] = time.perf_counter() - t0
             ptimer = StageTimer()
+            # pieces: device-shard outputs drain and append to the
+            # missing-shard files per device, no full-slab host staging
             pm = PipelinedMatmul(coeffs, max_width=slab, codec=codec,
-                                 timer=ptimer)
-            for _, _, out in pm.stream(survivor_slabs()):
+                                 timer=ptimer, pieces=True)
+            for _, _, parts in pm.stream(survivor_slabs()):
                 t0 = time.perf_counter()
-                for r, i in enumerate(missing):
-                    outs[i].write(out[r].tobytes())
+                for _, piece in parts:
+                    for r, i in enumerate(missing):
+                        outs[i].write(piece[r].tobytes())
                 phases["write"] += time.perf_counter() - t0
             # consumer-side accounting: the stream loop's time splits
             # into waiting for survivor reads (gather), h2d puts
@@ -437,13 +453,16 @@ def rebuild_ec_files_streaming(base_name: str,
         if pipelined:
             from ..ops.pipeline import PipelinedMatmul
             ptimer = StageTimer()
+            # pieces, same as rebuild_ec_files: the sharded decode's
+            # per-device outputs append as they land
             pm = PipelinedMatmul(coeffs, max_width=slab, codec=codec,
-                                 timer=ptimer)
-            for _, _, out in pm.stream(source.slabs()):
+                                 timer=ptimer, pieces=True)
+            for _, _, parts in pm.stream(source.slabs()):
                 t0 = time.perf_counter()
-                for r, i in enumerate(missing):
-                    outs[i].write(out[r].tobytes())
-                    rebuilt_bytes += out[r].nbytes
+                for _, piece in parts:
+                    for r, i in enumerate(missing):
+                        outs[i].write(piece[r].tobytes())
+                        rebuilt_bytes += piece[r].nbytes
                 phases["write"] += time.perf_counter() - t0
             # consumer-side accounting, same discipline as
             # rebuild_ec_files: read_wait is the time this thread spent

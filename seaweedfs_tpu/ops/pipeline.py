@@ -56,7 +56,7 @@ class PipelinedMatmul:
                  max_width: int = 32 << 20, depth: int = 4,
                  prefetch: int = 3, drain_threads: int = 2,
                  timer: Optional[StageTimer] = None,
-                 codec=None):
+                 codec=None, pieces: bool = False):
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         self.r, self.k = coeffs.shape
         self.max_width = int(max_width)
@@ -65,6 +65,12 @@ class PipelinedMatmul:
         self.drain_threads = int(drain_threads)
         self.timer = timer  # optional per-stage breakdown (bench/profiling)
         self.codec = codec  # device fn + shardings come from the codec
+        # pieces mode: stream() yields (meta, data, [(col_off, piece)])
+        # instead of one (r, w) array — mesh-sharded outputs drain one
+        # piece per device shard (codec.drain_pieces) so consumers start
+        # on the first device's stripes without the host ever staging
+        # the full slab; codecs without drain_pieces yield one piece
+        self.pieces = bool(pieces)
         self._coeffs = coeffs
         self._bitmat_dev = None
         self._put = None
@@ -122,14 +128,21 @@ class PipelinedMatmul:
         drain_pool = ThreadPoolExecutor(max_workers=self.drain_threads)
         pending: deque = deque()
         timer = self.timer
+        drain_pieces = getattr(self.codec, "drain_pieces", None) \
+            if self.pieces else None
 
-        def fetch(out, nbytes):
-            if timer is None:
-                return np.asarray(out)
-            t = time.perf_counter()
-            host = np.asarray(out)
-            end = time.perf_counter()
-            timer.add("d2h+mxu", end - t, nbytes, interval=(t, end))
+        def fetch(out, nbytes, w):
+            t = time.perf_counter() if timer is not None else 0.0
+            if drain_pieces is not None:
+                host = drain_pieces(out, w)
+            elif self.pieces:
+                full = np.asarray(out)
+                host = [(0, full[:, :w] if full.shape[1] > w else full)]
+            else:
+                host = np.asarray(out)
+            if timer is not None:
+                end = time.perf_counter()
+                timer.add("d2h+mxu", end - t, nbytes, interval=(t, end))
             return host
 
         try:
@@ -162,7 +175,7 @@ class PipelinedMatmul:
                 STATS.add("dispatches")
                 STATS.add("device_bytes", data.nbytes)
                 out = fn(self._bitmat_dev, dev)      # async dispatch
-                fut = drain_pool.submit(fetch, out, self.r * bucket)
+                fut = drain_pool.submit(fetch, out, self.r * bucket, w)
                 pending.append((meta, data, fut, w))
                 if len(pending) >= self.depth:
                     yield self._drain(pending.popleft())
@@ -188,6 +201,8 @@ class PipelinedMatmul:
         full = fut.result()  # blocks until device + d2h complete
         if self.timer is not None:
             self.timer.add("drain_wait", time.perf_counter() - t0)
+        if self.pieces:
+            return meta, data, full  # already clipped to w by fetch
         if full.shape[1] != w:
             full = full[:, :w]
         return meta, data, full

@@ -9,11 +9,21 @@ process-global counter, and rebuild_ec_files / bench.py report the
 deltas (`dispatches`, `bitmat_uploads`) so a regression back to
 per-slab uploads shows up in `vs_baseline` instead of hiding inside
 wall time.
+
+Mesh-sharded dispatches additionally record which devices a put
+actually landed bytes on (`mesh_dispatches`, per-device byte map).
+That is the width guard: a MeshCodec built over a width-1 mesh (or a
+crossover silently routing everything to the single-device path)
+compiles, runs, and is bit-identical — only the per-device byte map
+distinguishes it from a dispatch that saturated the mesh, so
+`delta()` derives `dispatch_width_devices` / `device_busy_frac` from
+it and the bench asserts on them.
 """
 
 from __future__ import annotations
 
-import threading
+from typing import Dict
+
 from ..util.locks import make_lock
 
 
@@ -21,26 +31,58 @@ class DispatchStats:
     """Monotonic process-global counters (thread-safe)."""
 
     _FIELDS = ("dispatches", "bitmat_uploads", "host_fallbacks",
-               "device_bytes")
+               "device_bytes", "mesh_dispatches")
 
     def __init__(self):
         self._lock = make_lock("telemetry._lock")
         for f in self._FIELDS:
             setattr(self, f, 0)
+        self._mesh_device_bytes: Dict[str, int] = {}
 
     def add(self, field: str, n: int = 1):
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
 
+    def add_mesh_device_bytes(self, device: str, n: int):
+        """Payload bytes a sharded put landed on one device."""
+        with self._lock:
+            self._mesh_device_bytes[device] = \
+                self._mesh_device_bytes.get(device, 0) + n
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {f: getattr(self, f) for f in self._FIELDS}
+            snap = {f: getattr(self, f) for f in self._FIELDS}
+            snap["mesh_device_bytes"] = dict(self._mesh_device_bytes)
+            return snap
 
 
 STATS = DispatchStats()
 
 
 def delta(before: dict) -> dict:
-    """Counter movement since a snapshot() — the per-operation report."""
+    """Counter movement since a snapshot() — the per-operation report.
+
+    Besides the raw field deltas, derives the mesh width facts the
+    bench guards on: `dispatch_width_devices` (devices a sharded put
+    landed bytes on during the window; 1 when only single-device
+    dispatches ran, 0 when none did) and `device_busy_frac` (each
+    device's byte share relative to the busiest — 1.0 everywhere means
+    a perfectly even shard split)."""
     now = STATS.snapshot()
-    return {f: now[f] - before.get(f, 0) for f in DispatchStats._FIELDS}
+    out = {f: now[f] - before.get(f, 0) for f in DispatchStats._FIELDS}
+    before_dev = before.get("mesh_device_bytes", {})
+    per_dev = {}
+    for dev, n in now["mesh_device_bytes"].items():
+        moved = n - before_dev.get(dev, 0)
+        if moved > 0:
+            per_dev[dev] = moved
+    out["mesh_device_bytes"] = per_dev
+    if per_dev:
+        peak = max(per_dev.values())
+        out["dispatch_width_devices"] = len(per_dev)
+        out["device_busy_frac"] = {d: round(n / peak, 4)
+                                   for d, n in sorted(per_dev.items())}
+    else:
+        out["dispatch_width_devices"] = 1 if out["dispatches"] > 0 else 0
+        out["device_busy_frac"] = {}
+    return out

@@ -32,3 +32,26 @@ def make_mesh(shape: Optional[Tuple[int, ...]] = None,
         raise ValueError(f"mesh shape {shape} != {n} devices")
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, axis_names=tuple(axis_names[: len(shape)]))
+
+
+def make_codec_mesh(devices=None, width_devices: Optional[int] = None):
+    """Mesh for MeshCodec dispatches: EVERY device on the 'data'
+    (stripe-width) axis.
+
+    The default make_mesh layout reserves half the devices for the
+    'shard' axis (output sharding / psum paths), which is right for the
+    distributed-rebuild programs but halves the width parallelism of a
+    codec dispatch — the payload axis is the only one a plain
+    encode/decode matmul shards over, so a (4, 2) mesh left 4 of 8
+    devices idle on every MeshCodec call. Width is capped by
+    SW_EC_MESH_WIDTH_DEVICES (0 = all visible devices).
+    """
+    import jax
+    from ..util import config
+
+    devices = list(devices if devices is not None else jax.devices())
+    cap = (int(width_devices) if width_devices is not None
+           else config.env_int("SW_EC_MESH_WIDTH_DEVICES"))
+    width = len(devices) if cap <= 0 else min(cap, len(devices))
+    return make_mesh(shape=(width, 1), axis_names=("data", "shard"),
+                     devices=devices[:width])

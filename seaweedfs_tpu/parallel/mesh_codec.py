@@ -28,6 +28,16 @@ async — blocking np.asarray per chunk serializes compute against d2h),
 and the pipelined encode/rebuild path streams slabs through device_fn()
 with bounded in-flight depth (ops/pipeline.PipelinedMatmul).
 
+Width discipline (the round-16 lesson): the codec mesh puts EVERY
+device on the 'data' axis (mesh.make_codec_mesh — the default
+(n/2, 2) layout exists for the psum rebuild programs and would idle
+half the mesh here), slabs below the SW_EC_MESH_SHARD_MIN_BYTES
+payload crossover keep the single-device kernel (sharding a
+kilobyte-wide reconstruct pays partitioning overhead it can't
+amortize), and every sharded put records its per-device byte landing
+in ops/telemetry so a silent fall-back to width-1 dispatch is a
+visible counter regression, not a 74 -> 2 MB/s surprise.
+
 This is the serving-path face of SURVEY §2.6's device tier: the same
 sharded programs the driver dry-runs via __graft_entry__ become the
 volume server's encode/rebuild engine.
@@ -43,7 +53,8 @@ from ..ops import gf256
 from ..ops.codec import ReedSolomonCodec, _ConstCache, small_dispatch_default
 from ..ops.rs_tpu import width_bucket
 from ..ops.telemetry import STATS
-from .mesh import make_mesh
+from ..util import config
+from .mesh import make_codec_mesh
 
 
 class MeshCodec(ReedSolomonCodec):
@@ -52,7 +63,8 @@ class MeshCodec(ReedSolomonCodec):
     def __init__(self, data_shards: int, parity_shards: int,
                  matrix_kind: str = "vandermonde", mesh=None,
                  chunk_bytes: int = 32 << 20,
-                 small_dispatch_bytes: int = None):
+                 small_dispatch_bytes: int = None,
+                 mesh_shard_min_bytes: int = None):
         super().__init__(data_shards, parity_shards, matrix_kind)
         self.chunk_bytes = int(chunk_bytes)
         self._mesh = mesh  # lazy: devices may not be initialized yet
@@ -60,12 +72,21 @@ class MeshCodec(ReedSolomonCodec):
         self.small_dispatch_bytes = (
             small_dispatch_default() if small_dispatch_bytes is None
             else int(small_dispatch_bytes))
+        # payload bytes (k * width) below which a dispatch keeps the
+        # single-device path: sharding a small slab pays partitioning
+        # overhead on every device without enough columns to amortize it
+        self.mesh_shard_min_bytes = (
+            config.env_int("SW_EC_MESH_SHARD_MIN_BYTES")
+            if mesh_shard_min_bytes is None else int(mesh_shard_min_bytes))
         self._consts = _ConstCache()
 
     @property
     def mesh(self):
         if self._mesh is None:
-            self._mesh = make_mesh()
+            # ALL devices on the width axis — the default make_mesh
+            # (data, shard) = (n/2, 2) layout is for the psum rebuild
+            # programs and would leave half the mesh idle here
+            self._mesh = make_codec_mesh()
         return self._mesh
 
     def _on_tpu_mesh(self) -> bool:
@@ -147,21 +168,67 @@ class MeshCodec(ReedSolomonCodec):
             return jax.device_put(
                 host, NamedSharding(self.mesh, P(None, None)))
 
-        return self._consts.get(coeffs.tobytes(), make)
+        return self._consts.get((coeffs.tobytes(), "mesh"), make)
 
     def _put(self, data: np.ndarray):
+        """Sharded h2d: the width axis splits over 'data', and the
+        per-device landing is recorded so a silent fall-back to a
+        width-1 dispatch is visible in telemetry, not just wall time."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        return jax.device_put(
+        arr = jax.device_put(
             data, NamedSharding(self.mesh, P(None, "data")))
+        STATS.add("mesh_dispatches")
+        for shard in arr.addressable_shards:
+            STATS.add_mesh_device_bytes(str(shard.device),
+                                        shard.data.nbytes)
+        return arr
+
+    def _single_device_fn(self, coeffs: np.ndarray, width: int):
+        """The current single-device path (fused Pallas on TPU, packed
+        popcount XLA elsewhere — ops/rs_tpu.fn_and_bitmat), for
+        dispatches too small to amortize mesh partitioning."""
+        import jax.numpy as jnp
+        from ..ops.rs_tpu import fn_and_bitmat
+        fn, const_host = fn_and_bitmat(coeffs, width)
+        const = self._consts.get((coeffs.tobytes(), "single"),
+                                 lambda: jnp.asarray(const_host))
+        return fn, const, jnp.asarray
 
     def device_fn(self, coeffs: np.ndarray, width: int):
         """Streaming hook for PipelinedMatmul: (fn, resident const,
         put). `width` must come from pipeline_width_bucket (even shard
-        split over 'data')."""
+        split over 'data'). Below the SW_EC_MESH_SHARD_MIN_BYTES
+        payload crossover (k * width) the single-device kernel is
+        returned instead of the sharded program."""
         coeffs = np.ascontiguousarray(coeffs, dtype=np.uint8)
         r, k = coeffs.shape
+        if k * width < self.mesh_shard_min_bytes or \
+                self.mesh.shape["data"] <= 1:
+            return self._single_device_fn(coeffs, width)
         return self._fn(k, r, width), self._device_const(coeffs), self._put
+
+    def drain_pieces(self, out_dev, w: int):
+        """Host pieces of a device output in width order: list of
+        (col_offset, (r, piece_w) np.ndarray) covering [0, w). Sharded
+        outputs drain one piece per device shard — consumers (the
+        spread sink's per-target workers, rebuild shard writes) start
+        on the first device's stripes without staging the full slab on
+        the host; single-device outputs come back as one piece."""
+        shards = getattr(out_dev, "addressable_shards", None) or []
+        by_off = {}
+        for shard in shards:
+            lo = shard.index[1].start or 0
+            if lo >= w or lo in by_off:  # clip tail pad; dedupe replicas
+                continue
+            piece = np.asarray(shard.data)
+            if lo + piece.shape[1] > w:
+                piece = piece[:, : w - lo]
+            by_off[lo] = piece
+        if not by_off:
+            full = np.asarray(out_dev)
+            return [(0, full[:, :w] if full.shape[1] > w else full)]
+        return sorted(by_off.items())
 
     def pipeline_width_bucket(self, n: int, cap: int) -> int:
         bucket = width_bucket(n, cap)
@@ -183,7 +250,6 @@ class MeshCodec(ReedSolomonCodec):
         if n == 0:
             return np.zeros((r, 0), dtype=np.uint8)
         from ..util import tracing
-        bitmat = self._device_const(coeffs)
         out = np.empty((r, n), dtype=np.uint8)
         step = self.chunk_bytes
         # dispatch all chunks, then drain: the async dispatches overlap
@@ -194,7 +260,7 @@ class MeshCodec(ReedSolomonCodec):
                 end = min(off + step, n)
                 w = end - off
                 bucket = self._width_bucket(w)
-                fn = self._fn(k, r, bucket)
+                fn, bitmat, put = self.device_fn(coeffs, bucket)
                 if w < bucket:  # zero-pad: GF-linear, so exact
                     padded = np.zeros((k, bucket), dtype=np.uint8)
                     padded[:, :w] = data[:, off:end]
@@ -202,7 +268,7 @@ class MeshCodec(ReedSolomonCodec):
                     padded = data[:, off:end]
                 STATS.add("dispatches")
                 STATS.add("device_bytes", w * k)
-                pending.append((off, end, fn(bitmat, self._put(padded))))
+                pending.append((off, end, fn(bitmat, put(padded))))
         with tracing.span("drain", backend="mesh", bytes=int(n * r)):
             for off, end, dev in pending:
                 out[:, off:end] = np.asarray(dev)[:, : end - off]

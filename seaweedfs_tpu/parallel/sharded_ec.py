@@ -120,10 +120,12 @@ def sharded_rebuild_fn(mesh, k: int, n_out_shards: int, n: int):
         return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
 
     # jax.shard_map only exists from 0.5; fall back to the experimental
-    # home it had before that
-    try:
+    # home it had before that — gated on the same capability probe the
+    # DCN-tier test uses, so shim and test retire together
+    from .multihost import has_native_shard_map
+    if has_native_shard_map():
         shard_map = jax.shard_map
-    except AttributeError:
+    else:
         from jax.experimental.shard_map import shard_map
     smap = shard_map(
         local, mesh=mesh,

@@ -649,7 +649,10 @@ class VolumeServer:
         from ..stats.metrics import (DEVICE_TELEMETRY_COUNTER,
                                      HTTP_POOL_CHURN_COUNTER)
         for kind, total in telemetry.STATS.snapshot().items():
-            DEVICE_TELEMETRY_COUNTER.set_total(total, kind)
+            # the per-device mesh byte map exports via its own labeled
+            # family (observe_mesh), not the flat kind counter
+            if isinstance(total, (int, float)):
+                DEVICE_TELEMETRY_COUNTER.set_total(total, kind)
         # connection-pool churn (process-global, same mirror pattern)
         from .http_util import pool_stats_snapshot
         for event, total in pool_stats_snapshot().items():
@@ -826,7 +829,7 @@ class VolumeServer:
         except ValueError:
             raise HttpError(400, "bad JSON body") from None
         if isinstance(body, dict) and body.get("assignment"):
-            from ..stats.metrics import observe_spread
+            from ..stats.metrics import observe_mesh, observe_spread
             from ..util import tracing
             stats: dict = {}
             base, final = self.store.generate_ec_shards_streaming(
@@ -837,6 +840,7 @@ class VolumeServer:
                 window=int(body.get("window") or 0) or None,
                 stats=stats)
             observe_spread(stats)
+            observe_mesh(stats)
             return {"volume": vid, "base": os.path.basename(base),
                     "assignment": {str(s): u for s, u in final.items()},
                     "stats": stats,
@@ -947,7 +951,8 @@ class VolumeServer:
         — when the POST body carries ``sources`` ({shard: [holders]}) —
         the streaming striped gather: survivor ranges are pulled and
         decoded in overlapped slabs, never landing whole on disk."""
-        from ..stats.metrics import observe_gather, observe_repair
+        from ..stats.metrics import (observe_gather, observe_mesh,
+                                     observe_repair)
         from ..util import tracing
         vid = int(req.query["volume"])
         collection = req.query.get("collection", "")
@@ -967,6 +972,7 @@ class VolumeServer:
                 repair=str(body.get("repair") or "auto"))
             observe_gather(stats)
             observe_repair(stats)
+            observe_mesh(stats)
         else:
             rebuilt = self.store.rebuild_ec_shards(
                 vid, collection, stats=stats)
@@ -995,7 +1001,8 @@ class VolumeServer:
         file so it cannot serve reads or feed a decode, then stream a
         fresh copy from the surviving k. Driven by the master's repair
         queue when a scrub finding names this holder."""
-        from ..stats.metrics import observe_gather, observe_repair
+        from ..stats.metrics import (observe_gather, observe_mesh,
+                                     observe_repair)
         from ..util import tracing
         vid = int(req.query["volume"])
         sid = int(req.query["shard"])
@@ -1024,6 +1031,7 @@ class VolumeServer:
             repair=str(body.get("repair") or "auto"))
         observe_gather(stats)
         observe_repair(stats)
+        observe_mesh(stats)
         mounted = self.store.mount_ec_shards(vid, collection, rebuilt) \
             if rebuilt else []
         self._invalidate_reconstructions(vid, rebuilt or [sid])

@@ -631,6 +631,48 @@ def observe_gather(stats: Dict):
         VOLUME_EC_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
 
 
+# -- mesh-sharded dispatch (ops/telemetry deltas via observe_mesh) -----------
+
+VOLUME_EC_MESH_DISPATCH_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_mesh_dispatches_total",
+    "Mesh-sharded device dispatches: one jit call whose payload width "
+    "axis spans the device mesh (single-device crossover dispatches "
+    "are counted under ec_device_telemetry_total only).")
+VOLUME_EC_MESH_WIDTH_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_mesh_dispatch_width_devices",
+    "Devices the last mesh EC operation's dispatches landed bytes on "
+    "(1 = silent fall-back to width-1 dispatch — the r05 regression "
+    "mode this gauge exists to catch).")
+VOLUME_EC_MESH_DEVICE_BYTES = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_mesh_device_bytes_total",
+    "Payload bytes landed on each mesh device by sharded dispatches.",
+    labels=("device",))
+VOLUME_EC_MESH_BUSY_FRAC_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_mesh_device_busy_frac",
+    "Per-device byte share of the last mesh EC operation relative to "
+    "the busiest device (1.0 everywhere = even shard split).",
+    labels=("device",))
+
+
+def observe_mesh(stats: Dict):
+    """Export one EC operation's mesh-dispatch telemetry (the
+    ops/telemetry.delta keys inside the stats dict filled by the
+    encode/rebuild paths) onto the volume registry."""
+    if not stats:
+        return
+    n = stats.get("mesh_dispatches")
+    if n:
+        VOLUME_EC_MESH_DISPATCH_COUNTER.inc(amount=n)
+    for dev, nbytes in (stats.get("mesh_device_bytes") or {}).items():
+        if nbytes:
+            VOLUME_EC_MESH_DEVICE_BYTES.inc(str(dev), amount=nbytes)
+    width = stats.get("dispatch_width_devices")
+    if width:
+        VOLUME_EC_MESH_WIDTH_GAUGE.set(width)
+    for dev, frac in (stats.get("device_busy_frac") or {}).items():
+        VOLUME_EC_MESH_BUSY_FRAC_GAUGE.set(frac, str(dev))
+
+
 # -- trace repair (ec/decoder.rebuild_ec_file_repair via observe_repair) -----
 
 VOLUME_EC_REPAIR_COUNTER = VOLUME_SERVER_GATHER.counter(

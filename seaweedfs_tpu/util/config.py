@@ -100,6 +100,12 @@ _knob("SW_EC_SMALL_DISPATCH_BYTES", "int", 256 << 10,
 _knob("SW_EC_SMALL_DISPATCH_AUTO", "bool", False,
       "Let the tuner's fitted host/device crossover supersede "
       "SW_EC_SMALL_DISPATCH_BYTES live.")
+_knob("SW_EC_MESH_SHARD_MIN_BYTES", "int", 1 << 20,
+      "Slab payload bytes (k * width) below which the mesh backend "
+      "dispatches on one device instead of sharding the width axis.")
+_knob("SW_EC_MESH_WIDTH_DEVICES", "int", 0,
+      "Cap on devices the mesh codec puts on its width axis; 0 uses "
+      "every visible device.")
 _knob("SW_EC_GATHER_WINDOW", "int", 4,
       "Bounded in-flight stripe prefetch window for streaming gathers.")
 _knob("SW_EC_GATHER_MODE", "str", "stream",

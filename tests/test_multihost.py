@@ -13,6 +13,12 @@ import sys
 
 import pytest
 
+from seaweedfs_tpu.parallel.multihost import (has_native_shard_map,
+                                              jax_version,
+                                              multihost_cpu_capability)
+
+_CAP_OK, _CAP_WHY = multihost_cpu_capability()
+
 _CHILD = r"""
 import json, os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -35,8 +41,20 @@ def _free_port():
     return port
 
 
+def test_capability_probe_is_consistent():
+    """The probe that gates the DCN test and the sharded_ec shard_map
+    shim must agree with the build it inspects: a jax with top-level
+    shard_map IS the >= 0.5 line that grew multiprocess CPU
+    collectives, and a False verdict must carry a reason."""
+    ok, why = multihost_cpu_capability()
+    assert ok == (jax_version() >= (0, 5))
+    assert ok == has_native_shard_map()
+    assert ok or why
+
+
 @pytest.mark.skipif(os.environ.get("SW_MULTIHOST_TESTS", "1") == "0",
                     reason="disabled by SW_MULTIHOST_TESTS=0")
+@pytest.mark.skipif(not _CAP_OK, reason=_CAP_WHY or "capable")
 def test_two_process_mesh_runs_ec_step(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -60,11 +78,10 @@ def test_two_process_mesh_runs_ec_step(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    # no output-sniffing skip here: multihost_cpu_capability() decided
+    # up front that this build CAN run multiprocess CPU collectives, so
+    # a failure now is a real failure
     for pid, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0 and \
-                "aren't implemented on the CPU backend" in out:
-            pytest.skip("this jax build has no multiprocess CPU "
-                        "collectives")
         assert p.returncode == 0, \
             f"process {pid} failed:\n{out[-2000:]}"
     results = []
