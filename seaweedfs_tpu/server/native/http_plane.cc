@@ -42,7 +42,9 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/resource.h>
 #include <sys/socket.h>
+#include <sys/syscall.h>
 #include <sys/types.h>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -50,10 +52,13 @@
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <deque>
 #include <list>
 #include <memory>
 #include <mutex>
@@ -213,6 +218,43 @@ int parse_needle(const uint8_t* blob, size_t len, int version,
 }
 
 // ---------------------------------------------------------------- server
+struct Server;
+
+// One group-commit rider whose HTTP ack the committer sends after the
+// covering fdatasync: holds a dup of the connection fd (owned — closed
+// on destruction), the pre-built 200 response, and a 307 fallback for
+// the poison path. t0_us/bytes/target feed per-request telemetry; t0_us
+// is 0 when stats were off at request start (clock-free discipline).
+struct DeferredAck {
+  int fd = -1;
+  uint64_t seq = 0;
+  std::string resp;      // full HTTP bytes of the success ack
+  std::string fallback;  // full HTTP bytes of the 307 poison redirect
+  uint64_t t0_us = 0;
+  uint64_t bytes = 0;
+  std::string target;
+  DeferredAck() = default;
+  DeferredAck(const DeferredAck&) = delete;
+  DeferredAck& operator=(const DeferredAck&) = delete;
+  DeferredAck(DeferredAck&& o) noexcept { *this = std::move(o); }
+  DeferredAck& operator=(DeferredAck&& o) noexcept {
+    if (this == &o) return *this;
+    if (fd >= 0) close(fd);
+    fd = o.fd;
+    o.fd = -1;
+    seq = o.seq;
+    resp = std::move(o.resp);
+    fallback = std::move(o.fallback);
+    t0_us = o.t0_us;
+    bytes = o.bytes;
+    target = std::move(o.target);
+    return *this;
+  }
+  ~DeferredAck() {
+    if (fd >= 0) close(fd);
+  }
+};
+
 // Write lease for one volume: fds + append offset + counter deltas.
 // While enabled, every .dat/.idx append (fast-path POSTs AND Python's
 // delegated writes via swhp_append) serializes on `mu`; disabling takes
@@ -234,7 +276,56 @@ struct Writer {
   std::atomic<uint64_t> puts{0}, put_bytes{0};
   std::atomic<uint64_t> deletes{0}, deleted_bytes{0};
   std::atomic<uint64_t> max_key{0};
+
+  // -- group-commit durability (SW_PLANE_FSYNC_MODE). In group mode a
+  // dedicated committer amortizes ONE fdatasync over every append that
+  // landed inside the commit window; an append is acked only after the
+  // fdatasync covering its sequence number returned. `sync_mu` is the
+  // INNER lock (taken with `mu` held to publish a sequence, and alone
+  // by the committer/waiters — the committer never takes `mu`).
+  int sync_mode = 0;        // 0 off, 1 group, 2 always; frozen at enable
+  uint64_t batch_us = 2000;     // commit window (SW_PLANE_FSYNC_BATCH_US)
+  uint64_t max_pending = 512;   // riders forcing an early commit
+  Server* srv = nullptr;        // telemetry sink (server-global counters)
+  int sync_dat_fd = -1, sync_idx_fd = -1;  // committer's dup'd fds
+  std::mutex sync_mu;
+  std::condition_variable sync_cv;  // wakes the committer
+  // riders wait on the cv matching their batch's parity, so a commit
+  // wakes only its own cohort — one shared cv would spuriously wake
+  // (and context-switch) every rider of the batch still accumulating
+  std::condition_variable ack_cv[2];
+  // Deferred acks: the common-case rider doesn't block at all — it
+  // leaves a pre-built response (and a poison fallback) with the
+  // committer, which sends it once the covering fdatasync returns.
+  // Owns a dup of the connection fd so the conn thread's own
+  // lifecycle (close on hangup/non-keepalive) can't race the send.
+  std::deque<DeferredAck> deferred;  // seq-ordered, under sync_mu
+  uint64_t sync_gen = 0;     // open commit generation (under sync_mu)
+  uint64_t append_seq = 0;   // last sequence appended (under mu+sync_mu)
+  uint64_t synced_seq = 0;   // last sequence covered by an fdatasync
+  bool sync_failed = false;  // poisoned: an fdatasync failed — fail-stop
+  bool committer_stop = false;
+  std::thread committer;
+
+  // Idempotent committer teardown: the committer drains every pending
+  // sequence with a FINAL fdatasync before exiting, so appends enqueued
+  // before the stop get durable acks rather than hanging; appends that
+  // arrive after see committer_stop and poison themselves (-5).
+  void stop_committer() {
+    {
+      std::lock_guard<std::mutex> sg(sync_mu);
+      committer_stop = true;
+      sync_cv.notify_all();
+    }
+    if (committer.joinable()) committer.join();
+  }
+
   ~Writer() {
+    stop_committer();
+    // the committer closes its dups at loop exit; these remain only
+    // when enable failed before the thread spawned
+    if (sync_dat_fd >= 0) close(sync_dat_fd);
+    if (sync_idx_fd >= 0) close(sync_idx_fd);
     if (fd >= 0) close(fd);
     if (idx_fd >= 0) close(idx_fd);
   }
@@ -385,6 +476,13 @@ struct PlaneStats {
 // makes them race-free.
 thread_local int tl_status = 0;
 thread_local uint64_t tl_bytes = 0;
+// group-commit deferral: serve_write sets tl_deferred when it handed
+// its ack to the committer (handle_conn must not record telemetry —
+// the committer records the full request latency at send time); tl_t0
+// carries the request clock start into the deferred entry (0 when the
+// stats were off at request start)
+thread_local bool tl_deferred = false;
+thread_local uint64_t tl_t0 = 0;
 
 uint64_t mono_us() {
   struct timespec ts;
@@ -424,6 +522,22 @@ struct Server {
   std::atomic<uint64_t> ec_degraded_redirected{0};
   std::atomic<uint64_t> ec_local_served{0};
 
+  // group-commit durability config (swhp_set_sync_mode; applied to
+  // writers at enable time so a live lease's mode never mutates under
+  // in-flight appends) + server-global telemetry across all writers.
+  // The fsync µs histogram reuses kLatBoundsUs and is populated only
+  // while stats are enabled (SW_PLANE_STATS=0 keeps the committer
+  // clock-free too).
+  std::atomic<int> sync_mode{0};
+  std::atomic<uint64_t> sync_batch_us{2000};
+  std::atomic<uint64_t> sync_max_pending{512};
+  std::atomic<uint64_t> fsync_batches{0};
+  std::atomic<uint64_t> fsync_riders{0};
+  std::atomic<uint64_t> fsync_failures{0};
+  std::atomic<uint64_t> fsync_pending{0};
+  std::atomic<uint64_t> fsync_us_sum{0};
+  std::atomic<uint64_t> fsync_buckets[kLatBuckets + 1] = {};
+
   std::shared_ptr<VolumeRec> find(uint32_t vid) const {
     std::shared_lock<std::shared_mutex> l(vols_mu);
     auto it = vols.find(vid);
@@ -435,6 +549,140 @@ struct Server {
     return it == ec_vols.end() ? nullptr : it->second;
   }
 };
+
+// ----------------------------------------------------- group commit
+// One committed batch's telemetry. The µs histogram (kLatBoundsUs) and
+// sum are skipped when the batch wasn't timed — SW_PLANE_STATS=0 keeps
+// even the committer clock-free; batch/rider counts are plain
+// fetch_adds and always flow.
+void record_fsync(Server* s, uint64_t riders, uint64_t us, bool timed) {
+  s->fsync_batches.fetch_add(1, std::memory_order_relaxed);
+  s->fsync_riders.fetch_add(riders, std::memory_order_relaxed);
+  if (!timed) return;
+  s->fsync_us_sum.fetch_add(us, std::memory_order_relaxed);
+  int b = 0;
+  while (b < kLatBuckets && us > kLatBoundsUs[b]) b++;
+  s->fsync_buckets[b].fetch_add(1, std::memory_order_relaxed);
+}
+
+// Flush deferred group-commit acks outside any writer lock: a clean
+// commit sends each rider its pre-built 200; a poison/teardown sends
+// the 307 fallback (durability unknown — the record was never acked,
+// so the client's retry through Python is a harmless duplicate).
+// Defined after record_request; used by the committer and poison.
+void send_deferred(Server* s, std::vector<DeferredAck> acks, bool ok);
+
+// Fail-stop a writer after an fdatasync error: acking a write whose
+// durability is unknown is the one unforgivable ambiguity, so the whole
+// batch poisons (-5 to every waiter) and the writer dies like the
+// torn-.idx path in do_append — Python demotes to its own append path
+// and the next lease cycle resumes from the consistent prefix. Caller
+// must hold NEITHER w->mu nor w->sync_mu.
+void poison_writer(Writer* w) {
+  std::vector<DeferredAck> orphans;
+  {
+    std::lock_guard<std::mutex> sg(w->sync_mu);
+    w->sync_failed = true;
+    w->ack_cv[0].notify_all();
+    w->ack_cv[1].notify_all();
+    while (!w->deferred.empty()) {
+      orphans.push_back(std::move(w->deferred.front()));
+      w->deferred.pop_front();
+    }
+  }
+  if (!orphans.empty())
+    send_deferred(w->srv, std::move(orphans), false);
+  w->accept_posts.store(false, std::memory_order_release);
+  std::lock_guard<std::mutex> g(w->mu);
+  if (w->fd >= 0) close(w->fd);
+  if (w->idx_fd >= 0) close(w->idx_fd);
+  w->fd = w->idx_fd = -1;
+}
+
+// The group-commit committer: waits for the first rider, lets the
+// commit window (batch_us) fill — or max_pending riders force an early
+// close — then issues ONE fdatasync pair (.dat then .idx) covering
+// every sequence appended before the sync started, advances synced_seq
+// and wakes the batch. The fds are private dups, so a concurrent
+// fail-stop closing the writer's fds can't invalidate an in-flight
+// fdatasync; appends racing in DURING the sync simply ride the next
+// batch (fdatasync may flush their bytes early — never the reverse).
+void committer_loop(Server* s, Writer* w) {
+  // every in-flight durable write waits on this thread: under load the
+  // committer competes with hundreds of runnable conn threads for the
+  // CPU, and each scheduling delay stretches the commit cycle for the
+  // whole batch — ask for priority (best-effort: may need privileges)
+  setpriority(PRIO_PROCESS,
+              static_cast<id_t>(syscall(SYS_gettid)), -10);
+  std::unique_lock<std::mutex> sl(w->sync_mu);
+  for (;;) {
+    w->sync_cv.wait(sl, [&] {
+      return w->committer_stop ||
+             (w->append_seq > w->synced_seq && !w->sync_failed);
+    });
+    if (w->committer_stop &&
+        (w->append_seq == w->synced_seq || w->sync_failed))
+      break;
+    uint64_t first = w->synced_seq;
+    if (!w->committer_stop && w->batch_us > 0)
+      w->sync_cv.wait_for(
+          sl, std::chrono::microseconds(w->batch_us), [&] {
+            return w->committer_stop ||
+                   w->append_seq - first >= w->max_pending;
+          });
+    uint64_t upto = w->append_seq;
+    // close the open batch: riders that enqueued while sync_gen == gen
+    // are exactly the sequences <= upto (both read under sync_mu)
+    uint64_t gen = w->sync_gen++;
+    sl.unlock();
+    bool timed = s->stats.enabled.load(std::memory_order_relaxed);
+    uint64_t t0 = timed ? mono_us() : 0;
+    // sync .dat and .idx concurrently: issued back-to-back each forces
+    // its own journal commit; in flight together the jbd2 layer merges
+    // them into one transaction, roughly halving the commit window
+    bool idx_ok = false;
+    std::thread idx_sync(
+        [&] { idx_ok = fdatasync(w->sync_idx_fd) == 0; });
+    bool dat_ok = fdatasync(w->sync_dat_fd) == 0;
+    idx_sync.join();
+    bool ok = dat_ok && idx_ok;
+    uint64_t us = timed ? mono_us() - t0 : 0;
+    if (ok) {
+      record_fsync(s, upto - first, us, timed);
+      sl.lock();
+      w->synced_seq = upto;
+      w->ack_cv[gen & 1].notify_all();
+      if (!w->deferred.empty() && w->deferred.front().seq <= upto) {
+        std::vector<DeferredAck> acks;
+        while (!w->deferred.empty() && w->deferred.front().seq <= upto) {
+          acks.push_back(std::move(w->deferred.front()));
+          w->deferred.pop_front();
+        }
+        sl.unlock();  // sends must not block riders enqueueing
+        send_deferred(s, std::move(acks), true);
+        sl.lock();
+      }
+    } else {
+      s->fsync_failures.fetch_add(1, std::memory_order_relaxed);
+      poison_writer(w);
+      sl.lock();
+    }
+  }
+  // belt-and-braces: a rider enqueued after sync_failed is rejected
+  // with -5 before it defers, and poison flushed the queue — but a
+  // deferred ack must never be silently dropped, so fall back loudly
+  std::vector<DeferredAck> leftover;
+  while (!w->deferred.empty()) {
+    leftover.push_back(std::move(w->deferred.front()));
+    w->deferred.pop_front();
+  }
+  sl.unlock();
+  if (!leftover.empty())
+    send_deferred(s, std::move(leftover), false);
+  close(w->sync_dat_fd);
+  close(w->sync_idx_fd);
+  w->sync_dat_fd = w->sync_idx_fd = -1;
+}
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -503,6 +751,27 @@ void record_request(Server* s, const Request& req, int status,
     e.unix_ms = wall_ms();
     st.slow_seq++;
   }
+}
+
+void send_deferred(Server* s, std::vector<DeferredAck> acks, bool ok) {
+  for (auto& a : acks) {
+    const std::string& out = ok ? a.resp : a.fallback;
+    send_all(a.fd, out.data(), out.size());
+    close(a.fd);
+    a.fd = -1;
+    if (s) {
+      if (!ok) s->redirected++;
+      if (a.t0_us) {  // stats were on when the request started
+        Request rq;
+        rq.method = "POST";
+        rq.target = a.target;
+        record_request(s, rq, ok ? 200 : 307, ok ? a.bytes : 0,
+                       mono_us() - a.t0_us);
+      }
+    }
+  }
+  if (s && !acks.empty())
+    s->fsync_pending.fetch_sub(acks.size(), std::memory_order_relaxed);
 }
 
 // Reads one request off the socket (blocking). Returns 1 ok, 0 clean EOF,
@@ -591,17 +860,38 @@ int read_request(int fd, std::string* acc, Request* out) {
   }
 }
 
+std::string format_head(int code, const char* reason, size_t body_len,
+                        bool keepalive,
+                        const std::string& extra_headers,
+                        const char* ctype) {
+  return "HTTP/1.1 " + std::to_string(code) + " " + reason +
+         "\r\nContent-Length: " + std::to_string(body_len) +
+         "\r\nContent-Type: " + ctype + "\r\n" + extra_headers +
+         "Connection: " + (keepalive ? "keep-alive" : "close") +
+         "\r\n\r\n";
+}
+
+// full response bytes in one buffer, for acks sent later by a thread
+// that isn't the connection's own (group-commit deferred acks)
+std::string format_response(int code, const char* reason,
+                            const std::string& body, bool keepalive,
+                            const std::string& extra_headers = "",
+                            const char* ctype = "text/plain") {
+  std::string out =
+      format_head(code, reason, body.size(), keepalive, extra_headers,
+                  ctype);
+  out += body;
+  return out;
+}
+
 void respond_simple(int fd, int code, const char* reason,
                     const std::string& body, bool keepalive,
                     const std::string& extra_headers = "",
                     const char* ctype = "text/plain") {
   tl_status = code;
   tl_bytes += body.size();
-  std::string head = "HTTP/1.1 " + std::to_string(code) + " " + reason +
-                     "\r\nContent-Length: " + std::to_string(body.size()) +
-                     "\r\nContent-Type: " + ctype + "\r\n" + extra_headers +
-                     "Connection: " +
-                     (keepalive ? "keep-alive" : "close") + "\r\n\r\n";
+  std::string head = format_head(code, reason, body.size(), keepalive,
+                                 extra_headers, ctype);
   if (body.empty())
     send_all(fd, head.data(), head.size());
   else
@@ -1133,10 +1423,11 @@ void be64_store(uint8_t* p, uint64_t v) {
     p[i] = static_cast<uint8_t>(v >> (8 * (7 - i)));
 }
 
-// The one append primitive: .dat record + .idx entry + mirror + counter
-// deltas, atomically under the writer mutex. size_field==kTombstoneSize
-// marks a delete (blob is the tombstone record; the .idx entry gets
-// offset 0 + tombstone size, mirroring NeedleMap.delete).
+// The append core: .dat record + .idx entry + mirror + counter deltas.
+// Caller holds w->mu (do_append below — the only caller — takes it).
+// size_field==kTombstoneSize marks a delete (blob is the tombstone
+// record; the .idx entry gets offset 0 + tombstone size, mirroring
+// NeedleMap.delete).
 // check_cookie: re-verify the overwrite/delete cookie against the
 // STORED needle under the mutex — the caller's pre-check raced with
 // other appends (Python's write_needle holds volume.lock across
@@ -1145,11 +1436,10 @@ void be64_store(uint8_t* p, uint64_t v) {
 // -3 I/O error (tails truncated back; an untruncatable torn .idx
 // fail-stops the writer rather than misalign every later record),
 // -4 cookie mismatch.
-int64_t do_append(VolumeRec* vol, Writer* w, const uint8_t* blob,
-                  int64_t len, uint64_t key, uint32_t size_field,
-                  bool check_cookie, uint32_t cookie,
-                  int64_t* freed_out = nullptr) {
-  std::lock_guard<std::mutex> g(w->mu);
+int64_t do_append_locked(VolumeRec* vol, Writer* w, const uint8_t* blob,
+                         int64_t len, uint64_t key, uint32_t size_field,
+                         bool check_cookie, uint32_t cookie,
+                         int64_t* freed_out) {
   if (w->fd < 0) return -1;
   int64_t tail = w->tail.load(std::memory_order_relaxed);
   if (tail + len > w->max_size) return -2;
@@ -1228,6 +1518,88 @@ int64_t do_append(VolumeRec* vol, Writer* w, const uint8_t* blob,
     while (key > mk &&
            !w->max_key.compare_exchange_weak(mk, key)) {
     }
+  }
+  return off;
+}
+
+// Append + durability, per the writer's frozen sync mode. Off: ack
+// straight from the page cache (pre-durability behavior). Always: one
+// inline fdatasync pair per append under the mutex — the measured
+// baseline group mode is judged against. Group: publish a sequence
+// number to the committer, RELEASE the append mutex (later appends must
+// batch up behind this one, not serialize on its fsync), and wait until
+// one fdatasync covers the sequence. Adds -5 to the error codes above:
+// durability was lost before the ack (fsync error poisoned the batch,
+// or the lease was torn down mid-batch) — the record may or may not be
+// on disk, so the caller must NOT ack; Python stays authoritative and a
+// client retry lands as a harmless duplicate whose index entry wins.
+// do_append also accepts a prepared DeferredAck (`defer`): in group
+// mode the rider then doesn't block on the commit at all — its ack is
+// queued with the committer (consuming `defer`) and kAckDeferred is
+// returned so the caller sends nothing. Blocking-rider and always-mode
+// semantics are unchanged when defer is null or unarmed (fd < 0).
+constexpr int64_t kAckDeferred = -6;
+
+int64_t do_append(VolumeRec* vol, Writer* w, const uint8_t* blob,
+                  int64_t len, uint64_t key, uint32_t size_field,
+                  bool check_cookie, uint32_t cookie,
+                  int64_t* freed_out = nullptr,
+                  DeferredAck* defer = nullptr) {
+  uint64_t my_seq = 0;
+  uint64_t my_gen = 0;
+  bool group_wait = false;
+  bool ack_deferred = false;
+  int64_t off;
+  {
+    std::lock_guard<std::mutex> g(w->mu);
+    off = do_append_locked(vol, w, blob, len, key, size_field,
+                           check_cookie, cookie, freed_out);
+    if (off >= 0 && w->sync_mode == 2) {
+      bool timed = w->srv && w->srv->stats.enabled.load(
+                                 std::memory_order_relaxed);
+      uint64_t t0 = timed ? mono_us() : 0;
+      if (fdatasync(w->fd) != 0 || fdatasync(w->idx_fd) != 0) {
+        // inline fail-stop (poison_writer would re-lock w->mu)
+        if (w->srv)
+          w->srv->fsync_failures.fetch_add(1, std::memory_order_relaxed);
+        {
+          std::lock_guard<std::mutex> sg(w->sync_mu);
+          w->sync_failed = true;
+        }
+        w->accept_posts.store(false, std::memory_order_release);
+        close(w->fd);
+        close(w->idx_fd);
+        w->fd = w->idx_fd = -1;
+        return -5;
+      }
+      if (w->srv)
+        record_fsync(w->srv, 1, timed ? mono_us() - t0 : 0, timed);
+    } else if (off >= 0 && w->sync_mode == 1) {
+      std::lock_guard<std::mutex> sg(w->sync_mu);
+      if (w->committer_stop || w->sync_failed) return -5;
+      my_seq = ++w->append_seq;
+      my_gen = w->sync_gen;  // the commit that will cover my_seq
+      if (w->srv)
+        w->srv->fsync_pending.fetch_add(1, std::memory_order_relaxed);
+      if (defer && defer->fd >= 0) {
+        defer->seq = my_seq;
+        w->deferred.push_back(std::move(*defer));
+        ack_deferred = true;
+      } else {
+        group_wait = true;
+      }
+      w->sync_cv.notify_one();
+    }
+  }
+  if (ack_deferred) return kAckDeferred;
+  if (group_wait) {
+    std::unique_lock<std::mutex> sl(w->sync_mu);
+    w->ack_cv[my_gen & 1].wait(sl, [&] {
+      return w->synced_seq >= my_seq || w->sync_failed;
+    });
+    if (w->srv)
+      w->srv->fsync_pending.fetch_sub(1, std::memory_order_relaxed);
+    if (w->synced_seq < my_seq) return -5;
   }
   return off;
 }
@@ -1394,7 +1766,7 @@ void json_escape(const std::string& in, std::string* out) {
 // append back through swhp_append — same mutex, same tail).
 void serve_write(Server* s, int fd, const Request& req,
                  const std::string& body, uint32_t vid, uint64_t key,
-                 uint32_t cookie) {
+                 uint32_t cookie, bool pipelined) {
   auto vol = s->find(vid);
   if (!vol) {
     redirect_to_fallback(s, fd, req);
@@ -1453,13 +1825,46 @@ void serve_write(Server* s, int fd, const Request& req,
   std::vector<uint8_t> blob = build_needle(
       cookie, key, reinterpret_cast<const uint8_t*>(data), data_len,
       filename, mime, vol->version, &size_field, &crc);
+  // the success ack depends only on request-side facts, so in group
+  // mode it is pre-built and handed to the committer: the rider never
+  // blocks on the commit — the committer sends the ack the moment the
+  // covering fdatasync returns. Pipelined clients (rare: bytes of the
+  // NEXT request already buffered) keep the blocking path so responses
+  // cannot reorder with inline-served requests on the same connection.
+  char etag[16];
+  snprintf(etag, sizeof etag, "%02x%02x%02x%02x", crc >> 24 & 0xFF,
+           crc >> 16 & 0xFF, crc >> 8 & 0xFF, crc & 0xFF);
+  std::string resp = "{\"name\": \"";
+  json_escape(filename, &resp);
+  resp += "\", \"size\": " + std::to_string(data_len) +
+          ", \"eTag\": \"" + etag + "\"}";
+  DeferredAck da;
+  if (w->sync_mode == 1 && !pipelined) {
+    da.fd = dup(fd);  // dup: the conn thread's close can't race us
+    if (da.fd >= 0) {
+      da.resp = format_response(200, "OK", resp, req.keepalive, "",
+                                "application/json");
+      da.fallback = format_response(
+          307, "Temporary Redirect", "", req.keepalive,
+          "Location: http://" + s->fallback + req.target + "\r\n");
+      da.bytes = resp.size();
+      da.t0_us = tl_t0;
+      da.target = req.target;
+    }
+  }
   // overwrite-cookie verification happens INSIDE do_append, under the
   // writer mutex (storage/volume.py holds volume.lock across
   // check+append; reference volume_read_write.go reads the stored
   // header's cookie)
   int64_t off = do_append(vol.get(), w.get(), blob.data(),
                           static_cast<int64_t>(blob.size()), key,
-                          size_field, /*check_cookie=*/true, cookie);
+                          size_field, /*check_cookie=*/true, cookie,
+                          nullptr, &da);
+  if (off == kAckDeferred) {
+    s->written++;
+    tl_deferred = true;
+    return;
+  }
   if (off == -4) {
     respond_simple(fd, 500, "Internal Server Error",
                    "{\"error\": \"needle " + std::to_string(key) +
@@ -1467,10 +1872,12 @@ void serve_write(Server* s, int fd, const Request& req,
                    req.keepalive, "", "application/json");
     return;
   }
-  if (off == -2 || off == -1) {
-    // addressing ceiling, or the lease was revoked between the
-    // accept_posts check and the append (vacuum/readonly toggle):
-    // Python is the authority either way
+  if (off == -2 || off == -1 || off == -5) {
+    // addressing ceiling, the lease revoked between the accept_posts
+    // check and the append (vacuum/readonly toggle), or durability lost
+    // mid-batch (-5: fsync poison / lease teardown — the record was NOT
+    // acked, so the client's retry through Python is a harmless
+    // duplicate): Python is the authority in every case
     redirect_to_fallback(s, fd, req);
     return;
   }
@@ -1481,13 +1888,6 @@ void serve_write(Server* s, int fd, const Request& req,
                    "application/json");
     return;
   }
-  char etag[16];
-  snprintf(etag, sizeof etag, "%02x%02x%02x%02x", crc >> 24 & 0xFF,
-           crc >> 16 & 0xFF, crc >> 8 & 0xFF, crc & 0xFF);
-  std::string resp = "{\"name\": \"";
-  json_escape(filename, &resp);
-  resp += "\", \"size\": " + std::to_string(data_len) +
-          ", \"eTag\": \"" + etag + "\"}";
   s->written++;  // before the send — see the IMS 304 comment
   respond_simple(fd, 200, "OK", resp, req.keepalive, "",
                  "application/json");
@@ -1566,7 +1966,7 @@ void serve_delete(Server* s, int fd, const Request& req, uint32_t vid,
                    req.keepalive, "", "application/json");
     return;
   }
-  if (rc == -2 || rc == -1) {
+  if (rc == -2 || rc == -1 || rc == -5) {
     redirect_to_fallback(s, fd, req);
     return;
   }
@@ -1600,6 +2000,8 @@ void handle_conn(Server* s, int fd) {
     uint64_t t0 = stats_on ? mono_us() : 0;
     tl_status = 0;
     tl_bytes = 0;
+    tl_deferred = false;
+    tl_t0 = t0;
     if (req.chunked) req.keepalive = false;  // body framing not parsed
     uint32_t vid = 0, cookie = 0;
     uint64_t key = 0;
@@ -1639,8 +2041,10 @@ void handle_conn(Server* s, int fd) {
         body.append(buf, static_cast<size_t>(got));
       }
       if (short_read) break;  // torn upload: nothing was appended
-      serve_write(s, fd, req, body, vid, key, cookie);
-      if (stats_on)
+      // leftover buffered bytes = the client pipelined the next
+      // request; deferring this ack could then reorder responses
+      serve_write(s, fd, req, body, vid, key, cookie, !acc.empty());
+      if (stats_on && !tl_deferred)
         record_request(s, req, tl_status, tl_bytes, mono_us() - t0);
       if (!req.keepalive) break;
       continue;
@@ -1790,6 +2194,21 @@ int swhp_enable_writer(void* h, uint32_t vid, const char* idx_path,
   w->max_size = max_size;
   w->file_size_limit = file_size_limit;
   w->accept_posts.store(accept_posts != 0, std::memory_order_release);
+  // freeze the server's configured durability mode into this lease
+  // (a live lease's mode never mutates under in-flight appends). The
+  // committer gets private dup'd fds so a fail-stop closing the
+  // writer's fds can't invalidate an in-flight fdatasync.
+  w->srv = s;
+  w->sync_mode = s->sync_mode.load();
+  w->batch_us = s->sync_batch_us.load();
+  uint64_t mp = s->sync_max_pending.load();
+  w->max_pending = mp ? mp : 1;
+  if (w->sync_mode == 1) {
+    w->sync_dat_fd = dup(w->fd);
+    w->sync_idx_fd = dup(w->idx_fd);
+    if (w->sync_dat_fd < 0 || w->sync_idx_fd < 0) return -1;
+    w->committer = std::thread(committer_loop, s, w.get());
+  }
   std::unique_lock<std::shared_mutex> l(vol->mu);
   vol->writer = std::move(w);
   return 0;
@@ -1811,6 +2230,13 @@ int64_t swhp_disable_writer(void* h, uint32_t vid) {
   }
   if (!w) return -1;
   w->accept_posts.store(false, std::memory_order_release);
+  // committer teardown FIRST: its final fdatasync drains every pending
+  // sequence, so appends enqueued before the stop get their durable
+  // acks (a lease handback must never leak an acked-but-unsynced
+  // window); an append racing in after the stop poisons itself to -5
+  // instead of enqueueing. Only then does taking `mu` below become the
+  // usual no-append-in-flight barrier.
+  w->stop_committer();
   std::lock_guard<std::mutex> g(w->mu);
   int64_t tail = w->tail.load();
   if (w->fd >= 0) close(w->fd);
@@ -2028,6 +2454,51 @@ int swhp_slow_ring(void* h, char* buf, int buflen) {
   memcpy(buf, out.data(), out.size());
   buf[out.size()] = '\0';
   return static_cast<int>(out.size());
+}
+
+// ---- group-commit durability -------------------------------------------
+
+// Configures the durability mode applied to writers at enable time
+// (SW_PLANE_FSYNC_MODE): 0 = off (ack from the page cache — the
+// pre-durability behavior), 1 = group (a committer amortizes ONE
+// fdatasync per commit window over every rider), 2 = always (fdatasync
+// per append — the baseline group mode is measured against). batch_us
+// is the commit window, max_pending the rider count forcing an early
+// commit. Live leases keep the mode they were enabled with; Python
+// cycles the lease to apply a change. Returns 0, -1 on a bad mode.
+int swhp_set_sync_mode(void* h, int mode, uint64_t batch_us,
+                       uint64_t max_pending) {
+  if (!h || mode < 0 || mode > 2) return -1;
+  Server* s = static_cast<Server*>(h);
+  s->sync_mode.store(mode);
+  s->sync_batch_us.store(batch_us);
+  s->sync_max_pending.store(max_pending ? max_pending : 1);
+  return 0;
+}
+
+// Flat snapshot of the durability telemetry, all uint64:
+//   [0] mode        [1] batch_us     [2] max_pending
+//   [3] batches     [4] riders       [5] fsync_failures
+//   [6] pending     [7] fsync µs sum
+//   [8..] per-bucket fsync µs counts (bounds = swhp_lat_bounds, last =
+//         +Inf); the µs sum and buckets flow only while stats are
+//         enabled — SW_PLANE_STATS=0 keeps the committer clock-free.
+int swhp_sync_stats_len() { return 8 + kLatBuckets + 1; }
+
+int swhp_sync_stats(void* h, uint64_t* out, int n) {
+  if (!h || n < 8 + kLatBuckets + 1) return -1;
+  Server* s = static_cast<Server*>(h);
+  out[0] = static_cast<uint64_t>(s->sync_mode.load());
+  out[1] = s->sync_batch_us.load();
+  out[2] = s->sync_max_pending.load();
+  out[3] = s->fsync_batches.load(std::memory_order_relaxed);
+  out[4] = s->fsync_riders.load(std::memory_order_relaxed);
+  out[5] = s->fsync_failures.load(std::memory_order_relaxed);
+  out[6] = s->fsync_pending.load(std::memory_order_relaxed);
+  out[7] = s->fsync_us_sum.load(std::memory_order_relaxed);
+  for (int b = 0; b <= kLatBuckets; b++)
+    out[8 + b] = s->fsync_buckets[b].load(std::memory_order_relaxed);
+  return 8 + kLatBuckets + 1;
 }
 
 // ---- EC volumes + reconstructed-slab cache -----------------------------

@@ -175,6 +175,21 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.swhp_set_slow_us.argtypes = [ctypes.c_void_p,
                                              ctypes.c_uint64]
             lib.swhp_set_slow_us.restype = None
+        # group-commit durability ABI — absent in an explicitly
+        # overridden pre-durability build (SW_HTTP_PLANE_LIB), where
+        # appends keep the page-cache ack contract as before
+        if hasattr(lib, "swhp_set_sync_mode"):
+            lib.swhp_set_sync_mode.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int,
+                                               ctypes.c_uint64,
+                                               ctypes.c_uint64]
+            lib.swhp_set_sync_mode.restype = ctypes.c_int
+            lib.swhp_sync_stats_len.argtypes = []
+            lib.swhp_sync_stats_len.restype = ctypes.c_int
+            lib.swhp_sync_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int]
+            lib.swhp_sync_stats.restype = ctypes.c_int
         # EC + reconstructed-slab cache ABI — absent in an explicitly
         # overridden pre-cache build (SW_HTTP_PLANE_LIB); the wrapper
         # then keeps every EC read on the redirect path as before
@@ -265,6 +280,12 @@ class NativeReadPlane:
         if self._has_cache:
             lib.swhp_cache_configure(
                 self._h, max(0, config.env_int("SW_PLANE_CACHE_BYTES")))
+        self._has_sync = hasattr(lib, "swhp_set_sync_mode")
+        if self._has_sync:
+            self.set_sync_mode(
+                config.env_str("SW_PLANE_FSYNC_MODE"),
+                config.env_int("SW_PLANE_FSYNC_BATCH_US"),
+                config.env_int("SW_PLANE_FSYNC_MAX_PENDING"))
 
     # -- volume lifecycle --------------------------------------------------
     def register_volume(self, volume) -> bool:
@@ -526,6 +547,48 @@ class NativeReadPlane:
         except ValueError:
             return []
 
+    # SW_PLANE_FSYNC_MODE values -> swhp_set_sync_mode codes
+    _SYNC_MODES = {"off": 0, "group": 1, "always": 2}
+    _SYNC_MODE_NAMES = {v: k for k, v in _SYNC_MODES.items()}
+
+    def set_sync_mode(self, mode, batch_us: int, max_pending: int) -> bool:
+        """Configure group-commit durability for subsequently-enabled
+        write leases (live leases keep the mode they were enabled with —
+        the volume server cycles leases to apply a change). mode is
+        'off' | 'group' | 'always' (an unknown string falls back to
+        'off' rather than refusing to serve)."""
+        h = self._h
+        if not h or not self._has_sync:
+            return False
+        code = self._SYNC_MODES.get(str(mode).strip().lower(), 0)
+        return self._lib.swhp_set_sync_mode(
+            h, code, max(0, int(batch_us)), max(1, int(max_pending))) == 0
+
+    # field order of swhp_sync_stats's flat export, ahead of the buckets
+    _SYNC_STATS_HEAD = ("mode", "batch_us", "max_pending", "batches",
+                        "riders", "failures", "pending", "fsync_us_sum")
+
+    def sync_stats(self) -> Optional[dict]:
+        """Durability telemetry snapshot: config + batch/rider/failure
+        counters, pending-queue depth, and the fsync µs histogram as
+        ``(bound_us, count)`` pairs (trailing None = +Inf). The mode
+        comes back as its knob string. None when the plane is stopped
+        or the loaded library predates the durability ABI."""
+        h = self._h
+        if not h or not self._has_sync:
+            return None
+        n = int(self._lib.swhp_sync_stats_len())
+        buf = (ctypes.c_uint64 * n)()
+        if self._lib.swhp_sync_stats(h, buf, n) != n:
+            return None
+        vals = [int(x) for x in buf]
+        out = dict(zip(self._SYNC_STATS_HEAD, vals))
+        out["mode"] = self._SYNC_MODE_NAMES.get(out["mode"], "off")
+        counts = vals[len(self._SYNC_STATS_HEAD):]
+        bounds = list(lat_bounds_us())[:len(counts) - 1]
+        out["buckets"] = list(zip(bounds + [None], counts))
+        return out
+
     def set_stats_enabled(self, on: bool):
         h = self._h
         if h and self._has_stats:
@@ -577,6 +640,13 @@ class NativeWriter:
         if off == -4:
             raise VolumeError(
                 f"needle {key}: mismatching cookie on overwrite")
+        if off == -5:
+            # durability lost (fsync poison / lease torn down
+            # mid-batch): never acked, so the caller's retry through
+            # the Python path is a harmless duplicate
+            raise OSError(
+                f"volume {self.vid}: group-commit batch poisoned — "
+                f"durability of the append is unknown")
         if off < 0:
             raise OSError(
                 f"native append failed on volume {self.vid} ({off})")
@@ -610,3 +680,10 @@ class NativeWriter:
         if h:
             self._plane._lib.swhp_set_accept_posts(
                 h, self.vid, 1 if on else 0)
+
+    def release(self) -> int:
+        """Hand the lease back (C++ mutex barrier; the group-commit
+        committer drains its final batch first). Volume._demote_fast
+        _writer calls this when an append came back ambiguous; the
+        owning server's _writer_release does the same via the plane."""
+        return self._plane.disable_writer(self.vid)

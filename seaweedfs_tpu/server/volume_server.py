@@ -87,6 +87,8 @@ class VolumeServer:
         router.add("GET", "/admin/traces/export", traces_export_handler)
         router.add("GET", "/admin/plane/slow", self.admin_plane_slow)
         router.add("GET", "/admin/plane/cache", self.admin_plane_cache)
+        router.add("GET", "/admin/plane/durability",
+                   self.admin_plane_durability)
         router.add("POST", "/admin/profile", profile_handler)
         router.add("GET", "/stats/disk", self.stats_disk)
         router.add("GET", "/stats/memory", self.stats_memory)
@@ -642,6 +644,11 @@ class VolumeServer:
         from ..stats.metrics import observe_plane_cache
         observe_plane_cache(self.fast_plane.cache_stats()
                             if self.fast_plane is not None else None)
+        # group-commit durability counters (same mirror pattern; None
+        # when the plane is off or predates the durability ABI)
+        from ..stats.metrics import observe_plane_sync
+        observe_plane_sync(self.fast_plane.sync_stats()
+                           if self.fast_plane is not None else None)
         # device-codec telemetry (process-global monotonic counters)
         # mirrors onto the scrape so dispatches / bitmat uploads / host
         # fallbacks are visible without running a rebuild through bench
@@ -689,6 +696,16 @@ class VolumeServer:
         if self.fast_plane is None:
             return {"plane": False, "cache": None}
         return {"plane": True, "cache": self.fast_plane.cache_stats()}
+
+    def admin_plane_durability(self, req: Request):
+        """Group-commit durability config + telemetry (swhp_sync_stats):
+        mode/window/rider-cap, batches vs riders (the amortization
+        ratio), fsync µs histogram, pending-queue depth, and failures —
+        a failure means a batch poisoned and its writer fail-stopped."""
+        if self.fast_plane is None:
+            return {"plane": False, "durability": None}
+        return {"plane": True,
+                "durability": self.fast_plane.sync_stats()}
 
     def admin_assign_volume(self, req: Request):
         vid = int(req.query["volume"])

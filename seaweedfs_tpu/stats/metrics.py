@@ -981,6 +981,50 @@ def observe_plane_cache(snap: Optional[Dict]):
     PLANE_CACHE_MAX_BYTES_GAUGE.set(snap.get("max_bytes", 0))
 
 
+# -- group-commit write durability (native_plane.sync_stats) -----------------
+
+PLANE_FSYNC_BATCH_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_fsync_batches_total",
+    "Group commits issued by the native plane: one fdatasync pair "
+    "(.dat + .idx) covering every rider in the batch; 'always' mode "
+    "counts each per-append fsync as a batch of one.")
+PLANE_FSYNC_RIDER_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_fsync_riders_total",
+    "Appends whose ack was covered by a group commit; riders/batches "
+    "is the fsync amortization ratio (1.0 = no batching win).")
+PLANE_FSYNC_FAILURE_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_plane_fsync_failures_total",
+    "fdatasync errors: the batch poisoned (-5 to every waiting append, "
+    "nothing acked) and the writer fail-stopped — Python demoted the "
+    "volume to its own append path.")
+PLANE_FSYNC_HISTOGRAM = VOLUME_SERVER_GATHER.histogram(
+    "SeaweedFS_volumeServer_plane_fsync_seconds",
+    "Bucketed duration of the committer's covering fdatasync pair "
+    "(populated only while SW_PLANE_STATS is on — stats off keeps the "
+    "committer clock-free).",
+    buckets=PLANE_LAT_BUCKETS_S)
+PLANE_FSYNC_PENDING_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_plane_fsync_pending",
+    "Appends currently parked awaiting their covering group commit "
+    "(bounded by SW_PLANE_FSYNC_MAX_PENDING per batch).")
+
+
+def observe_plane_sync(snap: Optional[Dict]):
+    """Mirror one NativeReadPlane.sync_stats() snapshot onto the volume
+    registry (same set_total mirror pattern as observe_plane)."""
+    if not snap:
+        return
+    PLANE_FSYNC_BATCH_COUNTER.set_total(snap.get("batches", 0))
+    PLANE_FSYNC_RIDER_COUNTER.set_total(snap.get("riders", 0))
+    PLANE_FSYNC_FAILURE_COUNTER.set_total(snap.get("failures", 0))
+    buckets = snap.get("buckets") or ()
+    PLANE_FSYNC_HISTOGRAM.set_buckets(
+        [c for _bound, c in buckets[:len(PLANE_LAT_BUCKETS_S)]],
+        sum(c for _bound, c in buckets),
+        snap.get("fsync_us_sum", 0) / 1e6)
+    PLANE_FSYNC_PENDING_GAUGE.set(snap.get("pending", 0))
+
+
 # -- repair queue (stats/repair_queue.py via observe_repair_queue) -----------
 
 MASTER_REPAIR_QUEUE_COUNTER = MASTER_GATHER.counter(

@@ -184,6 +184,12 @@ class _SortedBase:
     def deleted_size(self) -> int:
         return self.deletion_byte_counter
 
+    def sync(self):
+        """fdatasync the .idx append log (SW_PLANE_FSYNC_MODE parity
+        with NeedleMap.sync)."""
+        if self._idx_file is not None:
+            os.fdatasync(self._idx_file.fileno())
+
     def close(self):
         if self._idx_file is not None:
             self._idx_file.close()

@@ -139,6 +139,13 @@ class NeedleMap:
     def deleted_size(self) -> int:
         return self.deletion_byte_counter
 
+    def sync(self):
+        """fdatasync the .idx append log — the Python write path's half
+        of the SW_PLANE_FSYNC_MODE durability contract (the native
+        plane's committer fdatasyncs the .idx it owns the same way)."""
+        if self._idx_file is not None:
+            os.fdatasync(self._idx_file.fileno())
+
     def close(self):
         if self._idx_file is not None:
             self._idx_file.close()

@@ -195,6 +195,37 @@ class Volume:
         self.nm = load_needle_map(self.idx_path, self.index_kind,
                                   self.offset_width)
 
+    def _demote_fast_writer(self, err):
+        """The native writer failed with ambiguity (I/O error, poisoned
+        group-commit batch, fail-stopped lease): take the lease back,
+        reload the needle map from the .idx the plane kept
+        authoritative, and resume Python-owned appends — the plane's
+        standing poison-demote philosophy. Caller holds self.lock."""
+        from ..util import glog
+        glog.V(0).infof(
+            "volume %d: native writer demoted to the Python append "
+            "path (%s)", self.id, err)
+        w = self.fast_writer
+        self.fast_writer = None
+        try:
+            w.release()
+        finally:
+            self.reload_nm()
+
+    def _durable_sync(self):
+        """fdatasync the .dat and .idx when SW_PLANE_FSYNC_MODE is on:
+        an append demoted to the Python path must honor the same
+        durability contract the native plane's group commit acks under
+        — per-append fsync is acceptable on the slow path."""
+        from ..util import config
+        mode = (config.env_str("SW_PLANE_FSYNC_MODE") or "off")
+        if mode.strip().lower() == "off":
+            return
+        os.fdatasync(self.dat.fileno())
+        sync = getattr(self.nm, "sync", None)
+        if sync is not None:
+            sync()
+
     def size(self) -> int:
         with self.lock:
             self.dat.seek(0, os.SEEK_END)
@@ -298,12 +329,20 @@ class Volume:
                 # the native plane owns the tail: one append updates
                 # .dat, .idx, and the serving mirror atomically (the
                 # ceiling check and the authoritative cookie re-check
-                # live there too)
+                # live there too). OSError means ambiguity — an I/O
+                # failure or a poisoned group-commit batch — so the
+                # lease comes back and THIS append retries below on the
+                # Python path (a durability-unknown duplicate on disk
+                # is harmless: the index points at the latest record).
+                # VolumeError (ceiling, cookie mismatch) propagates.
                 blob = n.to_bytes(self.version)
-                self.fast_writer.append(blob, n.id, n.size,
-                                        cookie=n.cookie)
-                self.last_modified = int(time.time())
-                return n.size
+                try:
+                    self.fast_writer.append(blob, n.id, n.size,
+                                            cookie=n.cookie)
+                    self.last_modified = int(time.time())
+                    return n.size
+                except OSError as e:
+                    self._demote_fast_writer(e)
             self.dat.seek(0, os.SEEK_END)
             offset = self.dat.tell()
             if offset % NEEDLE_PADDING_SIZE:
@@ -327,6 +366,7 @@ class Volume:
                 raise
             if n.size > 0 or self.version == 1:
                 self.nm.put(n.id, offset, n.size)
+            self._durable_sync()
             self.last_modified = int(time.time())
             return n.size
 
@@ -363,17 +403,23 @@ class Volume:
             tomb = Needle(cookie=n.cookie, id=n.id, data=b"",
                           append_at_ns=time.time_ns())
             if self.fast_writer is not None:
-                self.fast_writer.append(tomb.to_bytes(self.version),
-                                        n.id, TOMBSTONE_FILE_SIZE,
-                                        cookie=n.cookie)
-                self.last_modified = int(time.time())
-                return freed
+                # same demotion contract as write_needle: OSError =
+                # ambiguity, retry this tombstone on the Python path
+                try:
+                    self.fast_writer.append(tomb.to_bytes(self.version),
+                                            n.id, TOMBSTONE_FILE_SIZE,
+                                            cookie=n.cookie)
+                    self.last_modified = int(time.time())
+                    return freed
+                except OSError as e:
+                    self._demote_fast_writer(e)
             self.nm.delete(n.id)
             self.dat.seek(0, os.SEEK_END)
             offset = self.dat.tell()
             self.dat.seek(offset)
             self.dat.write(tomb.to_bytes(self.version))
             self.dat.flush()
+            self._durable_sync()
             self.last_modified = int(time.time())
             return freed
 

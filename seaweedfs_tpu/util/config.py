@@ -163,6 +163,19 @@ _knob("SW_PLANE_CACHE_BYTES", "int", 32 << 20,
       "Byte budget of the native plane's reconstructed-slab cache; 0 "
       "disables the in-plane degraded fast path (lost-shard reads "
       "redirect to Python as before).")
+_knob("SW_PLANE_FSYNC_MODE", "str", "off",
+      "Write-durability mode for appends (plane AND Python fallback): "
+      "off acks from the page cache, group amortizes one fdatasync per "
+      "commit window over every rider before acking the batch, always "
+      "fdatasyncs per append (the baseline group is measured against).")
+_knob("SW_PLANE_FSYNC_BATCH_US", "int", 2000,
+      "Group-commit window in microseconds: riders accumulate this "
+      "long (or until SW_PLANE_FSYNC_MAX_PENDING) before the one "
+      "covering fdatasync; p99 write latency absorbs at most one "
+      "window.")
+_knob("SW_PLANE_FSYNC_MAX_PENDING", "int", 512,
+      "Riders that force a group commit before the window closes "
+      "(bounds the pending-ack queue and the data at risk per batch).")
 _knob("SW_LOCK_DEBUG", "bool", False,
       "Record the cross-thread lock-acquisition graph (util/locks.py) "
       "for deadlock detection; auto-on under pytest.")
@@ -213,6 +226,20 @@ _knob("SW_BENCH_DP_SECONDS", "float", 5.0,
       "Duration of each data-plane saturation pass.")
 _knob("SW_BENCH_DP_CONNS", "int", 12,
       "Concurrent connections in the data-plane saturation pass.")
+_knob("SW_BENCH_DP_DURABLE_SECONDS", "float", 2.0,
+      "Duration of each durable-mode (fsync) data-plane trial; "
+      "0 skips the durability trial set.")
+_knob("SW_BENCH_DP_DURABLE_CONNS", "int", 128,
+      "Concurrent connections in each durable-mode trial (all three "
+      "modes share the load shape; group commit needs enough "
+      "in-flight writers to accumulate riders per fsync).")
+_knob("SW_BENCH_DP_CRASH_RUNS", "int", 3,
+      "kill -9 crash-consistency drill runs in the data-plane bench; "
+      "0 skips the drill.")
+_knob("SW_BENCH_DP_DIR", "str", "",
+      "Volume directory handed to the crash-drill child server.")
+_knob("SW_BENCH_DP_MASTER", "str", "",
+      "Master URL handed to the crash-drill child server.")
 _knob("SW_BENCH_DEGRADED_NEEDLES", "int", 24,
       "Needles written for the degraded-read drill.")
 _knob("SW_BENCH_DEGRADED_KB", "int", 64,

@@ -65,6 +65,22 @@ if os.environ["SW_LOCK_DEBUG"] == "1" and not os.environ.get("SW_LOCK_GRAPH_DIR"
 honor_platform_request()
 
 
+def wait_until(pred, timeout=8.0, interval=0.02):
+    """Event-driven converge helper: poll an asynchronously-updated
+    predicate (pulse propagation to the master, queue drains, lock
+    expiry) instead of sleeping across a pulse boundary. Returns the
+    first truthy value pred() produces, or its final (falsy) value at
+    the deadline — callers assert on the result, so a converged cluster
+    costs milliseconds and a broken one still fails loudly."""
+    import time
+    deadline = time.monotonic() + timeout
+    while True:
+        v = pred()
+        if v or time.monotonic() >= deadline:
+            return v
+        time.sleep(interval)
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Fail the run if the merged lock-acquisition graph has a cycle."""
     from seaweedfs_tpu.util import locks as _locks

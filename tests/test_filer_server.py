@@ -394,7 +394,6 @@ def test_mode_param_and_skip_chunk_deletion(cluster):
     """Reference parity: ?mode= octal on writes
     (filer_server_handlers_write.go:156) and ?skipChunkDeletion=true
     on deletes (metadata-only removal, chunks left alive)."""
-    import time
     master, vs, fs = cluster
     http_call("PUT", f"http://{fs.url}/moded.bin?mode=755",
               body=b"moded-content")
@@ -405,8 +404,10 @@ def test_mode_param_and_skip_chunk_deletion(cluster):
     http_call("DELETE", f"http://{fs.url}/moded.bin?skipChunkDeletion=true")
     with pytest.raises(HttpError):
         http_call("GET", f"http://{fs.url}/moded.bin")
-    # give the deletion queue a beat: nothing should reap the chunk
-    time.sleep(1.5)
+    # drain the deletion queue synchronously: skipChunkDeletion must
+    # have queued nothing, so the chunk survives a full sweep
+    fs.flush_deletions()
+    assert not fs.filer._deletion_queue
     assert op.read_file(master.url, fid) == b"moded-content"
 
 

@@ -1108,6 +1108,14 @@ class TestPlaneDegradedServing:
         # exact-counted, with zero latency samples (no clock reads)
         serving.fast_plane.set_stats_enabled(False)
         try:
+            # telemetry for the LAST stats-on response can land after
+            # the client reads its reply (recorded after the bytes are
+            # on the wire — see wait_until): settle before snapshotting
+            def settled():
+                r0 = serving.fast_plane.stats()["requests"]
+                time.sleep(0.02)
+                return serving.fast_plane.stats()["requests"] == r0
+            assert wait_until(settled)
             tele0 = serving.fast_plane.stats()
             c0 = serving.fast_plane.cache_stats()
             st, _, body = raw_get(serving.fast_url, f"/{hot}")
@@ -1241,6 +1249,7 @@ PLANE_ABI = (
     "swhp_ec_delete", "swhp_ec_unregister",
     "swhp_cache_configure", "swhp_cache_put", "swhp_cache_invalidate",
     "swhp_cache_stats_len", "swhp_cache_stats",
+    "swhp_set_sync_mode", "swhp_sync_stats_len", "swhp_sync_stats",
 )
 
 

@@ -16,12 +16,13 @@ lease back first. Everything here pins:
 
 import http.client
 import json
+import os
 import threading
 import time
 
 import pytest
 
-from seaweedfs_tpu.server.http_util import (HttpError, http_call,
+from seaweedfs_tpu.server.http_util import (HttpError, get_json, http_call,
                                             http_get_with_headers,
                                             post_json, post_multipart)
 from seaweedfs_tpu.server.master import MasterServer
@@ -589,6 +590,224 @@ class TestFastPathDeletes:
         for fid in (man["fid"], chunk_a["fid"]):
             with pytest.raises(HttpError):
                 http_call("GET", f"http://{vs.url}/{fid}")
+
+
+DURABILITY_KNOBS = ("SW_PLANE_FSYNC_MODE", "SW_PLANE_FSYNC_BATCH_US",
+                    "SW_PLANE_FSYNC_MAX_PENDING")
+
+
+@pytest.fixture
+def durable_cluster(tmp_path):
+    """A cluster whose plane leases run group-commit fsync: a wide
+    commit window so concurrent appends demonstrably share batches."""
+    os.environ["SW_PLANE_FSYNC_MODE"] = "group"
+    os.environ["SW_PLANE_FSYNC_BATCH_US"] = "20000"
+    os.environ["SW_PLANE_FSYNC_MAX_PENDING"] = "512"
+    master = MasterServer(port=0, pulse_seconds=1).start()
+    vs = start_vs(tmp_path, master)
+    try:
+        assert vs.fast_plane is not None
+        yield master, vs
+    finally:
+        vs.stop()
+        master.stop()
+        for k in DURABILITY_KNOBS:
+            os.environ.pop(k, None)
+
+
+class TestGroupCommitDurability:
+    """SW_PLANE_FSYNC_MODE=group: appends under the lease ride a shared
+    commit window; ONE fdatasync covers the batch and only then are the
+    batched responses acked (Haystack's needle-log sync discipline)."""
+
+    def test_group_commit_amortizes_fsyncs(self, durable_cluster):
+        """Concurrent acked writes must share fdatasyncs (batches <
+        riders), every acked needle must read back bit-identical, and
+        the pending gauge must drain to zero."""
+        master, vs = durable_cluster
+        snap = vs.fast_plane.sync_stats()
+        assert snap["mode"] == "group"
+        assert snap["batch_us"] == 20000
+        base_batches, base_riders = snap["batches"], snap["riders"]
+
+        written, errors = {}, []
+        lock = threading.Lock()
+
+        def writer(tid):
+            for i in range(4):
+                try:
+                    a = assign(master)
+                    data = f"durable-{tid}-{i}".encode() * 20
+                    body, ctype = multipart_body("g", data)
+                    st, _, _ = raw_request(
+                        vs.fast_url, "POST", f"/{a['fid']}", body,
+                        {"Content-Type": ctype})
+                    if st != 200:
+                        errors.append(f"write {st}")
+                    else:
+                        with lock:
+                            written[a["fid"]] = data
+                except Exception as e:  # noqa: BLE001
+                    errors.append(str(e))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(not t.is_alive() for t in threads)
+        assert not errors, errors[:5]
+        assert len(written) == 64
+
+        snap = vs.fast_plane.sync_stats()
+        riders = snap["riders"] - base_riders
+        batches = snap["batches"] - base_batches
+        assert riders >= 64  # every acked append was group-synced
+        assert 1 <= batches < riders, (batches, riders)  # amortized
+        assert snap["failures"] == 0
+        assert snap["pending"] == 0
+        # acked == readable, bit-identical, on both planes
+        for fid, data in written.items():
+            assert http_call("GET", f"http://{vs.fast_url}/{fid}") \
+                == data
+        # fast-path DELETE tombstones ride the same commit window
+        doomed = next(iter(written))
+        st, _, _ = raw_request(vs.fast_url, "DELETE", f"/{doomed}")
+        assert st == 200
+        assert vs.fast_plane.sync_stats()["riders"] > snap["riders"]
+
+    def test_stats_off_group_commit_is_clock_free(self, durable_cluster):
+        """SW_PLANE_STATS=0 must keep the committer clock-free: batch
+        and rider exact-counts still advance, but the fsync latency
+        histogram and µs sum are frozen (no mono_us() on the write
+        path)."""
+        master, vs = durable_cluster
+        vs.fast_plane.set_stats_enabled(False)
+        try:
+            s0 = vs.fast_plane.sync_stats()
+            for i in range(6):
+                a = assign(master)
+                body, ctype = multipart_body("c", f"tick-{i}".encode())
+                assert raw_request(
+                    vs.fast_url, "POST", f"/{a['fid']}", body,
+                    {"Content-Type": ctype})[0] == 200
+            s1 = vs.fast_plane.sync_stats()
+            assert s1["riders"] - s0["riders"] >= 6
+            assert s1["batches"] > s0["batches"]
+            assert s1["fsync_us_sum"] == s0["fsync_us_sum"]
+            total0 = sum(c for _b, c in s0["buckets"])
+            total1 = sum(c for _b, c in s1["buckets"])
+            assert total1 == total0, "stats-off batch took a timestamp"
+        finally:
+            vs.fast_plane.set_stats_enabled(True)
+
+    def test_admin_durability_endpoint_and_metrics(self, durable_cluster):
+        """GET /admin/plane/durability books the committer through the
+        Python server; the plane_fsync_* families ride /metrics."""
+        master, vs = durable_cluster
+        a = assign(master)
+        body, ctype = multipart_body("m", b"observable")
+        assert raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                           {"Content-Type": ctype})[0] == 200
+        view = get_json(f"http://{vs.url}/admin/plane/durability")
+        assert view["plane"] is True
+        d = view["durability"]
+        assert d["mode"] == "group"
+        assert set(d) >= {"mode", "batch_us", "max_pending", "batches",
+                          "riders", "failures", "pending", "buckets"}
+        assert d["batches"] >= 1 and d["riders"] >= 1
+        body = raw_request(vs.url, "GET", "/metrics")[2].decode()
+        for fam in ("plane_fsync_batches_total",
+                    "plane_fsync_riders_total",
+                    "plane_fsync_failures_total",
+                    "plane_fsync_seconds",
+                    "plane_fsync_pending"):
+            assert f"SeaweedFS_volumeServer_{fam}" in body, fam
+
+    def test_always_mode_one_fsync_per_append(self, tmp_path):
+        """mode=always is the unamortized baseline: every acked append
+        carries its own fdatasync, so batches == riders exactly."""
+        os.environ["SW_PLANE_FSYNC_MODE"] = "always"
+        master = MasterServer(port=0, pulse_seconds=1).start()
+        vs = start_vs(tmp_path, master, name="valw")
+        try:
+            snap = vs.fast_plane.sync_stats()
+            assert snap["mode"] == "always"
+            fids = []
+            for i in range(8):
+                a = assign(master)
+                body, ctype = multipart_body("a", f"solo-{i}".encode())
+                assert raw_request(
+                    vs.fast_url, "POST", f"/{a['fid']}", body,
+                    {"Content-Type": ctype})[0] == 200
+                fids.append(a["fid"])
+            snap = vs.fast_plane.sync_stats()
+            assert snap["batches"] == snap["riders"] >= 8
+            for i, fid in enumerate(fids):
+                assert http_call("GET", f"http://{vs.url}/{fid}") \
+                    == f"solo-{i}".encode()
+        finally:
+            vs.stop()
+            master.stop()
+            for k in DURABILITY_KNOBS:
+                os.environ.pop(k, None)
+
+    def test_torn_lease_demotes_to_python_append(self, durable_cluster):
+        """A lease torn down underneath the volume (the fail-stop /
+        poisoned-batch shape) must demote: the SAME logical write
+        retries on the Python append path — no lost ack, no wedged
+        volume — and the Python path fsyncs under the shared knob."""
+        master, vs = durable_cluster
+        a = assign(master)
+        vid = int(a["fid"].split(",")[0])
+        body, ctype = multipart_body("w", b"pre-tear")
+        assert raw_request(vs.fast_url, "POST", f"/{a['fid']}", body,
+                           {"Content-Type": ctype})[0] == 200
+        v = vs.store.find_volume(vid)
+        assert v.fast_writer is not None
+        # tear the lease down in the plane WITHOUT telling the volume —
+        # the next delegated append sees the writer gone (ambiguity)
+        assert vs.fast_plane.disable_writer(vid) >= 0
+        a2 = assign(master)
+        while int(a2["fid"].split(",")[0]) != vid:
+            a2 = assign(master)
+        out = post_multipart(f"http://{vs.url}/{a2['fid']}", "t",
+                             b"post-tear-landed")
+        assert out["size"] == len(b"post-tear-landed")
+        assert v.fast_writer is None, "demotion must drop the writer"
+        for fid, want in ((a["fid"], b"pre-tear"),
+                          (a2["fid"], b"post-tear-landed")):
+            assert http_call("GET", f"http://{vs.url}/{fid}") == want
+
+    def test_python_path_append_fsyncs_under_knob(self, tmp_path,
+                                                  monkeypatch):
+        """The uniform ack contract: with the knob on, a pure-Python
+        append fdatasyncs the .dat AND the .idx before returning; with
+        it off, the write path issues no fsync at all."""
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+        synced = []
+        real_fdatasync = os.fdatasync
+
+        def counting_fdatasync(fd):
+            synced.append(fd)
+            return real_fdatasync(fd)
+
+        monkeypatch.setattr(os, "fdatasync", counting_fdatasync)
+        v = Volume(str(tmp_path / "pyfsync"), "", 9, create=True)
+        try:
+            monkeypatch.setenv("SW_PLANE_FSYNC_MODE", "group")
+            v.write_needle(Needle(cookie=0x1, id=1, data=b"d" * 64))
+            assert len(synced) == 2  # .dat + .idx, exactly once each
+            v.delete_needle(Needle(cookie=0x1, id=1))
+            assert len(synced) == 4  # the tombstone ack too
+            synced.clear()
+            monkeypatch.setenv("SW_PLANE_FSYNC_MODE", "off")
+            v.write_needle(Needle(cookie=0x2, id=2, data=b"e" * 64))
+            assert synced == [], "mode=off must stay fsync-free"
+        finally:
+            v.close()
 
 
 def test_benchmark_batch_assign_all_native(tmp_path):

@@ -192,10 +192,14 @@ def test_volume_copy_keeps_source(cluster3):
                   if n["url"] != source)
     run_command(env, f"volume.copy -volumeId {vid} -target {target}")
     assert "copied" in out.getvalue()
-    time.sleep(1.5)  # both holders reach the master via pulse
-    env2, _ = _env(master)
-    urls = {r["url"] for r in env2.all_volumes()[str(vid)]}
-    assert urls == {source, target}
+    # converge: both holders reach the master via pulse
+    from conftest import wait_until
+
+    def replica_urls():
+        return {r["url"] for r in _env(master)[0].all_volumes()[str(vid)]}
+    assert wait_until(lambda: replica_urls() == {source, target}), \
+        replica_urls()
+    urls = replica_urls()
     # the data reads identically from both holders
     import seaweedfs_tpu.server.http_util as hu
     for fid, data in payloads.items():
@@ -207,7 +211,10 @@ def test_volume_copy_keeps_source(cluster3):
     assert out.get("size") == len(b"post-copy-write")
     # a pre-frozen replica must stay frozen through a copy
     hu.post_json(f"http://{source}/admin/volume/readonly?volume={vid}")
-    time.sleep(1.5)  # the freeze reaches the master via pulse
+    # converge: the freeze reaches the master via pulse
+    assert wait_until(lambda: any(
+        r["url"] == source and r.get("read_only")
+        for r in _env(master)[0].all_volumes()[str(vid)]))
     env3, _ = _env(master)
     other = next(n["url"] for n in env3.cluster_nodes()
                  if n["url"] not in (source, target))

@@ -194,10 +194,17 @@ def test_lock_depth_covers_children_and_expires(stack):
     assert ei.value.code == 423
     dav_call(dav, "PUT", "/ldir/child.txt", b"x",
              headers={"If": f"(<{token}>)"})
-    # and it expires
-    import time as _time
-    _time.sleep(1.2)
-    dav_call(dav, "PUT", "/ldir/child.txt", b"after-expiry")
+    # and it expires — converge on the reap instead of sleeping past it
+    from conftest import wait_until
+
+    def put_after_expiry():
+        try:
+            dav_call(dav, "PUT", "/ldir/child.txt", b"after-expiry")
+            return True
+        except urllib.error.HTTPError as e:
+            assert e.code == 423
+            return False
+    assert wait_until(put_after_expiry), "lock never expired"
     assert dav_call(dav, "GET", "/ldir/child.txt")[2] == b"after-expiry"
 
 
