@@ -113,19 +113,33 @@ class _ConstCache:
     def __init__(self, maxsize: int = 32):
         self._entries: OrderedDict = OrderedDict()
         self._maxsize = maxsize
+        from .device_stats import DEVICE_STATS
+        DEVICE_STATS.register_const_cache(self)
 
     def get(self, key, make):
+        from .device_stats import DEVICE_STATS
         hit = self._entries.get(key)
         if hit is not None:
             self._entries.move_to_end(key)
+            DEVICE_STATS.note_const_cache("hits")
             return hit
         val = make()
         from .telemetry import STATS
         STATS.add("bitmat_uploads")
+        DEVICE_STATS.note_const_cache("misses")
         self._entries[key] = val
         if len(self._entries) > self._maxsize:
             self._entries.popitem(last=False)
+            DEVICE_STATS.note_const_cache("evictions")
         return val
+
+    def occupancy(self) -> dict:
+        """Entries and device bytes currently pinned (best-effort:
+        constants without .nbytes count zero bytes)."""
+        nbytes = 0
+        for val in list(self._entries.values()):
+            nbytes += int(getattr(val, "nbytes", 0) or 0)
+        return {"entries": len(self._entries), "bytes": nbytes}
 
 
 class ReedSolomonCodec:

@@ -123,7 +123,15 @@ def _fused_fn(k: int, r: int, n: int, tile: int, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint8),
         interpret=interpret,
     )
-    return jax.jit(fn)
+    from . import device_stats
+    return device_stats.wrap(jax.jit(fn), "rs_pallas._fused_fn")
+
+
+from . import device_stats as _device_stats  # noqa: E402
+
+_device_stats.register_jit_factory("rs_pallas._fused_fn", _fused_fn)
+_device_stats.register_jit_factory("rs_pallas._fused_bitmat_cached",
+                                   _fused_bitmat_cached)
 
 
 def _use_interpret() -> bool:

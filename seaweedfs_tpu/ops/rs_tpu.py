@@ -30,7 +30,14 @@ import functools
 import numpy as np
 
 from .codec import ReedSolomonCodec
+from . import device_stats
 from . import gf256
+from ..util import config
+
+#: lru maxsize for the jit factories below — read once at import, a
+#: registered knob so eviction pressure (a silent recompile source) is
+#: tunable and visible in ec_xla_jit_cache_total.
+_JIT_CACHE_SIZE = config.env_int("SW_EC_JIT_CACHE_SIZE")
 
 
 def _jax():
@@ -39,7 +46,7 @@ def _jax():
     return jax, jnp
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=_JIT_CACHE_SIZE)
 def _coded_fn(k: int, r: int, n: int):
     """Jitted (bitmat (k*8, r*8) int8, data (k, n) uint8) -> (r, n) uint8."""
     jax, jnp = _jax()
@@ -58,16 +65,16 @@ def _coded_fn(k: int, r: int, n: int):
         weights = (jnp.uint8(1) << shifts)[None, :, None]
         return (ybits * weights).sum(axis=1, dtype=jnp.uint8)
 
-    return jax.jit(fn)
+    return device_stats.wrap(jax.jit(fn), "rs_tpu._coded_fn")
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=_JIT_CACHE_SIZE)
 def _bitmat_cached(coeff_bytes: bytes, r: int, k: int):
     coeffs = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(r, k)
     return gf256.bit_matrix(coeffs).astype(np.int8)
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=_JIT_CACHE_SIZE)
 def _packed_fn(k: int, r: int, n: int):
     """Jitted (packed bitmat (ceil(k*8/32), r*8) uint32, data (k, n)
     uint8) -> (r, n) uint8 — the AND/popcount form of the GF(2) matmul.
@@ -108,13 +115,21 @@ def _packed_fn(k: int, r: int, n: int):
             outs.append(byte.astype(jnp.uint8))
         return jnp.stack(outs)
 
-    return jax.jit(fn)
+    return device_stats.wrap(jax.jit(fn), "rs_tpu._packed_fn")
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=_JIT_CACHE_SIZE)
 def _packed_bitmat(coeff_bytes: bytes, r: int, k: int):
     coeffs = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(r, k)
     return gf256.pack_bit_matrix(coeffs)
+
+
+for _name, _factory in (("rs_tpu._coded_fn", _coded_fn),
+                        ("rs_tpu._bitmat_cached", _bitmat_cached),
+                        ("rs_tpu._packed_fn", _packed_fn),
+                        ("rs_tpu._packed_bitmat", _packed_bitmat)):
+    device_stats.register_jit_factory(_name, _factory)
+del _name, _factory
 
 
 def on_tpu() -> bool:

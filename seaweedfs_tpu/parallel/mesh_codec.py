@@ -49,7 +49,7 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-from ..ops import gf256
+from ..ops import device_stats, gf256
 from ..ops.codec import ReedSolomonCodec, _ConstCache, small_dispatch_default
 from ..ops.rs_tpu import width_bucket
 from ..ops.telemetry import STATS
@@ -144,11 +144,13 @@ class MeshCodec(ReedSolomonCodec):
                 return jnp.stack(outs)
 
         mesh = self.mesh
-        fn = jax.jit(
-            program,
-            in_shardings=(NamedSharding(mesh, P(None, None)),
-                          NamedSharding(mesh, P(None, "data"))),
-            out_shardings=NamedSharding(mesh, P(None, "data")))
+        fn = device_stats.wrap(
+            jax.jit(
+                program,
+                in_shardings=(NamedSharding(mesh, P(None, None)),
+                              NamedSharding(mesh, P(None, "data"))),
+                out_shardings=NamedSharding(mesh, P(None, "data"))),
+            "mesh_codec._fn")
         self._fns[key] = fn
         return fn
 

@@ -25,7 +25,7 @@ import functools
 
 import numpy as np
 
-from ..ops import gf256
+from ..ops import device_stats, gf256
 
 
 def _pad_rows(mat: np.ndarray, mult: int) -> np.ndarray:
@@ -81,11 +81,13 @@ def sharded_encode_fn(mesh, k: int, m: int, n: int):
     # partitions over 'data')
     out_rows = "shard" if m % mesh.shape["shard"] == 0 else None
     bm_spec, data_spec = encode_in_specs(mesh, m)
-    jfn = jax.jit(
-        fn,
-        in_shardings=(NamedSharding(mesh, bm_spec),
-                      NamedSharding(mesh, data_spec)),
-        out_shardings=NamedSharding(mesh, P(out_rows, "data")))
+    jfn = device_stats.wrap(
+        jax.jit(
+            fn,
+            in_shardings=(NamedSharding(mesh, bm_spec),
+                          NamedSharding(mesh, data_spec)),
+            out_shardings=NamedSharding(mesh, P(out_rows, "data"))),
+        "sharded_ec.encode_fn")
     return jfn, bitmat
 
 
@@ -140,11 +142,13 @@ def sharded_rebuild_fn(mesh, k: int, n_out_shards: int, n: int):
         return smap(bitmat_dec, x)
 
     bm_spec, surv_spec = rebuild_in_specs(mesh)
-    return jax.jit(
-        fn,
-        in_shardings=(NamedSharding(mesh, bm_spec),
-                      NamedSharding(mesh, surv_spec)),
-        out_shardings=NamedSharding(mesh, P(None, "data")))
+    return device_stats.wrap(
+        jax.jit(
+            fn,
+            in_shardings=(NamedSharding(mesh, bm_spec),
+                          NamedSharding(mesh, surv_spec)),
+            out_shardings=NamedSharding(mesh, P(None, "data"))),
+        "sharded_ec.rebuild_fn")
 
 
 def decode_bitmat(k: int, m: int, survivor_rows, missing_rows,

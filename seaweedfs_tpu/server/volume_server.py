@@ -89,6 +89,7 @@ class VolumeServer:
         router.add("GET", "/admin/plane/cache", self.admin_plane_cache)
         router.add("GET", "/admin/plane/durability",
                    self.admin_plane_durability)
+        router.add("GET", "/admin/devices", self.admin_devices)
         router.add("POST", "/admin/profile", profile_handler)
         router.add("GET", "/stats/disk", self.stats_disk)
         router.add("GET", "/stats/memory", self.stats_memory)
@@ -664,6 +665,15 @@ class VolumeServer:
         from .http_util import pool_stats_snapshot
         for event, total in pool_stats_snapshot().items():
             HTTP_POOL_CHURN_COUNTER.set_total(total, event)
+        # device-runtime plane: compile/recompile accounting, sampled
+        # device time, const-cache + jit-factory occupancy. The
+        # inventory is only exported when jax is already initialized —
+        # a scrape must never be the thing that boots a backend.
+        from ..ops import device_stats as _ds
+        from ..stats.metrics import observe_device_stats
+        observe_device_stats(_ds.DEVICE_STATS.snapshot(),
+                             _ds.jit_factory_snapshot(),
+                             _ds.device_inventory())
         # degraded-read engine counters (engine-global, same mirror
         # pattern; the per-read latency histogram streams in live via
         # the engine's on_read hook)
@@ -688,6 +698,16 @@ class VolumeServer:
         return {"plane": True,
                 "slow": self.fast_plane.slow_requests(),
                 "stats": self.fast_plane.stats()}
+
+    def admin_devices(self, req: Request):
+        """Device-runtime snapshot (ops/device_stats): per-entry-point
+        compile/recompile/dispatch counters with the latched recompile
+        sentinel, sampled device seconds, jit-factory cache_info,
+        const-cache occupancy, and the device inventory incl.
+        memory_stats(). Forces backend init — this endpoint exists to
+        answer questions about devices."""
+        from ..ops import device_stats as _ds
+        return _ds.admin_snapshot()
 
     def admin_plane_cache(self, req: Request):
         """Native-plane reconstructed-slab cache counters + EC serving

@@ -83,6 +83,38 @@ def cluster_repairs(env: CommandEnv, args: List[str]):
                   f"ttr={inc.get('time_to_re_protection_s', 0.0):.1f}s")
 
 
+@command("cluster.devices",
+         ": device-runtime snapshot per node (GET /admin/devices) — "
+         "platform, device kind×count, XLA compiles/recompiles with the "
+         "latched sentinel, and cached constant bytes")
+def cluster_devices(env: CommandEnv, args: List[str]):
+    nodes = env.cluster_nodes()
+    env.write(f"cluster.devices: {len(nodes)} nodes")
+    for n in nodes:
+        url = n["url"]
+        try:
+            snap = env.node_get(url, "/admin/devices")
+        except HttpError as e:
+            env.write(f"  {url}  unreachable: {e}")
+            continue
+        inv = snap.get("inventory") or {}
+        stats = snap.get("stats") or {}
+        kinds = " ".join(f"{kind}x{count}" for kind, count in
+                         sorted((inv.get("device_kinds") or {}).items()))
+        compiles = sum((stats.get("compiles") or {}).values())
+        recompiles = sum((stats.get("recompiles") or {}).values())
+        occ = stats.get("const_cache_occupancy") or {}
+        sentinel = "  SENTINEL" if stats.get("sentinel") else ""
+        env.write(
+            f"  {url}  platform={inv.get('platform')}"
+            f"  devices={kinds or 'none'}"
+            f"  compiles={compiles} recompiles={recompiles}"
+            f"  const_cache={occ.get('entries', 0)}"
+            f"/{occ.get('bytes', 0)}B{sentinel}")
+        for off in (stats.get("offenders") or []):
+            env.write(f"    recompile offender: {off}")
+
+
 @command("cluster.profile",
          "[-seconds 2] [-o <file>]: sample every server's Python "
          "threads (POST /admin/profile) and merge the collapsed stacks "

@@ -673,6 +673,116 @@ def observe_mesh(stats: Dict):
         VOLUME_EC_MESH_BUSY_FRAC_GAUGE.set(frac, str(dev))
 
 
+# -- device-runtime plane (ops/device_stats via observe_device_stats) --------
+
+VOLUME_EC_XLA_COMPILES = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_compiles_total",
+    "XLA executables compiled per instrumented jit entry point "
+    "(ops/device_stats.wrap: one AOT lower().compile() per abstract "
+    "shape signature).",
+    labels=("entry",))
+VOLUME_EC_XLA_COMPILE_SECONDS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_compile_seconds_total",
+    "Wall seconds spent inside timed lower().compile() calls per "
+    "entry point — the warmup cost bench.py splits out of every "
+    "headline.",
+    labels=("entry",))
+VOLUME_EC_XLA_RECOMPILES = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_recompiles_total",
+    "Compiles beyond the first for the same (entry, width-bucket) "
+    "pair — broken width-bucketing as a counter, not a wall-time "
+    "mystery. Steady state is 0.",
+    labels=("entry",))
+VOLUME_EC_XLA_RECOMPILE_SENTINEL = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_xla_recompile_sentinel",
+    "Latches to 1 the first time any (entry, width-bucket) pair "
+    "compiles twice in this process; never resets.")
+VOLUME_EC_XLA_DISPATCHES = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_dispatches_total",
+    "Instrumented jit dispatches per entry point.",
+    labels=("entry",))
+VOLUME_EC_XLA_DEVICE_SAMPLES = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_device_samples_total",
+    "Dispatches timed through block_until_ready under "
+    "SW_EC_DEVICE_TIMING (every SW_EC_DEVICE_TIMING_SAMPLE'th).",
+    labels=("entry",))
+VOLUME_EC_XLA_DEVICE_SECONDS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_device_seconds_total",
+    "Summed sampled device seconds per entry point; multiply the "
+    "per-sample mean by ec_xla_dispatches_total for the estimated "
+    "total.",
+    labels=("entry",))
+VOLUME_EC_XLA_JIT_CACHE = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_xla_jit_cache_total",
+    "lru_cache jit-factory events (hits, misses, evictions); an "
+    "evicted jitted fn is a silent recompile on next use.",
+    labels=("factory", "event"))
+VOLUME_EC_XLA_JIT_CACHE_ENTRIES = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_xla_jit_cache_entries",
+    "Live entries per lru_cache jit factory (cache_info().currsize; "
+    "maxsize is SW_EC_JIT_CACHE_SIZE).",
+    labels=("factory",))
+VOLUME_EC_XLA_DEVICE_MEMORY = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_xla_device_memory_bytes",
+    "device.memory_stats() gauges where the backend exposes them "
+    "(bytes_in_use, peak_bytes_in_use, ... per device).",
+    labels=("device", "kind"))
+VOLUME_EC_CONST_CACHE_EVENTS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_const_cache_events_total",
+    "_ConstCache device-constant events (hits, misses, evictions); a "
+    "miss is one bit-matrix lift + upload, an eviction forces a "
+    "re-upload on next use.",
+    labels=("event",))
+VOLUME_EC_CONST_CACHE_ENTRIES = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_const_cache_entries",
+    "Device-resident coefficient constants held across all live "
+    "_ConstCache instances.")
+VOLUME_EC_CONST_CACHE_BYTES = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_const_cache_bytes",
+    "Device bytes pinned by cached coefficient constants across all "
+    "live _ConstCache instances.")
+
+
+def observe_device_stats(snap: Dict, factories: Dict = None,
+                         inventory: Dict = None):
+    """Mirror an ops/device_stats snapshot (plus optional jit-factory
+    cache_info and device inventory) onto the volume registry. Uses
+    set_total: the plane's counters are process-global monotonic, so
+    each scrape overwrites rather than accumulates."""
+    if not snap:
+        return
+    for entry, n in snap.get("compiles", {}).items():
+        VOLUME_EC_XLA_COMPILES.set_total(n, entry)
+    for entry, s in snap.get("compile_seconds", {}).items():
+        VOLUME_EC_XLA_COMPILE_SECONDS.set_total(s, entry)
+    for entry, n in snap.get("recompiles", {}).items():
+        VOLUME_EC_XLA_RECOMPILES.set_total(n, entry)
+    VOLUME_EC_XLA_RECOMPILE_SENTINEL.set(
+        1 if snap.get("sentinel") else 0)
+    for entry, n in snap.get("dispatches", {}).items():
+        VOLUME_EC_XLA_DISPATCHES.set_total(n, entry)
+    for entry, n in snap.get("device_samples", {}).items():
+        VOLUME_EC_XLA_DEVICE_SAMPLES.set_total(n, entry)
+    for entry, s in snap.get("device_seconds", {}).items():
+        VOLUME_EC_XLA_DEVICE_SECONDS.set_total(s, entry)
+    for event, n in snap.get("const_cache", {}).items():
+        VOLUME_EC_CONST_CACHE_EVENTS.set_total(n, event)
+    occ = snap.get("const_cache_occupancy") or {}
+    VOLUME_EC_CONST_CACHE_ENTRIES.set(occ.get("entries", 0))
+    VOLUME_EC_CONST_CACHE_BYTES.set(occ.get("bytes", 0))
+    for factory, info in (factories or {}).items():
+        for event in ("hits", "misses", "evictions"):
+            VOLUME_EC_XLA_JIT_CACHE.set_total(
+                info.get(event, 0), factory, event)
+        VOLUME_EC_XLA_JIT_CACHE_ENTRIES.set(
+            info.get("currsize", 0), factory)
+    for dev in (inventory or {}).get("devices", []):
+        name = f"{(inventory or {}).get('platform')}:{dev.get('id')}"
+        for kind, val in (dev.get("memory_stats") or {}).items():
+            if isinstance(val, (int, float)):
+                VOLUME_EC_XLA_DEVICE_MEMORY.set(val, name, str(kind))
+
+
 # -- trace repair (ec/decoder.rebuild_ec_file_repair via observe_repair) -----
 
 VOLUME_EC_REPAIR_COUNTER = VOLUME_SERVER_GATHER.counter(

@@ -1,0 +1,153 @@
+"""tools/bench_diff.py: regression detection between bench records.
+
+Exercised against the REAL r04/r05 records from RESULTS/ (the r05 run
+where cluster rebuild throughput fell off a cliff) plus synthetic
+fixtures for threshold/exit-code behavior.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_diff  # noqa: E402
+
+R04 = os.path.join(REPO, "BENCH_r04.json")
+R05 = os.path.join(REPO, "BENCH_r05.json")
+
+needs_records = pytest.mark.skipif(
+    not (os.path.exists(R04) and os.path.exists(R05)),
+    reason="bench records not checked in")
+
+
+class TestDirection:
+    def test_throughput_metrics_higher_is_better(self):
+        for m in ("cluster_rebuild.rebuild_mbps_volume_bytes",
+                  "bench.write_rps", "matmul.value",
+                  "degraded_read.speedup"):
+            assert bench_diff.direction(m) is True
+
+    def test_latency_and_failure_metrics_lower_is_better(self):
+        for m in ("cluster_rebuild.rebuild_s", "plane.p99_ms",
+                  "cluster_rebuild.recompiles", "read.errors"):
+            assert bench_diff.direction(m) is False
+
+    def test_unclassified_metrics_never_flagged(self):
+        assert bench_diff.direction("bench.shard_count") is None
+        d = bench_diff.diff_records({"shard_count": 10},
+                                    {"shard_count": 1}, 0.2)
+        assert d["regressions"] == []
+        assert [u["metric"] for u in d["unclassified"]] == \
+            ["shard_count"]
+
+
+class TestFlatten:
+    def test_nested_numeric_leaves_dotted(self):
+        flat = bench_diff.flatten(
+            {"a": {"b_s": 1.5, "skip": "text", "flag": True,
+                   "arr": [1, 2]}, "top_rps": 3})
+        assert flat == {"a.b_s": 1.5, "top_rps": 3}
+
+    def test_driver_wrapper_unwrapped(self, tmp_path):
+        p = tmp_path / "rec.json"
+        p.write_text(json.dumps(
+            {"n": 5, "rc": 0, "parsed": {"x_rps": 7}}))
+        assert bench_diff.load_record(str(p)) == {"x_rps": 7}
+
+
+class TestDiffRecords:
+    def test_regression_beyond_threshold_flagged_worst_first(self):
+        d = bench_diff.diff_records(
+            {"a_mbps": 100, "b_mbps": 100, "c_s": 1.0},
+            {"a_mbps": 50, "b_mbps": 79, "c_s": 1.1}, 0.2)
+        metrics = [r["metric"] for r in d["regressions"]]
+        assert metrics == ["a_mbps", "b_mbps"]  # -50% before -21%
+        assert d["regressions"][0]["delta_frac"] == pytest.approx(-0.5)
+
+    def test_within_threshold_not_flagged(self):
+        d = bench_diff.diff_records({"a_mbps": 100}, {"a_mbps": 85},
+                                    0.2)
+        assert d["regressions"] == []
+
+    def test_improvements_and_added_removed(self):
+        d = bench_diff.diff_records({"a_mbps": 100, "gone_s": 1.0},
+                                    {"a_mbps": 200, "new_rps": 5}, 0.2)
+        assert [i["metric"] for i in d["improvements"]] == ["a_mbps"]
+        assert d["added"] == ["new_rps"]
+        assert d["removed"] == ["gone_s"]
+
+    def test_lower_is_better_regression(self):
+        d = bench_diff.diff_records({"p99_ms": 10}, {"p99_ms": 30},
+                                    0.2)
+        assert [r["metric"] for r in d["regressions"]] == ["p99_ms"]
+
+
+@needs_records
+class TestRealRecords:
+    def test_r04_to_r05_runs_clean(self, capsys):
+        """r04 predates the cluster-rebuild drill, so the r05 cliff
+        surfaces as ADDED metrics, not a regression — the differ must
+        not crash on records with disjoint drill sets."""
+        rc = bench_diff.main([R04, R05])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "cluster_rebuild" in out  # listed under added
+
+    def test_rebuild_cliff_flagged(self, tmp_path, capsys):
+        """Graft the healthy 72 MB/s rebuild figure onto r04 — the 2
+        MB/s figure r05 actually recorded must then be flagged."""
+        with open(R04) as f:
+            old = json.load(f)
+        old["parsed"]["cluster_rebuild"] = {
+            "rebuild_mbps_volume_bytes": 72}
+        p = tmp_path / "r04_healthy.json"
+        p.write_text(json.dumps(old))
+        rc = bench_diff.main([str(p), R05])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "cluster_rebuild.rebuild_mbps_volume_bytes" in out
+        assert "-97" in out  # 72 -> 2 is a -97.2% cliff
+
+    def test_json_output_machine_readable(self, tmp_path, capsys):
+        with open(R04) as f:
+            old = json.load(f)
+        old["parsed"]["cluster_rebuild"] = {
+            "rebuild_mbps_volume_bytes": 72}
+        p = tmp_path / "r04_healthy.json"
+        p.write_text(json.dumps(old))
+        rc = bench_diff.main([str(p), R05, "--json"])
+        assert rc == 1
+        d = json.loads(capsys.readouterr().out)
+        cliff = next(
+            r for r in d["regressions"]
+            if r["metric"] == "cluster_rebuild.rebuild_mbps_volume_bytes")
+        assert cliff["old"] == 72
+        assert cliff["new"] == 2
+        assert cliff["delta_frac"] == pytest.approx(-70 / 72,
+                                                    abs=1e-4)
+
+    def test_threshold_knob(self, capsys):
+        """At an absurd threshold nothing in r04->r05 regresses."""
+        rc = bench_diff.main([R04, R05, "--threshold", "10.0"])
+        assert rc == 0
+        capsys.readouterr()
+
+
+class TestExitCodes:
+    def test_unreadable_input_rc2(self, tmp_path, capsys):
+        rc = bench_diff.main([str(tmp_path / "missing.json"),
+                              str(tmp_path / "also_missing.json")])
+        assert rc == 2
+        capsys.readouterr()
+
+    def test_malformed_json_rc2(self, tmp_path, capsys):
+        p = tmp_path / "bad.json"
+        p.write_text("{not json")
+        q = tmp_path / "ok.json"
+        q.write_text("{}")
+        assert bench_diff.main([str(p), str(q)]) == 2
+        capsys.readouterr()

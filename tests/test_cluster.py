@@ -121,10 +121,18 @@ def test_ec_encode_spread_and_degraded_read(cluster, tmp_path):
               f"&shards=7,8,9,10,11,12,13")
     post_json(f"http://{src.url}/admin/ec/mount?volume={vid}&collection=ecc"
               f"&shards=0,1,2,3,4,5,6")
-    # drop the original volume everywhere
+    # drop the original volume everywhere; wait for the stores to shed
+    # it instead of sleeping across a pulse (master lookup keeps
+    # resolving the id through the EC map, so it can't be the signal)
     for u in op.lookup(master.url, vid):
         post_json(f"http://{u}/admin/delete_volume?volume={vid}")
-    time.sleep(0.1)
+    from conftest import wait_until
+
+    def volume_dropped():
+        return not (vs0.store.find_volume(vid)
+                    or vs1.store.find_volume(vid))
+
+    assert wait_until(volume_dropped, timeout=10)
 
     # reads must now resolve through EC: local shards + remote fetch
     for fid, data in list(payloads.items())[:5]:

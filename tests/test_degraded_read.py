@@ -390,15 +390,19 @@ def _lose_shards(env, victim, vid, to_lose):
                 if f.endswith(to_ext(sid)):
                     os.remove(os.path.join(loc.directory, f))
     victim.heartbeat_once()
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    from conftest import wait_until
+
+    def victim_dropped():
         info = env.ec_volumes().get(str(vid)) or {"shards": {}}
         shards = {int(s): urls for s, urls in info["shards"].items()}
         if all(s not in shards or victim.url not in shards[s]
                for s in to_lose):
-            return shards
-        time.sleep(0.2)
-    raise AssertionError(f"master never dropped shards {to_lose}")
+            return (shards,)  # 1-tuple: truthy even for an empty map
+        return None
+
+    got = wait_until(victim_dropped, timeout=10)
+    assert got, f"master never dropped shards {to_lose}"
+    return got[0]
 
 
 def _get(vs, fid):

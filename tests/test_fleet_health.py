@@ -428,13 +428,14 @@ class TestFleetHealthCluster:
             op.upload(a["url"], fid, payload, filename="f1")
             env = CommandEnv(master.url, out=io.StringIO())
             run_command(env, f"ec.encode -volumeId {vid}")
-            deadline = time.time() + 15
-            while time.time() < deadline:
-                ec = get_json(f"http://{master.url}/cluster/ec_lookup"
-                              f"?volumeId={vid}")
-                if len(ec.get("shards", {})) == TOTAL_SHARDS:
-                    break
-                time.sleep(0.2)
+            from conftest import wait_until
+            ec = wait_until(
+                lambda: (lambda m: m if len(m.get("shards", {}))
+                         == TOTAL_SHARDS else None)(
+                    get_json(f"http://{master.url}/cluster/ec_lookup"
+                             f"?volumeId={vid}")),
+                timeout=15)
+            assert ec, "encoded shards never reached the master"
             shards = {int(s): u for s, u in ec["shards"].items()}
             assert len(shards) == TOTAL_SHARDS
 
@@ -558,16 +559,15 @@ class TestFleetHealthCluster:
         post_json(f"http://{holder}/admin/ec/delete_shards"
                   f"?volume={vid}&collection={collection}"
                   f"&shards={sid}")
-        deadline = time.time() + 15
-        while time.time() < deadline:
+        from conftest import wait_until
+
+        def dropped():
             ec = get_json(f"http://{master.url}/cluster/ec_lookup"
                           f"?volumeId={vid}")
-            held = {int(s): u for s, u in
-                    ec.get("shards", {}).items()}
-            if sid not in held:
-                return
-            time.sleep(0.2)
-        raise AssertionError(f"shard {sid} still mapped after delete")
+            return sid not in {int(s) for s in ec.get("shards", {})}
+
+        assert wait_until(dropped, timeout=15), \
+            f"shard {sid} still mapped after delete"
 
     @staticmethod
     def _assert_merge_sums(merged_text, servers, family_suffix):

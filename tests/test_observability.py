@@ -230,11 +230,9 @@ class TestProfileEndpoint:
         t.start()
         try:
             env = CommandEnv(master.url, out=io.StringIO())
-            deadline = time.monotonic() + 15
-            while len(env.cluster_nodes()) < 2 and \
-                    time.monotonic() < deadline:
-                time.sleep(0.2)
-            assert len(env.cluster_nodes()) == 2
+            from conftest import wait_until
+            assert wait_until(
+                lambda: len(env.cluster_nodes()) == 2, timeout=15)
             out_path = str(tmp_path / "prof.folded")
             run_command(env,
                         f"cluster.profile -seconds 0.3 -o {out_path}")

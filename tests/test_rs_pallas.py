@@ -104,7 +104,8 @@ def test_fused_kernel_lowers_for_tpu_target(k, m):
     matrix = gf256.build_matrix(k, k + m, "vandermonde")
     fuse_bitmat(matrix[k:])  # host-side lift must build too
     fn = _fused_fn(k, m, n, pick_tile(k, m, n), False)
-    exported = jexport.export(fn, platforms=["tpu"])(
+    # jax.export wants the genuine jit, not the device_stats wrapper
+    exported = jexport.export(fn.raw_jit, platforms=["tpu"])(
         jax.ShapeDtypeStruct((8 * m, 8 * k), jnp.int8),
         jax.ShapeDtypeStruct((k, n), jnp.uint8))
     assert exported.platforms == ("tpu",)
