@@ -1381,6 +1381,285 @@ def measure_cluster_scrub_repair(n_volumes: int = None,
         _shutil.rmtree(workdir, ignore_errors=True)
 
 
+def measure_cluster_tiering(n_needles: int = None,
+                            needle_kb: int = None,
+                            n_servers: int = 3,
+                            readers: int = None,
+                            writers: int = None,
+                            rate_mbps: float = None) -> dict:
+    """f4 write-through tiering drill: one sealed hot volume is demoted
+    to EC through the shared stripe transport — rate-capped — WHILE
+    foreground readers hammer its needles and foreground writers keep
+    landing new data in other volumes. There is no drain window: reads
+    hit the hot replica until the EC mount flips (the replica delete),
+    then the stripe. Reports foreground p50/p99 during demotion vs
+    healthy, the demotion MB/s under the cap, zero failed/blocked
+    client writes, and bit-identical read-back across the flip."""
+    import shutil as _shutil
+    from seaweedfs_tpu.client import operation as op
+    from seaweedfs_tpu.server.http_util import get_json, post_json
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    n_needles = n_needles or config.env_int("SW_BENCH_TIER_NEEDLES")
+    needle_kb = needle_kb or config.env_int("SW_BENCH_TIER_KB")
+    readers = readers or config.env_int("SW_BENCH_TIER_READERS")
+    writers = writers or config.env_int("SW_BENCH_TIER_WRITERS")
+    if rate_mbps is None:
+        rate_mbps = config.env_float("SW_BENCH_TIER_RATE_MBPS")
+    workdir = tempfile.mkdtemp(prefix="swtier_")
+    master = MasterServer(
+        port=0, volume_size_limit_mb=config.env_int("SW_BENCH_TIER_MB"),
+        pulse_seconds=1).start()
+    servers = []
+    try:
+        for i in range(n_servers):
+            servers.append(VolumeServer(
+                port=0, directories=[os.path.join(workdir, f"v{i}")],
+                master_url=master.url, pulse_seconds=1,
+                max_volume_counts=[20], ec_backend="numpy").start())
+
+        # fill ONE volume of its own collection: assigns round-robin
+        # across the collection's volumes, keep only the first vid
+        rng = np.random.default_rng(47)
+        a0 = op.assign(master.url, collection="tier")
+        vid = int(a0["fid"].split(",")[0])
+        payloads = {}
+        hot_bytes = 0
+        attempts = 0
+        while len(payloads) < n_needles and attempts < n_needles * 30:
+            attempts += 1
+            a = a0 or op.assign(master.url, collection="tier")
+            a0 = None
+            if int(a["fid"].split(",")[0]) != vid:
+                continue
+            data = rng.integers(0, 256, needle_kb << 10,
+                                dtype=np.uint8).tobytes()
+            op.upload(a["url"], a["fid"], data,
+                      filename=f"t{len(payloads)}")
+            payloads[a["fid"]] = data
+            hot_bytes += len(data)
+        if len(payloads) < n_needles:
+            raise RuntimeError(
+                f"could not land {n_needles} needles on volume {vid}")
+
+        # seal it — readonly on every holder, then wait for the
+        # master's heartbeat view (the tierer scans that view)
+        for vs in servers:
+            if vs.store.find_volume(vid):
+                post_json(f"http://{vs.url}/admin/volume/readonly"
+                          f"?volume={vid}")
+                vs.heartbeat_once()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            vols = get_json(
+                f"http://{master.url}/cluster/volumes")["volumes"]
+            if any(r.get("read_only")
+                   for r in vols.get(str(vid), [])):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(f"volume {vid} never sealed at master")
+
+        def pct(lat):
+            lat = sorted(lat)
+            if not lat:
+                return 0.0, 0.0
+            return (lat[len(lat) // 2] * 1e3,
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3)
+
+        def fg_load(run, note):
+            """The foreground: readers hammer the sealed volume's
+            needles, writers keep landing fresh needles (assigns avoid
+            the sealed volume by construction) — while run() executes
+            in this thread. The SAME load shape runs for the healthy
+            baseline and the demotion window, so the p99 ratio
+            isolates the demotion itself, not the writer traffic."""
+            stop = threading.Event()
+            lat, rerr, wlat, wfail = [], [], [], []
+            lock = threading.Lock()
+            fids = list(payloads)
+
+            def hammer(tid):
+                i = tid
+                while not stop.is_set():
+                    fid = fids[i % len(fids)]
+                    t0 = time.perf_counter()
+                    try:
+                        got = op.read_file(master.url, fid)
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            rerr.append(f"{note} {fid}: {e!r}")
+                        continue
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lat.append(dt)
+                        if got != payloads[fid]:
+                            rerr.append(f"{note} {fid}: bytes differ")
+                    i += 1
+
+            def writer(tid):
+                wrng = np.random.default_rng(700 + tid)
+                while not stop.is_set():
+                    data = wrng.integers(0, 256, 8 << 10,
+                                         dtype=np.uint8).tobytes()
+                    t0 = time.perf_counter()
+                    try:
+                        op.upload_data(master.url, data,
+                                       filename=f"w{tid}")
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            wfail.append(repr(e))
+                        continue
+                    with lock:
+                        wlat.append(time.perf_counter() - t0)
+
+            fg = [threading.Thread(target=hammer, args=(t,),
+                                   daemon=True)
+                  for t in range(readers)]
+            fg += [threading.Thread(target=writer, args=(t,),
+                                    daemon=True)
+                   for t in range(writers)]
+            for t in fg:
+                t.start()
+            try:
+                ret = run()
+            finally:
+                stop.set()
+                for t in fg:
+                    t.join(timeout=30)
+            if rerr:
+                raise RuntimeError(rerr[0])
+            return ret, lat, wlat, wfail
+
+        # pacing floor: the producer cap applies to SHARD bytes — all
+        # k+m rows, padded up to the EC block layout (a small volume
+        # still pushes TOTAL x 1MB-small-block shards)
+        from seaweedfs_tpu.ec.encoder import ec_shard_base_size
+        shard_bytes = TOTAL * ec_shard_base_size(hot_bytes)
+        paced_floor_s = shard_bytes / (rate_mbps * 1e6) \
+            if rate_mbps else 0.0
+        # healthy baseline under the identical foreground load, for
+        # about as long as the demotion will run
+        _, lat_h, wlat_h, wfail_h = fg_load(
+            lambda: time.sleep(max(2.0, paced_floor_s)), "healthy")
+        healthy_p50, healthy_p99 = pct(lat_h)
+
+        # same load across the whole demotion, run synchronously here
+        master.tierer.age_s = 0.0        # sealed counts immediately
+        master.tierer.rate_mbps = rate_mbps
+        states, lat_d, wlat_d, wfail_d = fg_load(
+            master.tierer.run_pass, "during_demotion")
+        if states.get(vid) != "warm":
+            raise RuntimeError(f"demotion did not land: {states}")
+        during_p50, during_p99 = pct(lat_d)
+        w_lat = wlat_h + wlat_d
+        w_fail = wfail_h + wfail_d
+        # a write is "blocked" if it stalled well past the per-request
+        # noise floor — the no-drain claim is that client writes never
+        # wait on the data mover
+        blocked = sum(1 for dt in wlat_d if dt > 2.0)
+
+        # across the flip: hot replicas are gone, every byte must come
+        # back identical off the EC stripe
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and any(
+                vs.store.find_volume(vid) for vs in servers):
+            time.sleep(0.1)
+        bit_identical = all(op.read_file(master.url, fid) == data
+                            for fid, data in payloads.items())
+        if not bit_identical:
+            raise RuntimeError("post-flip read-back differs")
+
+        snap = master.tierer.snapshot()["volumes"][str(vid)]
+        out = {"servers": n_servers, "needles": len(payloads),
+               "needle_kb": needle_kb,
+               "hot_mb": round(hot_bytes / 1e6, 2),
+               "readers": readers, "writers": writers,
+               "rate_cap_mbps": rate_mbps,
+               "healthy_p50_ms": round(healthy_p50, 2),
+               "healthy_p99_ms": round(healthy_p99, 2),
+               "during_demotion_p50_ms": round(during_p50, 2),
+               "during_demotion_p99_ms": round(during_p99, 2),
+               "p99_ratio": round(during_p99 / healthy_p99, 2)
+               if healthy_p99 else None,
+               "reads_during_demotion": len(lat_d),
+               "writes_ok": len(w_lat),
+               "failed_writes": len(w_fail),
+               "blocked_writes": blocked,
+               "max_write_ms": round(max(w_lat) * 1e3, 2)
+               if w_lat else 0.0,
+               "demotion_wall_s": snap["wall_s"],
+               "demotion_mbps": snap["demote_mbps"],
+               "rate_cap_engaged": bool(
+                   paced_floor_s
+                   and snap["wall_s"] >= 0.9 * paced_floor_s),
+               "bit_identical": True}
+        log(f"cluster tiering: {out}")
+        return out
+    finally:
+        # master first: its tierer/repair loops must die before the
+        # holders vanish under them
+        master.stop()
+        for vs in servers:
+            vs.stop()
+        _shutil.rmtree(workdir, ignore_errors=True)
+
+
+def bench_diff_gate(record: dict, drill: str = None):
+    """Transport-parity gate: write this run's record next to the
+    historical BENCH_r*.json series and auto-diff against the newest
+    prior record via tools/bench_diff.py. Classified metrics that
+    regressed >20% exit 2 — the gate the unified-transport refactor
+    must hold (rebuild/encode throughput within noise of the pre-
+    refactor records). SW_BENCH_DIFF=0 disables the diff (the record
+    is still written). Standalone drills write BENCH_last_<drill>.json
+    wrapped as {drill: record} so their metric names line up with the
+    full records' nested extras; full runs append the next
+    BENCH_r<NN>.json."""
+    import glob
+    import re
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tools = os.path.join(repo, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    try:
+        import bench_diff
+    except Exception as e:  # noqa: BLE001 - the gate must not kill emit
+        log(f"bench_diff unavailable, gate skipped: {e!r}")
+        return
+    prior = sorted(glob.glob(os.path.join(repo, "BENCH_r[0-9]*.json")))
+    wrapped = {drill: record} if drill else dict(record)
+    if drill:
+        out_path = os.path.join(repo, f"BENCH_last_{drill}.json")
+    else:
+        nums = [int(re.search(r"BENCH_r(\d+)", p).group(1))
+                for p in prior]
+        out_path = os.path.join(
+            repo, f"BENCH_r{(max(nums) if nums else 0) + 1:02d}.json")
+    with open(out_path, "w") as f:
+        json.dump(wrapped, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench record written: {out_path}")
+    if not config.env_bool("SW_BENCH_DIFF"):
+        return
+    if not prior:
+        log("bench_diff: no prior BENCH_r*.json, gate skipped")
+        return
+    old_path = prior[-1]
+    try:
+        report = bench_diff.diff_records(
+            bench_diff.load_record(old_path),
+            bench_diff.load_record(out_path), threshold=0.2)
+    except Exception as e:  # noqa: BLE001 - unreadable prior record
+        log(f"bench_diff failed against {old_path}: {e!r}")
+        return
+    log(bench_diff.render_text(report, old_path, out_path))
+    if report["regressions"]:
+        log(f"bench_diff GATE: {len(report['regressions'])} metrics "
+            f"regressed >20% vs {os.path.basename(old_path)}")
+        raise SystemExit(2)
+
+
 def _jax_provenance() -> dict:
     """Stamp every emitted record with where the math actually ran —
     a CPU-fallback run (tunnel down) must be distinguishable from a
@@ -1413,6 +1692,10 @@ def emit(value: float, vs_baseline: float, kind: str, **extras):
     line.update(_jax_provenance())
     line.update(extras)
     print(json.dumps(line))
+    # every emitted record lands next to the BENCH_r*.json series and
+    # is auto-diffed against the newest prior one (exit 2 on >20%
+    # regressions; SW_BENCH_DIFF=0 to disable)
+    bench_diff_gate(line)
 
 
 def run_cluster_drill_subprocess(size_mb: int, n_servers: int) -> dict:
@@ -1959,6 +2242,12 @@ def secondary_configs(device_ok: bool, chained_by_geo: dict) -> dict:
         extras["cluster_scrub_repair"] = measure_cluster_scrub_repair()
     except Exception as e:  # noqa: BLE001 - secondary
         log(f"cluster scrub/repair bench failed: {e!r}")
+    # f4 write-through tiering: hot->warm demotion through the shared
+    # stripe transport under live reads/writes, rate-capped, no drain
+    try:
+        extras["cluster_tiering"] = measure_cluster_tiering()
+    except Exception as e:  # noqa: BLE001 - secondary
+        log(f"cluster tiering bench failed: {e!r}")
     # config 5 with a DEVICE backend (VERDICT r3 weak#5): the virtual
     # CPU mesh always (subprocess), plus the live single-chip mesh
     # when the tunnel is up
@@ -2217,6 +2506,7 @@ if __name__ == "__main__":
         result = measure_data_plane()
         result.update(_jax_provenance())
         print(json.dumps(result), flush=True)
+        bench_diff_gate(result, drill="data_plane")
     elif "cluster_scrub_repair" in sys.argv:
         # standalone integrity drill: detection latency, scrub MB/s,
         # scrub overhead on the foreground p99, TTR per incident kind
@@ -2225,5 +2515,16 @@ if __name__ == "__main__":
         result = measure_cluster_scrub_repair()
         result.update(_jax_provenance())
         print(json.dumps(result), flush=True)
+        bench_diff_gate(result, drill="cluster_scrub_repair")
+    elif "cluster_tiering" in sys.argv:
+        # standalone f4 tiering drill: foreground p50/p99 during a
+        # rate-capped hot->warm demotion vs healthy, demotion MB/s,
+        # zero failed/blocked writes, bit-identical across the flip
+        from seaweedfs_tpu.util.jax_platform import honor_platform_request
+        honor_platform_request()
+        result = measure_cluster_tiering()
+        result.update(_jax_provenance())
+        print(json.dumps(result), flush=True)
+        bench_diff_gate(result, drill="cluster_tiering")
     else:
         main()

@@ -13,12 +13,12 @@ from .constants import (  # noqa: F401
 from .encoder import write_ec_files, write_ec_files_spread, \
     write_sorted_file_from_idx, rebuild_ec_files, \
     rebuild_ec_files_streaming  # noqa: F401
+from .transport import (  # noqa: F401
+    GatherStats, LocalShardReader, LocalShardWriter, RemoteShardReader,
+    RemoteShardWriter, SpreadError, SpreadStats, TransportStats,
+)
 from .gather import (  # noqa: F401
-    GatherStats, LocalShardReader, RemoteShardReader, StripedGatherSource,
-    fetch_index_files, probe_shard_size,
+    StripedGatherSource, fetch_index_files, probe_shard_size,
 )
-from .spread import (  # noqa: F401
-    LocalShardWriter, RemoteShardWriter, SpreadError, SpreadStats,
-    StripedSpreadSink, spread_window,
-)
+from .spread import StripedSpreadSink, spread_window  # noqa: F401
 from .locate import Interval, locate_data  # noqa: F401

@@ -57,8 +57,9 @@ import numpy as np
 
 from ..util import config, tracing
 from .ec_volume import EcShardNotFound
-from .gather import (GatherStats, LocalShardReader, RemoteShardReader,
-                     ShardSizeCache, default_hedge_ms)
+from .gather import ShardSizeCache
+from .transport import (GatherStats, LocalShardReader, RemoteShardReader,
+                        default_hedge_ms)
 
 CACHE_BYTES_ENV = "SW_EC_DEGRADED_CACHE_BYTES"
 SLAB_BYTES_ENV = "SW_EC_DEGRADED_SLAB_BYTES"

@@ -1,59 +1,44 @@
-"""Streaming striped survivor gather for EC rebuild.
+"""Streaming striped survivor gather for EC rebuild — the *pull* role
+of ``ec/transport.py``.
 
 The copy-then-rebuild flow pulls every surviving shard whole onto the
 rebuilder before the first GF byte is computed — rebuild wall is
 gather + compute and the rebuilder briefly stores a full extra copy of
-the volume. This module replaces the gather side: a slab-granular
-source that fetches slab-aligned byte ranges of each survivor straight
-from its holders (the existing ranged ``/admin/ec/shard_read``
-endpoint, over ``http_util``'s keep-alive pool) and hands each arriving
-stripe to the pipelined decode while the next stripes are still in
-flight.
+the volume. The streaming gather instead fetches slab-aligned byte
+ranges of each survivor straight from its holders (the ranged
+``/admin/ec/shard_read`` endpoint over ``http_util``'s keep-alive
+pool) and hands each arriving stripe to the pipelined decode while the
+next stripes are still in flight.
 
-Shape of the stream: a *stripe* is one slab-aligned range
-``[off, off+w)`` of every chosen survivor — a ``(k, w)`` uint8 block,
-exactly what ``ops/pipeline.PipelinedMatmul`` consumes. Stripes are
-fetched with a bounded in-flight window (``SW_EC_GATHER_WINDOW``), so
-gather memory is O(window · k · slab), never O(volume), and yielded
-strictly in stripe order so the decoded slabs append to the rebuilt
-shard files in place.
-
-Straggler defenses:
-  * round-robin: when a shard has several replicas, stripe ``s`` leads
-    with holder ``s % len(holders)`` — consecutive stripes split across
-    the replicas instead of hammering one.
-  * retry: a failed range read fails over to the shard's remaining
-    holders in rotation order.
-  * hedging (``SW_EC_HEDGE_MS``, default off): if the leading holder
-    has not answered within the deadline, the same range is requested
-    from the next holder and the first response wins. The loser is NOT
-    cancelled — ``http_call`` reads its response to completion, so the
-    socket drains and parks back in the pool instead of leaking
-    mid-body.
+All of the transport — the bounded ``SW_EC_GATHER_WINDOW`` in-flight
+window with peak-buffer accounting, per-holder rotation, failover,
+``SW_EC_HEDGE_MS`` hedging with loser-drain health attribution, local
+fast paths — lives in ``ec/transport.py``, shared byte-for-byte with
+the spread push side. This module keeps only what is specific to
+pulling shards: shard-size probing, index-sidecar fetching, and the
+trace-repair projection readers/stream shape.
 """
 
 from __future__ import annotations
 
 import os
 import re
-import threading
-from ..util.locks import make_lock
 import time
-from collections import deque
-from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
-                                TimeoutError as _FutureTimeout, wait)
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..stats import health as _health
-from ..util import tracing
-from ..util import config
-from ..util.profiling import StageTimer
+from ..util.locks import make_lock
+from .transport import (  # noqa: F401  - the shared transport, pull role
+    DEFAULT_WINDOW, HEDGE_MS_ENV, GatherStats, LocalShardReader,
+    RemoteShardReader, StripedPull, TransportStats, default_hedge_ms,
+    hedge_pool, pull_window,
+)
 
-DEFAULT_WINDOW = 4
 GATHER_WINDOW_ENV = "SW_EC_GATHER_WINDOW"
-HEDGE_MS_ENV = "SW_EC_HEDGE_MS"
+
+# old private name — tests and older callers reach for it
+_hedge_pool = hedge_pool
 
 _CONTENT_RANGE_RE = re.compile(r"bytes\s+(\d+)-(\d+)/(\d+)")
 
@@ -74,275 +59,7 @@ def auto_slab(shard_size: int, default: int = 8 << 20,
 
 
 def gather_window() -> int:
-    return max(1, config.env_int(GATHER_WINDOW_ENV))
-
-
-def default_hedge_ms() -> float:
-    return config.env_float(HEDGE_MS_ENV)
-
-
-# hedged duplicates run here rather than in the gather pool: a stripe
-# worker submitting back into its own (possibly saturated) pool could
-# deadlock the window
-_HEDGE_POOL: Optional[ThreadPoolExecutor] = None
-_HEDGE_LOCK = make_lock("gather._HEDGE_LOCK")
-
-
-def _hedge_pool() -> ThreadPoolExecutor:
-    global _HEDGE_POOL
-    with _HEDGE_LOCK:
-        if _HEDGE_POOL is None:
-            _HEDGE_POOL = ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="ec-gather-hedge")
-        return _HEDGE_POOL
-
-
-class GatherStats:
-    """Counters + busy-time accounting shared by every reader of one
-    gather. Busy time is the UNION of fetch intervals (fetches overlap
-    across stripes/rows), so ``bytes / busy_s`` is the effective gather
-    bandwidth, comparable to what a serialized copy phase would need."""
-
-    def __init__(self):
-        self.timer = StageTimer()
-        self._lock = make_lock("gather.GatherStats._lock")
-        self.fetches = 0
-        self.bytes = 0
-        self.remote_bytes = 0
-        self.hedges_fired = 0
-        self.hedges_won = 0
-        self.hedges_lost = 0
-        self.retries = 0
-        self.stripes = 0
-        self.peak_buffered = 0
-        self.remote_shards = 0
-        self.local_shards = 0
-        # per-holder accounting feeds the health scoreboard drill:
-        # "routing on issues strictly fewer reads to the slow holder"
-        # is only assertable if someone counts reads per holder
-        self.holder_fetches: Dict[str, int] = {}
-        self.holder_errors: Dict[str, int] = {}
-
-    def add_fetch(self, nbytes: int, t0: float, t1: float,
-                  remote: bool = False, holder: Optional[str] = None):
-        self.timer.add("gather", t1 - t0, nbytes, interval=(t0, t1))
-        with self._lock:
-            self.fetches += 1
-            self.bytes += nbytes
-            if remote:
-                self.remote_bytes += nbytes
-            if holder:
-                self.holder_fetches[holder] = \
-                    self.holder_fetches.get(holder, 0) + 1
-
-    def add_holder_error(self, holder: str):
-        with self._lock:
-            self.holder_errors[holder] = \
-                self.holder_errors.get(holder, 0) + 1
-
-    def add_hedge_fired(self):
-        with self._lock:
-            self.hedges_fired += 1
-
-    def add_hedge_won(self):
-        with self._lock:
-            self.hedges_won += 1
-
-    def add_hedge_lost(self):
-        with self._lock:
-            self.hedges_lost += 1
-
-    def add_retry(self):
-        with self._lock:
-            self.retries += 1
-
-    def busy_s(self) -> float:
-        return self.timer.busy_time("gather")
-
-    def mbps(self) -> float:
-        busy = self.busy_s()
-        if busy <= 0:
-            return 0.0
-        return self.bytes / busy / 1e6
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return {
-                "gather_bytes": self.bytes,
-                "gather_remote_bytes": self.remote_bytes,
-                "gather_fetches": self.fetches,
-                "hedges_fired": self.hedges_fired,
-                "hedges_won": self.hedges_won,
-                "hedges_lost": self.hedges_lost,
-                "gather_retries": self.retries,
-                "gather_stripes": self.stripes,
-                "peak_gather_buffer": self.peak_buffered,
-                "holder_fetches": dict(self.holder_fetches),
-                "holder_errors": dict(self.holder_errors),
-            }
-
-
-class LocalShardReader:
-    """Range reads of a survivor shard already on the rebuilder's disk.
-    Opens per call — the gather pool reads several stripes of one shard
-    concurrently, and a shared seek pointer would race."""
-
-    remote = False
-
-    def __init__(self, path: str, stats: Optional[GatherStats] = None):
-        self.path = path
-        self.stats = stats or GatherStats()
-
-    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
-        t0 = time.perf_counter()
-        with open(self.path, "rb") as f:
-            f.seek(off)
-            data = f.read(n)
-        if len(data) != n:
-            raise IOError(f"short read of {self.path} at {off}: "
-                          f"{len(data)} < {n}")
-        self.stats.add_fetch(n, t0, time.perf_counter())
-        return data
-
-
-class RemoteShardReader:
-    """Ranged reads of one survivor shard from its holder set, with
-    round-robin striping, failover retries and optional hedging."""
-
-    remote = True
-
-    def __init__(self, vid: int, sid: int, holders: Sequence[str],
-                 stats: Optional[GatherStats] = None,
-                 timeout: float = 300.0,
-                 hedge_ms: Optional[float] = None):
-        if not holders:
-            raise ValueError(f"shard {vid}.{sid}: no holders")
-        self.vid = vid
-        self.sid = sid
-        self.holders = list(holders)
-        self.stats = stats or GatherStats()
-        self.span = None     # set by StripedGatherSource: trace parent
-        self.timeout = timeout
-        self.hedge_s = (default_hedge_ms() if hedge_ms is None
-                        else float(hedge_ms)) / 1000.0
-
-    # transport hooks — RemoteRepairReader overrides to hit the
-    # projected-read route with a different method/response size while
-    # inheriting rotation, failover and hedging unchanged
-    _method = "GET"
-    # health-scoreboard latency kind for fetches issued by this reader
-    _health_kind = "shard_read"
-
-    def _url(self, holder: str, off: int, n: int) -> str:
-        return (f"http://{holder}/admin/ec/shard_read?volume={self.vid}"
-                f"&shard={self.sid}&offset={off}&size={n}")
-
-    def _expect_len(self, n: int) -> int:
-        """Response bytes expected for an n-byte shard range."""
-        return n
-
-    def _read_one(self, holder: str, off: int, n: int) -> bytes:
-        from ..server.http_util import HttpError, http_call
-        # pool/hedge worker threads don't inherit the tracing
-        # contextvar — carry the rebuild span's traceparent explicitly
-        # so the holders' shard_read spans join the rebuild trace
-        hdrs = None
-        if self.span is not None:
-            hdrs = {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
-        expect = self._expect_len(n)
-        t0 = time.perf_counter()
-        try:
-            data = http_call(self._method, self._url(holder, off, n),
-                             headers=hdrs, timeout=self.timeout)
-            if len(data) != expect:
-                raise HttpError(
-                    502, f"short shard read {self.vid}.{self.sid} from "
-                         f"{holder} at {off}: {len(data)} < {expect}")
-        except Exception:
-            self.stats.add_holder_error(holder)
-            _health.BOARD.record_error(holder, self._health_kind)
-            raise
-        t1 = time.perf_counter()
-        self.stats.add_fetch(len(data), t0, t1, remote=True,
-                             holder=holder)
-        _health.BOARD.record_latency(holder, self._health_kind, t1 - t0)
-        return data
-
-    def _read_failover(self, order: Sequence[str], off: int,
-                       n: int) -> bytes:
-        last = None
-        for i, holder in enumerate(order):
-            if i:
-                self.stats.add_retry()
-            try:
-                return self._read_one(holder, off, n)
-            except Exception as e:  # noqa: BLE001 - try the next holder
-                last = e
-        raise last
-
-    def _attribute_hedge_loss(self, loser_future, loser: str,
-                              winner: str):
-        """The race is decided: whenever the losing duplicate finishes
-        draining (maybe much later), charge the loss to the losing
-        holder.  The loser's full latency is recorded by its own
-        _read_one when the drained duplicate completes — the timing
-        that used to be discarded — so the callback only needs to add
-        the hedge-loss attribution."""
-        self.stats.add_hedge_lost()
-
-        def _done(_f):
-            _health.BOARD.record_hedge_loss(loser, winner)
-
-        loser_future.add_done_callback(_done)
-
-    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
-        h = self.holders
-        # rotation both spreads load (consecutive stripes of a
-        # replicated shard split across its holders) and fixes the
-        # failover/hedge order for this stripe
-        order = [h[(stripe_idx + j) % len(h)] for j in range(len(h))]
-        if len(order) > 1 and _health.routing_enabled():
-            # demote unhealthy holders to the back of the failover /
-            # hedge order (stable within each class, so the rotation's
-            # load-spreading survives among healthy peers)
-            order = _health.BOARD.order_by_health(order)
-        if self.hedge_s <= 0 or len(order) < 2:
-            return self._read_failover(order, off, n)
-        ex = _hedge_pool()
-        primary = ex.submit(self._read_one, order[0], off, n)
-        try:
-            return primary.result(timeout=self.hedge_s)
-        except _FutureTimeout:
-            pass
-        except Exception:  # noqa: BLE001 - fast failure: plain failover
-            self.stats.add_retry()
-            return self._read_failover(order[1:], off, n)
-        # leading holder is past the hedge deadline: race a duplicate on
-        # the next holder; first success wins, the loser drains its
-        # response body in the pool thread and its socket goes back to
-        # the connection pool
-        self.stats.add_hedge_fired()
-        secondary = ex.submit(self._read_one, order[1], off, n)
-        pending = {primary, secondary}
-        last = None
-        while pending:
-            done, pending = wait(pending, return_when=FIRST_COMPLETED)
-            for f in done:
-                err = f.exception()
-                if err is None:
-                    if f is secondary:
-                        self.stats.add_hedge_won()
-                        self._attribute_hedge_loss(
-                            primary, order[0], order[1])
-                    else:
-                        self._attribute_hedge_loss(
-                            secondary, order[1], order[0])
-                    return f.result()
-                last = err
-        if len(order) > 2:
-            self.stats.add_retry()
-            return self._read_failover(order[2:], off, n)
-        raise last
+    return pull_window()
 
 
 def probe_shard_size(vid: int, sid: int, holders: Sequence[str],
@@ -437,14 +154,15 @@ class RemoteRepairReader(RemoteShardReader):
     """Projected reads for trace repair: asks the holder to apply this
     survivor's GF(2^8) trace masks server-side and ship only the packed
     symbol planes — ``len(masks) * ceil(n/8)`` bytes for an n-byte
-    range. Rotation, failover and hedging come from the base class."""
+    range. Rotation, failover and hedging come from the shared
+    transport reader."""
 
     _method = "POST"
     _health_kind = "repair_read"
 
     def __init__(self, vid: int, sid: int, holders: Sequence[str],
                  masks: Sequence[int],
-                 stats: Optional[GatherStats] = None,
+                 stats: Optional[TransportStats] = None,
                  timeout: float = 300.0,
                  hedge_ms: Optional[float] = None):
         super().__init__(vid, sid, holders, stats=stats, timeout=timeout,
@@ -471,7 +189,7 @@ class LocalRepairReader:
     remote = False
 
     def __init__(self, path: str, masks: Sequence[int],
-                 stats: Optional[GatherStats] = None):
+                 stats: Optional[TransportStats] = None):
         if not masks:
             raise ValueError(f"{path}: no repair masks")
         self.path = path
@@ -528,110 +246,28 @@ def fetch_index_files(base_name: str, holders: Sequence[str],
     return fetched
 
 
-class StripedGatherSource:
+class StripedGatherSource(StripedPull):
     """The survivor stream: ``slabs()`` yields ``(meta, (k, w) uint8)``
-    stripes in order, fetching up to ``window`` stripes ahead across a
-    shared thread pool. ``readers`` are the first-k survivors in decode
-    plan order — local files and remote holders mixed freely."""
-
-    def __init__(self, readers: Sequence, shard_size: int,
-                 slab: int = 8 << 20, window: Optional[int] = None,
-                 stats: Optional[GatherStats] = None,
-                 parent_span=None):
-        if not readers:
-            raise ValueError("no survivor readers")
-        self.readers = list(readers)
-        self.shard_size = int(shard_size)
-        self.slab = max(1, int(slab))
-        self.window = max(1, int(window) if window else gather_window())
-        self.stats = stats or GatherStats()
-        self.parent_span = parent_span
-        for r in self.readers:
-            r.stats = self.stats
-            r.span = parent_span
-        self.stats.remote_shards = sum(
-            1 for r in self.readers if getattr(r, "remote", False))
-        self.stats.local_shards = len(self.readers) - \
-            self.stats.remote_shards
-        self._buffered = 0
-        self._lock = make_lock("gather.GatherSource._lock")
-
-    def _note_buffered(self, delta: int):
-        with self._lock:
-            self._buffered += delta
-            if self._buffered > self.stats.peak_buffered:
-                self.stats.peak_buffered = self._buffered
-
-    # stream-shape hooks — RepairGatherSource reshapes both without
-    # touching the window/pool/ordering machinery
-    def _stripe_nbytes(self, w: int) -> int:
-        """Buffered bytes one in-flight stripe accounts for."""
-        return len(self.readers) * w
-
-    def _assemble(self, bufs: List[bytes], w: int) -> np.ndarray:
-        """Row buffers of one stripe -> the block the consumer wants."""
-        rows = [np.frombuffer(b, dtype=np.uint8) for b in bufs]
-        return np.stack(rows, axis=0)
-
-    def slabs(self):
-        k = len(self.readers)
-        stripes: List[Tuple[int, int]] = [
-            (off, min(self.slab, self.shard_size - off))
-            for off in range(0, self.shard_size, self.slab)]
-        self.stats.stripes = len(stripes)
-        if not stripes:
-            return
-        workers = min(16, max(2, min(self.window, len(stripes)) * k))
-        pool = ThreadPoolExecutor(max_workers=workers,
-                                  thread_name_prefix="ec-gather")
-        pending: deque = deque()
-
-        def submit(idx: int):
-            off, w = stripes[idx]
-            # account BEFORE the fetches start: in-flight rows are
-            # buffered memory too, and the bound must hold even when
-            # every submitted row completes before the consumer drains
-            self._note_buffered(self._stripe_nbytes(w))
-            t_sub = time.perf_counter()
-            futs = [pool.submit(self.readers[r].read, off, w, idx)
-                    for r in range(k)]
-            pending.append((idx, off, w, t_sub, futs))
-
-        try:
-            nxt = 0
-            while nxt < len(stripes) and len(pending) < self.window:
-                submit(nxt)
-                nxt += 1
-            while pending:
-                idx, off, w, t_sub, futs = pending.popleft()
-                data = self._assemble([f.result() for f in futs], w)
-                tracing.record_span(
-                    "gather.stripe", time.perf_counter() - t_sub,
-                    parent=self.parent_span, op="ec.rebuild.gather",
-                    stripe=idx, offset=off,
-                    bytes=self._stripe_nbytes(w))
-                self._note_buffered(-self._stripe_nbytes(w))
-                if nxt < len(stripes):
-                    submit(nxt)
-                    nxt += 1
-                yield (idx, off, w), data
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+    stripes in order, fetching up to ``window`` stripes ahead.
+    ``readers`` are the first-k survivors in decode plan order — local
+    files and remote holders mixed freely. Pure transport: the window,
+    pool, ordering, rotation, failover and hedging all come from
+    ``StripedPull``."""
 
 
-class RepairGatherSource(StripedGatherSource):
+class RepairGatherSource(StripedPull):
     """Trace-repair symbol stream: the readers are one projection
     reader per plan helper (``ops/codec.RepairPlan`` order), each
     returning its packed symbol planes for the stripe range. ``slabs()``
     yields ``(meta, (total_bits, ceil(w/8)) uint8)`` blocks — the
     concatenated planes in helper-then-mask order, ready for the fused
     combine matmul. The bounded window, round-robin rotation, failover
-    and hedging all come from the base source; only the stripe shape
-    and memory accounting differ."""
+    and hedging all come from the shared transport; only the stripe
+    shape and memory accounting differ."""
 
     def __init__(self, readers: Sequence, shard_size: int, plan,
                  slab: int = 8 << 20, window: Optional[int] = None,
-                 stats: Optional[GatherStats] = None,
+                 stats: Optional[TransportStats] = None,
                  parent_span=None):
         if len(readers) != len(plan.helpers):
             raise ValueError(

@@ -36,8 +36,8 @@ import numpy as np
 
 from ..util import config, glog
 from ..util import tracing
-from .gather import (GatherStats, LocalShardReader, RemoteShardReader,
-                     default_hedge_ms)
+from .transport import (GatherStats, LocalShardReader, RemoteShardReader,
+                        default_hedge_ms)
 
 RATE_ENV = "SW_EC_SCRUB_RATE_MBPS"
 IDLE_ENV = "SW_EC_SCRUB_IDLE_S"

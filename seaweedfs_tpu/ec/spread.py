@@ -1,343 +1,53 @@
-"""Streaming striped shard spread for EC encode.
+"""Streaming striped shard spread for EC encode — the *push* role of
+``ec/transport.py``.
 
 The copy-then-spread flow materializes all k+m shard files on the
 source disk and only then lets every target pull its shards whole over
 ``/admin/ec/copy`` — encode wall is encode + spread, the source pays a
 1.4x shard write pass plus the copy re-read, and nothing overlaps.
-This module mirrors ``ec/gather.py`` on the write path: a sink that
-takes the stripe stream coming out of the encode (each stripe is one
-slab-aligned ``[off, off+w)`` range of every shard) and pushes each
-shard's ranges straight to its assigned holder via the chunked
-``/admin/ec/shard_write`` endpoint while later slabs are still
-encoding. Shards bound for remote holders never touch the source disk.
+The streaming spread instead takes the stripe stream coming out of the
+encode (each stripe is one slab-aligned ``[off, off+w)`` range of every
+shard) and pushes each shard's ranges straight to its assigned holder
+via the chunked ``/admin/ec/shard_write`` endpoint while later slabs
+are still encoding. Shards bound for remote holders never touch the
+source disk.
 
-Shape of the stream: ``write_stripe(data, parity)`` receives the
-``(k, w)`` data rows and ``(m, w)`` parity rows of one stripe; row ``i``
-is exactly the next ``w`` bytes of shard ``i``'s file. One worker per
-distinct target drains a bounded send queue (``SW_EC_SPREAD_WINDOW``
-stripes in flight per target), so spread memory is
-O(window * (k+m) * slab), never O(volume), and each shard's ranges
-arrive at its holder strictly in offset order (append-at-expected-
-offset; the holder answers 409 on a mismatch).
-
-Failure discipline:
-  * every holder stages into ``<shard>.part`` and the sink finalizes
-    (atomic rename) only after the full shard arrived — a failed spread
-    leaves no partial shards anywhere.
-  * retry: a failed send is retried once on the same target (stale
-    keep-alive, transient 5xx); a 409 whose staged size already covers
-    the run is treated as a delivered-but-unacked duplicate.
-  * failover: a target that dies before acknowledging any byte has its
-    shards re-assigned to the next free node and the in-hand run is
-    replayed from offset 0. A target that dies mid-shard is not
-    replayable (the earlier stripes are gone — the source never kept
-    them), so the spread aborts and the shell falls back to copy mode.
+All of the transport — the bounded ``SW_EC_SPREAD_WINDOW`` per-target
+window with peak-buffer and blocked-time accounting, contiguous-run
+merging, retry/failover onto spares, first-run ``SW_EC_HEDGE_MS``
+hedging, the ``.part``-stage/atomic-finalize discipline — lives in
+``ec/transport.py``, shared byte-for-byte with the gather pull side.
+This module keeps only what is specific to pushing an encode: mapping
+a shard assignment onto transport writers.
 """
 
 from __future__ import annotations
 
-import os
-import queue
-import re
-import threading
-from ..util.locks import make_lock
-import time
 from typing import Dict, List, Optional, Sequence
 
-from ..util import config, tracing
-from ..util.profiling import StageTimer
+from .transport import (  # noqa: F401  - the shared transport, push role
+    DEFAULT_WINDOW, _SENTINEL, _STAGED_RE, LocalShardWriter,
+    RemoteShardWriter, SpreadError, SpreadStats, StripedPush,
+    TransportStats, merge_runs, push_window,
+)
+from .transport import TargetWorker as _TargetWorker  # noqa: F401
 
-DEFAULT_WINDOW = 4
 SPREAD_WINDOW_ENV = "SW_EC_SPREAD_WINDOW"
-
-_STAGED_RE = re.compile(r"staged=(\d+)")
-
-_SENTINEL = object()
 
 
 def spread_window() -> int:
-    return max(1, config.env_int(SPREAD_WINDOW_ENV))
+    return push_window()
 
 
-class SpreadError(Exception):
-    """A shard push failed beyond what retry/failover can absorb."""
-
-
-class SpreadStats:
-    """Counters + busy-time accounting shared by every writer of one
-    spread. Busy time is the UNION of send intervals (sends overlap
-    across targets), so ``bytes / busy_s`` is the effective placement
-    bandwidth, comparable to what a serialized copy phase would need."""
-
-    def __init__(self):
-        self.timer = StageTimer()
-        self._lock = make_lock("spread.SpreadStats._lock")
-        self.sends = 0
-        self.bytes = 0
-        self.retries = 0
-        self.failovers = 0
-        self.stripes = 0
-        self.peak_buffered = 0
-        self.remote_shards = 0
-        self.local_shards = 0
-
-    def add_send(self, nbytes: int, t0: float, t1: float):
-        self.timer.add("spread", t1 - t0, nbytes, interval=(t0, t1))
-        with self._lock:
-            self.sends += 1
-            self.bytes += nbytes
-
-    def add_retry(self):
-        with self._lock:
-            self.retries += 1
-
-    def add_failover(self):
-        with self._lock:
-            self.failovers += 1
-
-    def busy_s(self) -> float:
-        return self.timer.busy_time("spread")
-
-    def mbps(self) -> float:
-        busy = self.busy_s()
-        if busy <= 0:
-            return 0.0
-        return self.bytes / busy / 1e6
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return {
-                "spread_bytes": self.bytes,
-                "spread_sends": self.sends,
-                "spread_stripes": self.stripes,
-                "spread_retries": self.retries,
-                "spread_failovers": self.failovers,
-                "peak_spread_buffer": self.peak_buffered,
-            }
-
-
-class LocalShardWriter:
-    """Fast path for shards the source keeps: append to the local
-    ``.part`` stage file, atomic-rename on finalize — the same
-    no-partial-shards contract the remote protocol gives."""
-
-    remote = False
-
-    def __init__(self, path: str, stats: Optional[SpreadStats] = None):
-        self.path = path
-        self.part = path + ".part"
-        self.stats = stats or SpreadStats()
-        self.span = None
-        self._f = None
-
-    def send(self, url: Optional[str], off: int,
-             chunks: Sequence[bytes]) -> int:
-        t0 = time.perf_counter()
-        if self._f is None:
-            self._f = open(self.part, "wb" if off == 0 else "ab")
-        if self._f.tell() != off:
-            raise SpreadError(
-                f"local shard write offset mismatch for {self.path}: "
-                f"staged={self._f.tell()} offset={off}")
-        n = 0
-        for c in chunks:
-            self._f.write(c)
-            n += len(c)
-        self.stats.add_send(n, t0, time.perf_counter())
-        return n
-
-    def finalize(self, url: Optional[str], size: int):
-        if self._f is not None:
-            self._f.close()
-            self._f = None
-        staged = os.path.getsize(self.part) if os.path.exists(self.part) \
-            else -1
-        if staged != size:
-            raise SpreadError(
-                f"local shard {self.path}: staged {staged} != {size}")
-        os.replace(self.part, self.path)
-
-    def abort(self, url: Optional[str]):
-        if self._f is not None:
-            self._f.close()
-            self._f = None
-        for p in (self.part,):
-            try:
-                os.remove(p)
-            except OSError:
-                pass
-
-
-class RemoteShardWriter:
-    """Pushes one shard's slab ranges to its holder: each run of
-    contiguous chunks goes out as ONE chunked POST to
-    ``/admin/ec/shard_write`` (append-at-expected-offset, 409 on
-    mismatch), carrying the encode span's traceparent so the holder's
-    spans join the encode trace."""
-
-    remote = True
-
-    def __init__(self, vid: int, sid: int, collection: str = "",
-                 stats: Optional[SpreadStats] = None,
-                 timeout: float = 300.0):
-        self.vid = vid
-        self.sid = sid
-        self.collection = collection
-        self.stats = stats or SpreadStats()
-        self.span = None     # set by StripedSpreadSink: trace parent
-        self.timeout = timeout
-
-    def _url(self, holder: str, query: str) -> str:
-        return (f"http://{holder}/admin/ec/shard_write?volume={self.vid}"
-                f"&collection={self.collection}&shard={self.sid}&{query}")
-
-    def _headers(self) -> Optional[dict]:
-        # target worker threads don't inherit the tracing contextvar —
-        # carry the encode span's traceparent explicitly
-        if self.span is None:
-            return None
-        return {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
-
-    def send(self, url: str, off: int, chunks: Sequence[bytes]) -> int:
-        from ..server.http_util import HttpError, post_chunked
-        n = sum(len(c) for c in chunks)
-        t0 = time.perf_counter()
-        try:
-            post_chunked(self._url(url, f"offset={off}"), chunks,
-                         headers=self._headers(), timeout=self.timeout)
-        except HttpError as e:
-            if e.status == 409:
-                # the holder's staged size disagrees; if it already
-                # covers this run the previous delivery merely lost its
-                # ack — don't re-append, don't fail
-                m = _STAGED_RE.search(str(e))
-                if m and int(m.group(1)) == off + n:
-                    self.stats.add_send(n, t0, time.perf_counter())
-                    return n
-            raise
-        self.stats.add_send(n, t0, time.perf_counter())
-        return n
-
-    def finalize(self, url: str, size: int):
-        from ..server.http_util import http_call
-        http_call("POST",
-                  self._url(url, f"action=finalize&size={size}"),
-                  headers=self._headers(), timeout=self.timeout)
-
-    def abort(self, url: str):
-        from ..server.http_util import http_call
-        try:
-            http_call("POST", self._url(url, "action=abort"),
-                      headers=self._headers(), timeout=30.0)
-        except Exception:  # noqa: BLE001 - best-effort cleanup
-            pass
-
-
-class _TargetWorker(threading.Thread):
-    """Drains one target's bounded send queue: pops queued
-    ``(sid, off, chunk)`` items, merges per-shard contiguous runs, and
-    sends each run as one chunked POST. Owns the target url so
-    failover (re-assigning every shard of a dead target to a spare)
-    is a single-variable swap."""
-
-    def __init__(self, sink: "StripedSpreadSink", url: Optional[str],
-                 sids: List[int], window: int):
-        name = url or "local"
-        super().__init__(daemon=True, name=f"ec-spread-{name}")
-        self.sink = sink
-        self.url = url
-        self.sids = list(sids)
-        self.max_batch = max(1, window * len(sids))
-        self.q: queue.Queue = queue.Queue(maxsize=self.max_batch)
-        self.acked = 0
-        self.error: Optional[BaseException] = None
-
-    def run(self):
-        try:
-            stop = False
-            while not stop:
-                try:
-                    item = self.q.get(timeout=0.1)
-                except queue.Empty:
-                    if self.sink.failed is not None:
-                        return
-                    continue
-                batch = []
-                while True:
-                    if item is _SENTINEL:
-                        stop = True
-                        break
-                    batch.append(item)
-                    if len(batch) >= self.max_batch:
-                        break
-                    try:
-                        item = self.q.get_nowait()
-                    except queue.Empty:
-                        break
-                for sid, off, chunks in self._runs(batch):
-                    n = self._send_run(sid, off, chunks)
-                    self.sink._note_buffered(-n)
-        except BaseException as e:  # noqa: BLE001 - surfaced to consumer
-            self.error = e
-            self.sink._fail(e)
-
-    @staticmethod
-    def _runs(batch):
-        """Merge the batch into per-shard contiguous runs, preserving
-        per-shard order (queue order is stripe order, so each shard's
-        offsets arrive ascending and contiguous)."""
-        runs = []          # [sid, start_off, [chunks], next_off]
-        open_run: Dict[int, list] = {}
-        for sid, off, chunk in batch:
-            run = open_run.get(sid)
-            if run is not None and run[3] == off:
-                run[2].append(chunk)
-                run[3] += len(chunk)
-            else:
-                run = [sid, off, [chunk], off + len(chunk)]
-                runs.append(run)
-                open_run[sid] = run
-        return [(sid, off, chunks) for sid, off, chunks, _ in runs]
-
-    def _send_run(self, sid: int, off: int, chunks) -> int:
-        writer = self.sink.writers[sid]
-        n = sum(len(c) for c in chunks)
-        while True:
-            last = None
-            for attempt in range(2):
-                if attempt:
-                    self.sink.stats.add_retry()
-                try:
-                    writer.send(self.url, off, chunks)
-                    self.acked += n
-                    tracing.record_span(
-                        "spread.run", 0.0, parent=self.sink.parent_span,
-                        op="ec.encode.spread", shard=sid, offset=off,
-                        bytes=n, target=self.url or "local")
-                    return n
-                except BaseException as e:  # noqa: BLE001 - retry/failover
-                    last = e
-            if self.acked > 0 or off != 0 or self.url is None:
-                # bytes already landed on this target (or it's the local
-                # disk): the dead holder's prefix is unreplayable — the
-                # encode stream never kept it. Abort; the shell falls
-                # back to the copy flow.
-                raise last
-            spare = self.sink._take_spare(self.url)
-            if spare is None:
-                raise last
-            dead, self.url = self.url, spare
-            self.sink.stats.add_failover()
-            writer.abort(dead)
-
-
-class StripedSpreadSink:
+class StripedSpreadSink(StripedPush):
     """The placement stream: ``write_stripe`` routes each shard row of
     the arriving stripe to its holder's bounded send queue; per-target
     workers push the ranges while the encode produces the next stripes.
     ``assignment`` maps shard id -> holder url; shards mapped to
     ``local_url`` (or unmapped) take the local-writer fast path and are
-    staged next to ``base_name``."""
+    staged next to ``base_name``. Everything after writer construction
+    — windows, runs, failover, hedging, pacing, finalize/abort — is
+    ``StripedPush``."""
 
     def __init__(self, vid: int, base_name: str,
                  assignment: Dict[int, str], total: int,
@@ -345,134 +55,24 @@ class StripedSpreadSink:
                  local_url: str = "",
                  spares: Optional[Sequence[str]] = None,
                  window: Optional[int] = None,
-                 stats: Optional[SpreadStats] = None,
-                 parent_span=None):
+                 stats: Optional[TransportStats] = None,
+                 parent_span=None,
+                 rate_mbps: float = 0.0):
         from .constants import to_ext
         self.vid = vid
         self.base_name = base_name
-        self.total = int(total)
-        self.window = max(1, int(window) if window else spread_window())
-        self.stats = stats or SpreadStats()
-        self.parent_span = parent_span
-        self.offset = 0
-        self.failed: Optional[BaseException] = None
-        self._spares = [s for s in (spares or []) if s]
-        self._lock = make_lock("spread.SpreadSink._lock")
-        self._buffered = 0
-        self.writers: List = []
+        writers: List = []
         by_target: Dict[Optional[str], List[int]] = {}
-        for sid in range(self.total):
+        for sid in range(int(total)):
             url = assignment.get(sid) or ""
             if url == local_url:
                 url = ""
             if url:
-                w = RemoteShardWriter(vid, sid, collection, self.stats)
+                w = RemoteShardWriter(vid, sid, collection)
             else:
-                w = LocalShardWriter(base_name + to_ext(sid), self.stats)
-            w.span = parent_span
-            self.writers.append(w)
+                w = LocalShardWriter(base_name + to_ext(sid))
+            writers.append(w)
             by_target.setdefault(url or None, []).append(sid)
-        self.stats.remote_shards = sum(
-            1 for w in self.writers if w.remote)
-        self.stats.local_shards = self.total - self.stats.remote_shards
-        self.workers = [
-            _TargetWorker(self, url, sids, self.window)
-            for url, sids in by_target.items()]
-        self._worker_of = {}
-        for w in self.workers:
-            for sid in w.sids:
-                self._worker_of[sid] = w
-        self.blocked_s = 0.0     # consumer time lost to full windows
-        for w in self.workers:
-            w.start()
-
-    # -- shared bookkeeping -------------------------------------------------
-    def _note_buffered(self, delta: int):
-        with self._lock:
-            self._buffered += delta
-            if self._buffered > self.stats.peak_buffered:
-                self.stats.peak_buffered = self._buffered
-
-    def _fail(self, e: BaseException):
-        with self._lock:
-            if self.failed is None:
-                self.failed = e
-
-    def _take_spare(self, dead: Optional[str]) -> Optional[str]:
-        with self._lock:
-            for i, s in enumerate(self._spares):
-                if s != dead:
-                    return self._spares.pop(i)
-        return None
-
-    def assignment(self) -> Dict[int, str]:
-        """Final shard placement (post-failover): sid -> holder url,
-        '' for shards kept on the source."""
-        return {sid: (self._worker_of[sid].url or "")
-                for sid in range(self.total)}
-
-    def _put(self, worker: _TargetWorker, item):
-        t0 = time.perf_counter()
-        waited = False
-        while True:
-            if self.failed is not None:
-                raise SpreadError(
-                    f"shard spread failed: {self.failed!r}") \
-                    from self.failed
-            try:
-                worker.q.put(item, timeout=0.05)
-                break
-            except queue.Full:
-                waited = True
-        if waited:
-            self.blocked_s += time.perf_counter() - t0
-
-    # -- the stream ---------------------------------------------------------
-    def write_stripe(self, data, parity):
-        """Route one encoded stripe: row i of ``data``/``parity`` is the
-        next ``w`` bytes of shard i / shard k+i."""
-        k = data.shape[0]
-        w = data.shape[1]
-        off = self.offset
-        for sid in range(self.total):
-            row = data[sid] if sid < k else parity[sid - k]
-            chunk = row.tobytes()
-            self._note_buffered(len(chunk))
-            self._put(self._worker_of[sid], (sid, off, chunk))
-        self.offset = off + w
-        with self._lock:
-            self.stats.stripes += 1
-
-    def finish(self):
-        """Drain every window, join the workers, then finalize all
-        shards (atomic ``.part`` -> shard rename on every holder).
-        Raises if any push or finalize failed."""
-        t0 = time.perf_counter()
-        for w in self.workers:
-            self._put(w, _SENTINEL)
-        for w in self.workers:
-            w.join()
-        self.blocked_s += time.perf_counter() - t0
-        if self.failed is not None:
-            raise SpreadError(
-                f"shard spread failed: {self.failed!r}") from self.failed
-        for sid in range(self.total):
-            self.writers[sid].finalize(self._worker_of[sid].url,
-                                       self.offset)
-
-    def abort(self):
-        """Stop the workers and leave no partial shards: best-effort
-        ``.part`` cleanup on every holder and on the local disk."""
-        self._fail(SpreadError("spread aborted"))
-        for w in self.workers:
-            try:
-                w.q.put_nowait(_SENTINEL)
-            except queue.Full:
-                pass
-        for w in self.workers:
-            w.join(timeout=10.0)
-        for sid in range(self.total):
-            try:
-                self.writers[sid].abort(self._worker_of[sid].url)
-            except Exception:  # noqa: BLE001 - best-effort cleanup
-                pass
+        super().__init__(writers, by_target, spares=spares,
+                         window=window, stats=stats,
+                         parent_span=parent_span, rate_mbps=rate_mbps)

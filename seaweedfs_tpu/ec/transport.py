@@ -1,0 +1,974 @@
+"""One windowed stripe-transport layer for every EC data mover.
+
+``ec/gather.py`` (rebuild/repair pull) and ``ec/spread.py`` (encode
+push) each grew a private copy of the same transport: a bounded
+in-flight window with peak-buffer accounting, per-holder rotation +
+failover, ``SW_EC_HEDGE_MS`` hedging with loser-drain health
+attribution, contiguous-run merging and local fast paths. This module
+is that transport, once — a *pull* side (``StripedPull``: stripe
+readers fan out over a pool, stripes yield strictly in order) and a
+*push* side (``StripedPush``: per-target workers drain bounded send
+queues, merging contiguous runs). Gather, spread, scrub and the tier
+demotion pipeline are thin clients; hedging and health routing are
+therefore available on the write path too, not just the read path.
+
+Shape of the stream on both sides: a *stripe* is one slab-aligned byte
+range ``[off, off+w)`` of every shard. The pull side materializes it as
+a ``(k, w)`` uint8 block for the decode; the push side receives it as
+``(k, w)`` data + ``(m, w)`` parity rows from the encode. In-flight
+memory is O(window * shards * slab) on either side, never O(volume).
+
+Straggler defenses (shared):
+  * rotation: stripe ``s`` leads with holder ``s % len(holders)`` so
+    consecutive stripes split across replicas instead of hammering one.
+  * failover: a failed pull retries the remaining holders in rotation
+    order; a push target that dies before acking any byte hands its
+    shard set to a spare and replays from offset 0.
+  * hedging (``SW_EC_HEDGE_MS``, default off): a pull past the deadline
+    races a duplicate on the next holder; a first push run past the
+    deadline races a duplicate stage on a spare target. The loser is
+    never cancelled — its response drains in the hedge pool so the
+    socket parks back in the keep-alive pool — and the loss is charged
+    to the slow holder on the health scoreboard.
+  * health routing (``SW_EC_HEALTH_ROUTING``): unhealthy holders sort
+    to the back of the pull failover order; the healthiest spare is
+    picked first on push failover.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import re
+import threading
+import time
+from collections import deque
+from concurrent.futures import (FIRST_COMPLETED, ThreadPoolExecutor,
+                                TimeoutError as _FutureTimeout, wait)
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..stats import health as _health
+from ..util import config, tracing
+from ..util.locks import make_lock
+from ..util.profiling import StageTimer
+
+DEFAULT_WINDOW = 4
+PULL_WINDOW_ENV = "SW_EC_GATHER_WINDOW"
+PUSH_WINDOW_ENV = "SW_EC_SPREAD_WINDOW"
+HEDGE_MS_ENV = "SW_EC_HEDGE_MS"
+
+_STAGED_RE = re.compile(r"staged=(\d+)")
+
+_SENTINEL = object()
+
+
+def pull_window() -> int:
+    return max(1, config.env_int(PULL_WINDOW_ENV))
+
+
+def push_window() -> int:
+    return max(1, config.env_int(PUSH_WINDOW_ENV))
+
+
+def default_hedge_ms() -> float:
+    return config.env_float(HEDGE_MS_ENV)
+
+
+# hedged duplicates run here rather than in the mover's own pool: a
+# stripe worker submitting back into its (possibly saturated) pool
+# could deadlock the window
+_HEDGE_POOL: Optional[ThreadPoolExecutor] = None
+_HEDGE_LOCK = make_lock("transport._HEDGE_LOCK")
+
+
+def hedge_pool() -> ThreadPoolExecutor:
+    global _HEDGE_POOL
+    with _HEDGE_LOCK:
+        if _HEDGE_POOL is None:
+            _HEDGE_POOL = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="ec-transport-hedge")
+        return _HEDGE_POOL
+
+
+class SpreadError(Exception):
+    """A transport operation failed beyond what retry/failover can
+    absorb. (Historic name — the push side raised it first; the shared
+    layer kept it so existing handlers don't churn.)"""
+
+
+class TransportStats:
+    """Counters + busy-time accounting shared by every endpoint of one
+    transport run. Busy time is the UNION of transfer intervals
+    (transfers overlap across stripes/rows/targets), so
+    ``bytes / busy_s`` is the effective bandwidth, comparable to what a
+    serialized copy phase would need. ``stage`` names the role
+    ("gather"/"spread"/...) and prefixes the snapshot keys, so one
+    class serves both metric families plus the merged ``ec_transport_*``
+    export."""
+
+    stage = "transport"
+
+    def __init__(self):
+        self.timer = StageTimer()
+        self._lock = make_lock("transport.TransportStats._lock")
+        self.fetches = 0
+        self.sends = 0
+        self.bytes = 0
+        self.remote_bytes = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.hedges_lost = 0
+        self.retries = 0
+        self.failovers = 0
+        self.stripes = 0
+        self.peak_buffered = 0
+        self.remote_shards = 0
+        self.local_shards = 0
+        # per-holder accounting feeds the health scoreboard drill:
+        # "routing on issues strictly fewer reads to the slow holder"
+        # is only assertable if someone counts transfers per holder
+        self.holder_fetches: Dict[str, int] = {}
+        self.holder_errors: Dict[str, int] = {}
+
+    def add_fetch(self, nbytes: int, t0: float, t1: float,
+                  remote: bool = False, holder: Optional[str] = None):
+        self.timer.add(self.stage, t1 - t0, nbytes, interval=(t0, t1))
+        with self._lock:
+            self.fetches += 1
+            self.bytes += nbytes
+            if remote:
+                self.remote_bytes += nbytes
+            if holder:
+                self.holder_fetches[holder] = \
+                    self.holder_fetches.get(holder, 0) + 1
+
+    def add_send(self, nbytes: int, t0: float, t1: float,
+                 holder: Optional[str] = None):
+        self.timer.add(self.stage, t1 - t0, nbytes, interval=(t0, t1))
+        with self._lock:
+            self.sends += 1
+            self.bytes += nbytes
+            if holder:
+                self.holder_fetches[holder] = \
+                    self.holder_fetches.get(holder, 0) + 1
+
+    def add_holder_error(self, holder: str):
+        with self._lock:
+            self.holder_errors[holder] = \
+                self.holder_errors.get(holder, 0) + 1
+
+    def add_hedge_fired(self):
+        with self._lock:
+            self.hedges_fired += 1
+
+    def add_hedge_won(self):
+        with self._lock:
+            self.hedges_won += 1
+
+    def add_hedge_lost(self):
+        with self._lock:
+            self.hedges_lost += 1
+
+    def add_retry(self):
+        with self._lock:
+            self.retries += 1
+
+    def add_failover(self):
+        with self._lock:
+            self.failovers += 1
+
+    def busy_s(self) -> float:
+        return self.timer.busy_time(self.stage)
+
+    def mbps(self) -> float:
+        busy = self.busy_s()
+        if busy <= 0:
+            return 0.0
+        return self.bytes / busy / 1e6
+
+    def snapshot(self) -> Dict[str, float]:
+        s = self.stage
+        with self._lock:
+            return {
+                f"{s}_bytes": self.bytes,
+                f"{s}_remote_bytes": self.remote_bytes,
+                f"{s}_fetches": self.fetches,
+                f"{s}_sends": self.sends,
+                f"{s}_stripes": self.stripes,
+                f"{s}_retries": self.retries,
+                f"{s}_failovers": self.failovers,
+                f"peak_{s}_buffer": self.peak_buffered,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "hedges_lost": self.hedges_lost,
+                "holder_fetches": dict(self.holder_fetches),
+                "holder_errors": dict(self.holder_errors),
+            }
+
+
+class GatherStats(TransportStats):
+    """Pull-side role of the shared stats: snapshot keys are
+    ``gather_*`` (what ``observe_gather`` and the rebuild/repair stats
+    dicts have always carried)."""
+
+    stage = "gather"
+
+
+class SpreadStats(TransportStats):
+    """Push-side role of the shared stats: snapshot keys are
+    ``spread_*`` (what ``observe_spread`` and the encode stats dicts
+    have always carried)."""
+
+    stage = "spread"
+
+
+# ---------------------------------------------------------------------------
+# pull side: stripe readers
+
+
+class LocalShardReader:
+    """Range reads of a shard already on this node's disk. Opens per
+    call — the pull pool reads several stripes of one shard
+    concurrently, and a shared seek pointer would race."""
+
+    remote = False
+
+    def __init__(self, path: str, stats: Optional[TransportStats] = None):
+        self.path = path
+        self.stats = stats or GatherStats()
+
+    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
+        t0 = time.perf_counter()
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            data = f.read(n)
+        if len(data) != n:
+            raise IOError(f"short read of {self.path} at {off}: "
+                          f"{len(data)} < {n}")
+        self.stats.add_fetch(n, t0, time.perf_counter())
+        return data
+
+
+class RemoteShardReader:
+    """Ranged reads of one shard from its holder set, with round-robin
+    striping, failover retries and optional hedging."""
+
+    remote = True
+
+    def __init__(self, vid: int, sid: int, holders: Sequence[str],
+                 stats: Optional[TransportStats] = None,
+                 timeout: float = 300.0,
+                 hedge_ms: Optional[float] = None):
+        if not holders:
+            raise ValueError(f"shard {vid}.{sid}: no holders")
+        self.vid = vid
+        self.sid = sid
+        self.holders = list(holders)
+        self.stats = stats or GatherStats()
+        self.span = None     # set by StripedPull: trace parent
+        self.timeout = timeout
+        self.hedge_s = (default_hedge_ms() if hedge_ms is None
+                        else float(hedge_ms)) / 1000.0
+
+    # transport hooks — RemoteRepairReader overrides to hit the
+    # projected-read route with a different method/response size while
+    # inheriting rotation, failover and hedging unchanged
+    _method = "GET"
+    # health-scoreboard latency kind for fetches issued by this reader
+    _health_kind = "shard_read"
+
+    def _url(self, holder: str, off: int, n: int) -> str:
+        return (f"http://{holder}/admin/ec/shard_read?volume={self.vid}"
+                f"&shard={self.sid}&offset={off}&size={n}")
+
+    def _expect_len(self, n: int) -> int:
+        """Response bytes expected for an n-byte shard range."""
+        return n
+
+    def _read_one(self, holder: str, off: int, n: int) -> bytes:
+        from ..server.http_util import HttpError, http_call
+        # pool/hedge worker threads don't inherit the tracing
+        # contextvar — carry the caller span's traceparent explicitly
+        # so the holders' shard_read spans join the caller's trace
+        hdrs = None
+        if self.span is not None:
+            hdrs = {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
+        expect = self._expect_len(n)
+        t0 = time.perf_counter()
+        try:
+            data = http_call(self._method, self._url(holder, off, n),
+                             headers=hdrs, timeout=self.timeout)
+            if len(data) != expect:
+                raise HttpError(
+                    502, f"short shard read {self.vid}.{self.sid} from "
+                         f"{holder} at {off}: {len(data)} < {expect}")
+        except Exception:
+            self.stats.add_holder_error(holder)
+            _health.BOARD.record_error(holder, self._health_kind)
+            raise
+        t1 = time.perf_counter()
+        self.stats.add_fetch(len(data), t0, t1, remote=True,
+                             holder=holder)
+        _health.BOARD.record_latency(holder, self._health_kind, t1 - t0)
+        return data
+
+    def _read_failover(self, order: Sequence[str], off: int,
+                       n: int) -> bytes:
+        last = None
+        for i, holder in enumerate(order):
+            if i:
+                self.stats.add_retry()
+            try:
+                return self._read_one(holder, off, n)
+            except Exception as e:  # noqa: BLE001 - try the next holder
+                last = e
+        raise last
+
+    def _attribute_hedge_loss(self, loser_future, loser: str,
+                              winner: str):
+        """The race is decided: whenever the losing duplicate finishes
+        draining (maybe much later), charge the loss to the losing
+        holder.  The loser's full latency is recorded by its own
+        _read_one when the drained duplicate completes — the timing
+        that used to be discarded — so the callback only needs to add
+        the hedge-loss attribution."""
+        self.stats.add_hedge_lost()
+
+        def _done(_f):
+            _health.BOARD.record_hedge_loss(loser, winner)
+
+        loser_future.add_done_callback(_done)
+
+    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
+        h = self.holders
+        # rotation both spreads load (consecutive stripes of a
+        # replicated shard split across its holders) and fixes the
+        # failover/hedge order for this stripe
+        order = [h[(stripe_idx + j) % len(h)] for j in range(len(h))]
+        if len(order) > 1 and _health.routing_enabled():
+            # demote unhealthy holders to the back of the failover /
+            # hedge order (stable within each class, so the rotation's
+            # load-spreading survives among healthy peers)
+            order = _health.BOARD.order_by_health(order)
+        if self.hedge_s <= 0 or len(order) < 2:
+            return self._read_failover(order, off, n)
+        ex = hedge_pool()
+        primary = ex.submit(self._read_one, order[0], off, n)
+        try:
+            return primary.result(timeout=self.hedge_s)
+        except _FutureTimeout:
+            pass
+        except Exception:  # noqa: BLE001 - fast failure: plain failover
+            self.stats.add_retry()
+            return self._read_failover(order[1:], off, n)
+        # leading holder is past the hedge deadline: race a duplicate on
+        # the next holder; first success wins, the loser drains its
+        # response body in the pool thread and its socket goes back to
+        # the connection pool
+        self.stats.add_hedge_fired()
+        secondary = ex.submit(self._read_one, order[1], off, n)
+        pending = {primary, secondary}
+        last = None
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                err = f.exception()
+                if err is None:
+                    if f is secondary:
+                        self.stats.add_hedge_won()
+                        self._attribute_hedge_loss(
+                            primary, order[0], order[1])
+                    else:
+                        self._attribute_hedge_loss(
+                            secondary, order[1], order[0])
+                    return f.result()
+                last = err
+        if len(order) > 2:
+            self.stats.add_retry()
+            return self._read_failover(order[2:], off, n)
+        raise last
+
+
+class StripedPull:
+    """The pull pump: ``slabs()`` yields ``(meta, block)`` stripes in
+    strict order, fetching up to ``window`` stripes ahead across a
+    shared thread pool. ``readers`` are per-row endpoints (local files
+    and remote holders mixed freely). Subclasses reshape the stream via
+    the ``_stripe_nbytes``/``_assemble`` hooks without touching the
+    window/pool/ordering machinery."""
+
+    span_name = "gather.stripe"
+    span_op = "ec.rebuild.gather"
+
+    def __init__(self, readers: Sequence, shard_size: int,
+                 slab: int = 8 << 20, window: Optional[int] = None,
+                 stats: Optional[TransportStats] = None,
+                 parent_span=None):
+        if not readers:
+            raise ValueError("no survivor readers")
+        self.readers = list(readers)
+        self.shard_size = int(shard_size)
+        self.slab = max(1, int(slab))
+        self.window = max(1, int(window) if window else pull_window())
+        self.stats = stats if stats is not None else GatherStats()
+        self.parent_span = parent_span
+        for r in self.readers:
+            r.stats = self.stats
+            r.span = parent_span
+        self.stats.remote_shards = sum(
+            1 for r in self.readers if getattr(r, "remote", False))
+        self.stats.local_shards = len(self.readers) - \
+            self.stats.remote_shards
+        self._buffered = 0
+        self._lock = make_lock("transport.StripedPull._lock")
+
+    def _note_buffered(self, delta: int):
+        with self._lock:
+            self._buffered += delta
+            if self._buffered > self.stats.peak_buffered:
+                self.stats.peak_buffered = self._buffered
+
+    # stream-shape hooks
+    def _stripe_nbytes(self, w: int) -> int:
+        """Buffered bytes one in-flight stripe accounts for."""
+        return len(self.readers) * w
+
+    def _assemble(self, bufs: List[bytes], w: int) -> np.ndarray:
+        """Row buffers of one stripe -> the block the consumer wants."""
+        rows = [np.frombuffer(b, dtype=np.uint8) for b in bufs]
+        return np.stack(rows, axis=0)
+
+    def slabs(self):
+        k = len(self.readers)
+        stripes: List[Tuple[int, int]] = [
+            (off, min(self.slab, self.shard_size - off))
+            for off in range(0, self.shard_size, self.slab)]
+        self.stats.stripes = len(stripes)
+        if not stripes:
+            return
+        workers = min(16, max(2, min(self.window, len(stripes)) * k))
+        pool = ThreadPoolExecutor(max_workers=workers,
+                                  thread_name_prefix="ec-pull")
+        pending: deque = deque()
+
+        def submit(idx: int):
+            off, w = stripes[idx]
+            # account BEFORE the fetches start: in-flight rows are
+            # buffered memory too, and the bound must hold even when
+            # every submitted row completes before the consumer drains
+            self._note_buffered(self._stripe_nbytes(w))
+            t_sub = time.perf_counter()
+            futs = [pool.submit(self.readers[r].read, off, w, idx)
+                    for r in range(k)]
+            pending.append((idx, off, w, t_sub, futs))
+
+        try:
+            nxt = 0
+            while nxt < len(stripes) and len(pending) < self.window:
+                submit(nxt)
+                nxt += 1
+            while pending:
+                idx, off, w, t_sub, futs = pending.popleft()
+                data = self._assemble([f.result() for f in futs], w)
+                tracing.record_span(
+                    self.span_name, time.perf_counter() - t_sub,
+                    parent=self.parent_span, op=self.span_op,
+                    stripe=idx, offset=off,
+                    bytes=self._stripe_nbytes(w))
+                self._note_buffered(-self._stripe_nbytes(w))
+                if nxt < len(stripes):
+                    submit(nxt)
+                    nxt += 1
+                yield (idx, off, w), data
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+
+# ---------------------------------------------------------------------------
+# push side: stripe writers
+
+
+class LocalShardWriter:
+    """Fast path for shards this node keeps: append to the local
+    ``.part`` stage file, atomic-rename on finalize — the same
+    no-partial-shards contract the remote protocol gives."""
+
+    remote = False
+
+    def __init__(self, path: str,
+                 stats: Optional[TransportStats] = None):
+        self.path = path
+        self.part = path + ".part"
+        self.stats = stats or SpreadStats()
+        self.span = None
+        self._f = None
+
+    def send(self, url: Optional[str], off: int,
+             chunks: Sequence[bytes]) -> int:
+        t0 = time.perf_counter()
+        if self._f is None:
+            self._f = open(self.part, "wb" if off == 0 else "ab")
+        if self._f.tell() != off:
+            raise SpreadError(
+                f"local shard write offset mismatch for {self.path}: "
+                f"staged={self._f.tell()} offset={off}")
+        n = 0
+        for c in chunks:
+            self._f.write(c)
+            n += len(c)
+        self.stats.add_send(n, t0, time.perf_counter())
+        return n
+
+    def finalize(self, url: Optional[str], size: int):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        staged = os.path.getsize(self.part) if os.path.exists(self.part) \
+            else -1
+        if staged != size:
+            raise SpreadError(
+                f"local shard {self.path}: staged {staged} != {size}")
+        os.replace(self.part, self.path)
+
+    def abort(self, url: Optional[str]):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        for p in (self.part,):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+class RemoteShardWriter:
+    """Pushes one shard's slab ranges to its holder: each run of
+    contiguous chunks goes out as ONE chunked POST to
+    ``/admin/ec/shard_write`` (append-at-expected-offset, 409 on
+    mismatch), carrying the caller span's traceparent so the holder's
+    spans join the trace. Every send feeds the health scoreboard under
+    the ``shard_write`` kind — the push path sees slow holders with the
+    same eyes the pull path does."""
+
+    remote = True
+    _health_kind = "shard_write"
+
+    def __init__(self, vid: int, sid: int, collection: str = "",
+                 stats: Optional[TransportStats] = None,
+                 timeout: float = 300.0):
+        self.vid = vid
+        self.sid = sid
+        self.collection = collection
+        self.stats = stats or SpreadStats()
+        self.span = None     # set by StripedPush: trace parent
+        self.timeout = timeout
+
+    def _url(self, holder: str, query: str) -> str:
+        return (f"http://{holder}/admin/ec/shard_write?volume={self.vid}"
+                f"&collection={self.collection}&shard={self.sid}&{query}")
+
+    def _headers(self) -> Optional[dict]:
+        # target worker threads don't inherit the tracing contextvar —
+        # carry the caller span's traceparent explicitly
+        if self.span is None:
+            return None
+        return {tracing.TRACEPARENT_HEADER: self.span.traceparent()}
+
+    def send(self, url: str, off: int, chunks: Sequence[bytes]) -> int:
+        from ..server.http_util import HttpError, post_chunked
+        n = sum(len(c) for c in chunks)
+        t0 = time.perf_counter()
+        try:
+            post_chunked(self._url(url, f"offset={off}"), chunks,
+                         headers=self._headers(), timeout=self.timeout)
+        except HttpError as e:
+            if e.status == 409:
+                # the holder's staged size disagrees; if it already
+                # covers this run the previous delivery merely lost its
+                # ack — don't re-append, don't fail
+                m = _STAGED_RE.search(str(e))
+                if m and int(m.group(1)) == off + n:
+                    self.stats.add_send(n, t0, time.perf_counter(),
+                                        holder=url)
+                    return n
+            self.stats.add_holder_error(url)
+            _health.BOARD.record_error(url, self._health_kind)
+            raise
+        except Exception:
+            self.stats.add_holder_error(url)
+            _health.BOARD.record_error(url, self._health_kind)
+            raise
+        t1 = time.perf_counter()
+        self.stats.add_send(n, t0, t1, holder=url)
+        _health.BOARD.record_latency(url, self._health_kind, t1 - t0)
+        return n
+
+    def finalize(self, url: str, size: int):
+        from ..server.http_util import http_call
+        http_call("POST",
+                  self._url(url, f"action=finalize&size={size}"),
+                  headers=self._headers(), timeout=self.timeout)
+
+    def abort(self, url: str):
+        from ..server.http_util import http_call
+        try:
+            http_call("POST", self._url(url, "action=abort"),
+                      headers=self._headers(), timeout=30.0)
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+
+
+class TargetWorker(threading.Thread):
+    """Drains one target's bounded send queue: pops queued
+    ``(sid, off, chunk)`` items, merges per-shard contiguous runs, and
+    sends each run as one chunked POST. Owns the target url so
+    failover (re-assigning every shard of a dead target to a spare)
+    is a single-variable swap. The FIRST run to a remote target may be
+    hedged: past the ``SW_EC_HEDGE_MS`` deadline the same run races a
+    duplicate stage on a spare, the first ack wins the shard set, and
+    the loser's stage is aborted once its send drains."""
+
+    def __init__(self, sink: "StripedPush", url: Optional[str],
+                 sids: List[int], window: int):
+        name = url or "local"
+        super().__init__(daemon=True, name=f"ec-push-{name}")
+        self.sink = sink
+        self.url = url
+        self.sids = list(sids)
+        self.max_batch = max(1, window * len(sids))
+        self.q: queue.Queue = queue.Queue(maxsize=self.max_batch)
+        self.acked = 0
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        try:
+            stop = False
+            while not stop:
+                try:
+                    item = self.q.get(timeout=0.1)
+                except queue.Empty:
+                    if self.sink.failed is not None:
+                        return
+                    continue
+                batch = []
+                while True:
+                    if item is _SENTINEL:
+                        stop = True
+                        break
+                    batch.append(item)
+                    if len(batch) >= self.max_batch:
+                        break
+                    try:
+                        item = self.q.get_nowait()
+                    except queue.Empty:
+                        break
+                for sid, off, chunks in merge_runs(batch):
+                    n = self._send_run(sid, off, chunks)
+                    self.sink._note_buffered(-n)
+        except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+            self.error = e
+            self.sink._fail(e)
+
+    def _send_run(self, sid: int, off: int, chunks) -> int:
+        writer = self.sink.writers[sid]
+        n = sum(len(c) for c in chunks)
+        if (self.sink.hedge_s > 0 and self.url is not None
+                and self.acked == 0 and off == 0):
+            if self._send_run_hedged(writer, off, chunks, n):
+                self._trace_run(sid, off, n)
+                return n
+        while True:
+            last = None
+            for attempt in range(2):
+                if attempt:
+                    self.sink.stats.add_retry()
+                try:
+                    writer.send(self.url, off, chunks)
+                    self.acked += n
+                    self._trace_run(sid, off, n)
+                    return n
+                except BaseException as e:  # noqa: BLE001 - retry/failover
+                    last = e
+            if self.acked > 0 or off != 0 or self.url is None:
+                # bytes already landed on this target (or it's the local
+                # disk): the dead holder's prefix is unreplayable — the
+                # stripe stream never kept it. Abort; the caller falls
+                # back to the copy flow.
+                raise last
+            spare = self.sink._take_spare(self.url)
+            if spare is None:
+                raise last
+            dead, self.url = self.url, spare
+            self.sink.stats.add_failover()
+            writer.abort(dead)
+
+    def _send_run_hedged(self, writer, off: int, chunks,
+                         n: int) -> bool:
+        """Hedge the first run of this target: if the leading holder
+        has not acked within the deadline, race the same run against a
+        spare's stage. Returns True when the run landed (possibly after
+        swapping ``self.url`` to the winning spare); False hands the
+        run to the plain retry/failover path — a duplicate re-send is
+        safe because the holder's 409 ``staged=`` reply identifies a
+        delivered-but-unacked run."""
+        ex = hedge_pool()
+        primary = ex.submit(writer.send, self.url, off, chunks)
+        try:
+            primary.result(timeout=self.sink.hedge_s)
+            self.acked += n
+            return True
+        except _FutureTimeout:
+            pass
+        except Exception:  # noqa: BLE001 - fast failure: plain failover
+            return False
+        spare = self.sink._take_spare(self.url)
+        if spare is None:
+            # no rival to race: wait the slow send out
+            try:
+                primary.result()
+            except Exception:  # noqa: BLE001 - plain path owns retries
+                return False
+            self.acked += n
+            return True
+        self.sink.stats.add_hedge_fired()
+        secondary = ex.submit(writer.send, spare, off, chunks)
+        pending = {primary, secondary}
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                if f.exception() is not None:
+                    continue
+                self.sink.stats.add_hedge_lost()
+                if f is secondary:
+                    # the spare won: it owns this worker's shard set
+                    # from here on; the slow holder's stage is aborted
+                    # once its duplicate drains (the send is idempotent
+                    # there — nothing else references the stage)
+                    slow, self.url = self.url, spare
+                    self.sink.stats.add_hedge_won()
+                    self.sink.stats.add_failover()
+                    _health.BOARD.record_hedge_loss(slow, spare)
+                    primary.add_done_callback(
+                        lambda _f, dead=slow: writer.abort(dead))
+                else:
+                    _health.BOARD.record_hedge_loss(spare, self.url)
+
+                    def _cleanup(_f, spare=spare):
+                        writer.abort(spare)
+                        self.sink._return_spare(spare)
+
+                    secondary.add_done_callback(_cleanup)
+                self.acked += n
+                return True
+        # both failed: the plain path retries and fails over; give the
+        # consumed spare back first so failover can still reach it
+        self.sink._return_spare(spare)
+        return False
+
+    def _trace_run(self, sid: int, off: int, n: int):
+        tracing.record_span(
+            self.sink.span_name, 0.0, parent=self.sink.parent_span,
+            op=self.sink.span_op, shard=sid, offset=off,
+            bytes=n, target=self.url or "local")
+
+
+def merge_runs(batch):
+    """Merge a drained batch into per-shard contiguous runs, preserving
+    per-shard order (queue order is stripe order, so each shard's
+    offsets arrive ascending and contiguous)."""
+    runs = []          # [sid, start_off, [chunks], next_off]
+    open_run: Dict[int, list] = {}
+    for sid, off, chunk in batch:
+        run = open_run.get(sid)
+        if run is not None and run[3] == off:
+            run[2].append(chunk)
+            run[3] += len(chunk)
+        else:
+            run = [sid, off, [chunk], off + len(chunk)]
+            runs.append(run)
+            open_run[sid] = run
+    return [(sid, off, chunks) for sid, off, chunks, _ in runs]
+
+
+class StripedPush:
+    """The push pump: ``write_stripe`` routes each shard row of the
+    arriving stripe to its holder's bounded send queue; per-target
+    workers push the ranges while the producer makes the next stripes.
+    Subclasses build the ``writers`` list (one endpoint per shard) and
+    the ``by_target`` grouping; everything else — window accounting,
+    blocked-time, failover spares, hedging, finalize/abort discipline,
+    optional MB/s pacing — lives here."""
+
+    span_name = "spread.run"
+    span_op = "ec.encode.spread"
+
+    def __init__(self, writers: List, by_target: Dict[Optional[str],
+                                                      List[int]],
+                 spares: Optional[Sequence[str]] = None,
+                 window: Optional[int] = None,
+                 stats: Optional[TransportStats] = None,
+                 parent_span=None, hedge_ms: Optional[float] = None,
+                 rate_mbps: float = 0.0):
+        self.total = len(writers)
+        self.window = max(1, int(window) if window else push_window())
+        self.stats = stats if stats is not None else SpreadStats()
+        self.parent_span = parent_span
+        self.hedge_s = (default_hedge_ms() if hedge_ms is None
+                        else float(hedge_ms)) / 1000.0
+        # producer-side MB/s ceiling (tier demotions under live
+        # traffic): same discipline as the scrub's pacing — sleep the
+        # producer so cumulative pushed bytes stay under the cap
+        self.rate_mbps = float(rate_mbps or 0.0)
+        self._rate_t0 = None
+        self._rate_bytes = 0
+        self.offset = 0
+        self.failed: Optional[BaseException] = None
+        self._spares = [s for s in (spares or []) if s]
+        self._lock = make_lock("transport.StripedPush._lock")
+        self._buffered = 0
+        self.writers = list(writers)
+        for w in self.writers:
+            w.stats = self.stats
+            w.span = parent_span
+        self.stats.remote_shards = sum(
+            1 for w in self.writers if w.remote)
+        self.stats.local_shards = self.total - self.stats.remote_shards
+        self.workers = [
+            TargetWorker(self, url, sids, self.window)
+            for url, sids in by_target.items()]
+        self._worker_of = {}
+        for w in self.workers:
+            for sid in w.sids:
+                self._worker_of[sid] = w
+        self.blocked_s = 0.0     # producer time lost to full windows
+        for w in self.workers:
+            w.start()
+
+    # -- shared bookkeeping -------------------------------------------------
+    def _note_buffered(self, delta: int):
+        with self._lock:
+            self._buffered += delta
+            if self._buffered > self.stats.peak_buffered:
+                self.stats.peak_buffered = self._buffered
+
+    def _fail(self, e: BaseException):
+        with self._lock:
+            if self.failed is None:
+                self.failed = e
+
+    def _take_spare(self, dead: Optional[str]) -> Optional[str]:
+        with self._lock:
+            cands = self._spares
+            if len(cands) > 1 and _health.routing_enabled():
+                # healthiest spare first — a failover onto the next
+                # struggling holder just moves the stall
+                cands = _health.BOARD.order_by_health(list(cands))
+            for s in cands:
+                if s != dead:
+                    self._spares.remove(s)
+                    return s
+        return None
+
+    def _return_spare(self, url: str):
+        with self._lock:
+            if url and url not in self._spares:
+                self._spares.append(url)
+
+    def assignment(self) -> Dict[int, str]:
+        """Final shard placement (post-failover): sid -> holder url,
+        '' for shards kept locally."""
+        return {sid: (self._worker_of[sid].url or "")
+                for sid in range(self.total)}
+
+    def _put(self, worker: TargetWorker, item):
+        t0 = time.perf_counter()
+        waited = False
+        while True:
+            if self.failed is not None:
+                raise SpreadError(
+                    f"shard spread failed: {self.failed!r}") \
+                    from self.failed
+            try:
+                worker.q.put(item, timeout=0.05)
+                break
+            except queue.Full:
+                waited = True
+        if waited:
+            self.blocked_s += time.perf_counter() - t0
+
+    def _pace(self, nbytes: int):
+        """Hold the producer under ``rate_mbps``: sleep until the
+        cumulative pushed bytes fit the elapsed-time budget. Pacing the
+        producer (not the workers) keeps the whole pipeline — encode
+        compute included — at the cap, which is the point of running a
+        demotion under live traffic."""
+        if self.rate_mbps <= 0:
+            return
+        now = time.perf_counter()
+        if self._rate_t0 is None:
+            self._rate_t0 = now
+        self._rate_bytes += nbytes
+        need = self._rate_bytes / (self.rate_mbps * 1e6)
+        # sleep until the cumulative budget is caught up — in slices,
+        # so a coarse stripe (few big slabs) still honors the cap
+        # instead of charging at most one bounded sleep per stripe
+        while True:
+            spent = time.perf_counter() - self._rate_t0
+            if need <= spent:
+                break
+            time.sleep(min(need - spent, 0.25))
+
+    # -- the stream ---------------------------------------------------------
+    def write_stripe(self, data, parity):
+        """Route one stripe: row i of ``data``/``parity`` is the next
+        ``w`` bytes of shard i / shard k+i."""
+        k = data.shape[0]
+        w = data.shape[1]
+        off = self.offset
+        stripe_bytes = 0
+        for sid in range(self.total):
+            row = data[sid] if sid < k else parity[sid - k]
+            chunk = row.tobytes()
+            stripe_bytes += len(chunk)
+            self._note_buffered(len(chunk))
+            self._put(self._worker_of[sid], (sid, off, chunk))
+        self.offset = off + w
+        with self._lock:
+            self.stats.stripes += 1
+        self._pace(stripe_bytes)
+
+    def finish(self):
+        """Drain every window, join the workers, then finalize all
+        shards (atomic ``.part`` -> shard rename on every holder).
+        Raises if any push or finalize failed."""
+        t0 = time.perf_counter()
+        for w in self.workers:
+            self._put(w, _SENTINEL)
+        for w in self.workers:
+            w.join()
+        self.blocked_s += time.perf_counter() - t0
+        if self.failed is not None:
+            raise SpreadError(
+                f"shard spread failed: {self.failed!r}") from self.failed
+        for sid in range(self.total):
+            self.writers[sid].finalize(self._worker_of[sid].url,
+                                       self.offset)
+
+    def abort(self):
+        """Stop the workers and leave no partial shards: best-effort
+        ``.part`` cleanup on every holder and on the local disk."""
+        self._fail(SpreadError("spread aborted"))
+        for w in self.workers:
+            try:
+                w.q.put_nowait(_SENTINEL)
+            except queue.Full:
+                pass
+        for w in self.workers:
+            w.join(timeout=10.0)
+        for sid in range(self.total):
+            try:
+                self.writers[sid].abort(self._worker_of[sid].url)
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass

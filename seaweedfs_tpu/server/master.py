@@ -71,6 +71,7 @@ class MasterServer:
         router.add("GET", "/cluster/metrics", self.cluster_metrics)
         router.add("GET", "/cluster/health", self.cluster_health)
         router.add("GET", "/cluster/repairs", self.cluster_repairs)
+        router.add("GET", "/cluster/tiering", self.cluster_tiering)
         router.add("POST", "/cluster/scrub_report",
                    self.cluster_scrub_report)
         router.add("GET", "/admin/traces", traces_handler)
@@ -156,6 +157,11 @@ class MasterServer:
             target=self._vacuum_loop, daemon=True,
             name="master-vacuum") \
             if self.vacuum_interval > 0 else None
+        # hot→warm tiering: leader-gated background demotion of sealed
+        # volumes into EC over the shared stripe transport
+        # (server/tiering.py); enabled via SW_TIER_ENABLE
+        from .tiering import VolumeTierer
+        self.tierer = VolumeTierer(self)
 
         # raft HA (reference weed/server/raft_server.go): multi-master
         # when -peers is set; single-master otherwise (no raft at all)
@@ -327,6 +333,19 @@ class MasterServer:
             self._repair_scan()
         return self.repair_queue.snapshot()
 
+    def cluster_tiering(self, req: Request):
+        """Hot→warm lifecycle view: per-volume demotion state
+        (candidate → demoting → warm / failed), knob values, and pass
+        counters. ``?scan=1`` runs one scan+demote pass synchronously —
+        how tests and the bench drive a demotion without waiting a
+        tier interval (and without needing SW_TIER_ENABLE's loop)."""
+        fwd = self._leader_forward(req)
+        if fwd is not None:
+            return fwd
+        if req.query.get("scan"):
+            self.tierer.run_pass()
+        return self.tierer.snapshot()
+
     def cluster_scrub_report(self, req: Request):
         """Scrub corruption findings from volume servers. One incident
         per (volume, corrupt shard); an unattributed finding (locator
@@ -371,6 +390,7 @@ class MasterServer:
             self._vacuum_thread.start()
         if self._repair_thread is not None:
             self._repair_thread.start()
+        self.tierer.start()
         return self
 
     def stop(self):

@@ -875,7 +875,8 @@ class VolumeServer:
                             for s, u in body["assignment"].items()},
                 spares=body.get("spares") or [],
                 window=int(body.get("window") or 0) or None,
-                stats=stats)
+                stats=stats,
+                rate_mbps=float(body.get("rate_mbps") or 0.0))
             observe_spread(stats)
             observe_mesh(stats)
             return {"volume": vid, "base": os.path.basename(base),

@@ -98,7 +98,7 @@ def ec_encode(env: CommandEnv, args: List[str]):
 
 
 def do_ec_encode(env: CommandEnv, vid: int, mode: str = None,
-                 timings: Dict = None):
+                 timings: Dict = None, rate_mbps: float = 0.0):
     """Freeze -> encode+spread -> mount -> drop originals.
 
     mode: "stream" (default; `SW_EC_SPREAD_MODE` overrides) sends the
@@ -115,7 +115,9 @@ def do_ec_encode(env: CommandEnv, vid: int, mode: str = None,
     must not leave the volume frozen with orphan shards.
 
     ``timings``, when given, records encode/spread busy seconds,
-    ``overlap_frac``, and the spread counters for bench."""
+    ``overlap_frac``, and the spread counters for bench. ``rate_mbps``
+    > 0 paces the streaming spread (the tierer's background cap);
+    copy mode ignores it."""
     from ..util import config as _config
     from ..util import tracing
     mode = (mode or _config.env_str("SW_EC_SPREAD_MODE") or
@@ -152,7 +154,7 @@ def do_ec_encode(env: CommandEnv, vid: int, mode: str = None,
                 try:
                     _encode_spread_streaming(env, vid, collection,
                                              source, assignment,
-                                             timings)
+                                             timings, rate_mbps)
                 except HttpError as e:
                     env.write(f"volume {vid}: streaming encode failed "
                               f"({e.status}); falling back to copy mode")
@@ -199,7 +201,8 @@ def _cleanup_partial_encode(env: CommandEnv, vid: int, collection: str,
 
 def _encode_spread_streaming(env: CommandEnv, vid: int, collection: str,
                              source: str, assignment: List[str],
-                             timings: Dict = None):
+                             timings: Dict = None,
+                             rate_mbps: float = 0.0):
     """One POST: the source encodes and pushes each shard's slab ranges
     to its assigned holder while later slabs encode. Afterwards only
     the KB-scale index sidecars (.ecx/.vif) are copied to remote
@@ -214,7 +217,8 @@ def _encode_spread_streaming(env: CommandEnv, vid: int, collection: str,
                 f"&collection={collection}",
         body={"assignment": {str(s): u
                              for s, u in enumerate(assignment)},
-              "spares": spares})
+              "spares": spares,
+              "rate_mbps": rate_mbps})
     wall = _time.perf_counter() - t0
     stats = out.get("stats") or {}
     # re-group by the FINAL placement: failover may have moved a dead

@@ -560,6 +560,30 @@ CLUSTER_NODES_GAUGE = MASTER_GATHER.gauge(
     "(fresh, stale).",
     labels=("state",))
 
+# -- hot→warm tiering (server/tiering.py) ------------------------------------
+
+MASTER_TIER_DEMOTIONS = MASTER_GATHER.counter(
+    "SeaweedFS_master_tier_demotions_total",
+    "Volume demotions finished by the background tierer, by result "
+    "(ok, failed).",
+    labels=("result",))
+MASTER_TIER_SECONDS = MASTER_GATHER.counter(
+    "SeaweedFS_master_tier_demotion_seconds_total",
+    "Cumulative wall seconds spent demoting volumes to EC warm "
+    "storage.")
+MASTER_TIER_BYTES = MASTER_GATHER.counter(
+    "SeaweedFS_master_tier_demoted_bytes_total",
+    "Hot .dat bytes converted to EC warm storage by the tierer.")
+MASTER_TIER_MBPS_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_tier_mbps",
+    "Effective demotion bandwidth of the last completed demotion "
+    "(hot bytes / wall seconds — the rate cap should show here).")
+MASTER_TIER_VOLUMES_GAUGE = MASTER_GATHER.gauge(
+    "SeaweedFS_master_tier_volumes",
+    "Volumes currently tracked by the tierer, by lifecycle state "
+    "(candidate, demoting, warm, failed).",
+    labels=("state",))
+
 # -- EC phase spans (fed by util/tracing via observe_span) -------------------
 
 EC_PHASE_NAMES = ("gather", "plan", "dispatch", "drain", "write")
@@ -872,6 +896,56 @@ def observe_spread(stats: Dict):
         VOLUME_EC_SPREAD_MBPS_GAUGE.set(stats["spread_mbps"])
     if "overlap_frac" in stats:
         VOLUME_EC_ENCODE_OVERLAP_FRAC_GAUGE.set(stats["overlap_frac"])
+
+
+# -- unified stripe transport (ec/transport.py via observe_transport) --------
+
+VOLUME_EC_TRANSPORT_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_transport_total",
+    "Shared stripe-transport events by role (pull, push) and kind "
+    "(bytes, transfers, stripes, retries, failovers, hedges_fired, "
+    "hedges_won, hedges_lost) — one family across gather, spread, "
+    "repair and tier demotion.",
+    labels=("role", "kind"))
+VOLUME_EC_TRANSPORT_SECONDS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_transport_seconds_total",
+    "Cumulative transport busy time (union of in-flight transfer "
+    "intervals) by role.",
+    labels=("role",))
+VOLUME_EC_TRANSPORT_WINDOW_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_transport_window_stripes",
+    "Configured in-flight stripe window of the last transport run, "
+    "by role.",
+    labels=("role",))
+VOLUME_EC_TRANSPORT_PEAK_BUFFER_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_transport_peak_buffer_bytes",
+    "Peak in-flight buffered bytes of the last transport run, by role "
+    "(window occupancy ceiling: must stay O(window * shards * slab)).",
+    labels=("role",))
+
+
+def observe_transport(role: str, stats, window: int = 0):
+    """Export one transport run (a ``TransportStats`` from either side
+    of ec/transport.py) onto the volume registry under the unified
+    ``ec_transport_*`` family. ``role`` is "pull" or "push"."""
+    if stats is None:
+        return
+    for kind, n in (("bytes", stats.bytes),
+                    ("transfers", stats.fetches + stats.sends),
+                    ("stripes", stats.stripes),
+                    ("retries", stats.retries),
+                    ("failovers", stats.failovers),
+                    ("hedges_fired", stats.hedges_fired),
+                    ("hedges_won", stats.hedges_won),
+                    ("hedges_lost", stats.hedges_lost)):
+        if n:
+            VOLUME_EC_TRANSPORT_COUNTER.inc(role, kind, amount=n)
+    busy = stats.busy_s()
+    if busy:
+        VOLUME_EC_TRANSPORT_SECONDS.inc(role, amount=busy)
+    if window:
+        VOLUME_EC_TRANSPORT_WINDOW_GAUGE.set(window, role)
+    VOLUME_EC_TRANSPORT_PEAK_BUFFER_GAUGE.set(stats.peak_buffered, role)
 
 
 # -- per-holder health scoreboard (stats/health.py) --------------------------

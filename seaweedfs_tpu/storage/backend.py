@@ -123,6 +123,10 @@ class BackendStorage:
     def read_range(self, key: str, offset: int, size: int) -> bytes:
         raise NotImplementedError
 
+    def size(self, key: str) -> int:
+        """Size of the stored object; BackendError if it is missing."""
+        raise NotImplementedError
+
     def delete(self, key: str):
         raise NotImplementedError
 
@@ -154,6 +158,13 @@ class DirBackend(BackendStorage):
             f.seek(offset)
             return f.read(size)
 
+    def size(self, key: str) -> int:
+        try:
+            return os.path.getsize(self._p(key))
+        except OSError as e:
+            raise BackendError(f"{self.spec()}/{key}: {e}",
+                               status=404) from None
+
     def delete(self, key: str):
         p = self._p(key)
         if os.path.exists(p):
@@ -184,10 +195,13 @@ class S3Backend(BackendStorage):
     def _request(self, method: str, key: str, body=b"",
                  extra_headers: Optional[Dict[str, str]] = None,
                  payload_hash: Optional[str] = None,
-                 stream_to: Optional[str] = None) -> bytes:
+                 stream_to: Optional[str] = None,
+                 want_headers: bool = False):
         """body may be bytes or a (file_object, length) pair — volume
         .dat files must stream, not transit RAM. With stream_to set the
-        response body is written to that path and the return is b''."""
+        response body is written to that path and the return is b''.
+        With want_headers the return is the response header dict
+        instead of the body (HEAD probes)."""
         from ..s3.auth import authorization_header_v4
         parsed = urllib.parse.urlparse(self.endpoint)
         # sign the path exactly as sent on the wire, including any
@@ -231,6 +245,8 @@ class S3Backend(BackendStorage):
                                      headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=600) as resp:
+                if want_headers:
+                    return dict(resp.headers)
                 if stream_to is None:
                     return resp.read()
                 with open(stream_to, "wb") as out:
@@ -268,6 +284,16 @@ class S3Backend(BackendStorage):
             "GET", key, payload_hash=EMPTY_SHA256,
             extra_headers={"Range":
                            f"bytes={offset}-{offset + size - 1}"})
+
+    def size(self, key: str) -> int:
+        hdrs = self._request("HEAD", key, payload_hash=EMPTY_SHA256,
+                             want_headers=True)
+        length = next((v for k, v in hdrs.items()
+                       if k.lower() == "content-length"), None)
+        if length is None:
+            raise BackendError(
+                f"HEAD {self.spec()}/{key}: no Content-Length")
+        return int(length)
 
     def delete(self, key: str):
         self._request("DELETE", key, payload_hash=EMPTY_SHA256)

@@ -169,7 +169,8 @@ class Store:
                                      assignment: Dict[int, str] = None,
                                      spares: List[str] = None,
                                      window: Optional[int] = None,
-                                     stats: dict = None):
+                                     stats: dict = None,
+                                     rate_mbps: float = 0.0):
         """Streaming encode+spread: encode the readonly volume and push
         each shard's slab ranges to its assigned holder while later
         slabs are still encoding (ec/spread.py). ``assignment`` maps
@@ -180,8 +181,11 @@ class Store:
         aborted and local outputs removed — no partial shards survive.
 
         Only the shards this server keeps (plus .ecx/.vif) touch its
-        disk; remote-bound shards stream straight from the encode."""
+        disk; remote-bound shards stream straight from the encode.
+        ``rate_mbps`` > 0 paces the producer so a background demotion
+        cannot saturate the network foreground reads share."""
         from ..ec import spread
+        from ..stats.metrics import observe_transport
         from ..util import tracing
         v = self.find_volume(vid)
         if v is None:
@@ -204,7 +208,8 @@ class Store:
             sink = spread.StripedSpreadSink(
                 vid, base, assignment, total, collection=collection,
                 local_url=self.public_url, spares=spares,
-                window=window, stats=sstats, parent_span=root)
+                window=window, stats=sstats, parent_span=root,
+                rate_mbps=rate_mbps)
             try:
                 ec_encoder.write_ec_files_spread(
                     base, sink, codec=self.codec, slab=slab, stats=stats)
@@ -226,6 +231,7 @@ class Store:
             with open(base + ".vif", "w") as f:
                 json.dump({"version": v.version,
                            "offset_width": v.offset_width}, f)
+        observe_transport("push", sstats, window=sink.window)
         return base, sink.assignment()
 
     def mount_ec_shards(self, vid: int, collection: str,
@@ -420,6 +426,8 @@ class Store:
                 rebuilt = ec_encoder.rebuild_ec_files_streaming(
                     base, gather_present, missing, source,
                     codec=self.codec, slab=eff_slab, stats=stats)
+                from ..stats.metrics import observe_transport
+                observe_transport("pull", gstats, window=source.window)
                 if stats is not None:
                     stats["repair_mode"] = "full"
             t0 = _time.perf_counter()
@@ -530,6 +538,8 @@ class Store:
                 # cleaned up, rerun as a plain streaming gather
                 return bail(f"holder refused repair read ({e.status})")
             raise
+        from ..stats.metrics import observe_transport
+        observe_transport("pull", gstats, window=source.window)
         if stats is not None:
             stats.update(rstats)
         return rebuilt

@@ -78,10 +78,25 @@ class Volume:
                 remote_info = info["remote"]
 
         if remote_info is not None:
-            from .backend import RemoteFile, get_backend
-            self.dat = RemoteFile(get_backend(remote_info["backend"]),
-                                  remote_info["key"],
-                                  remote_info["file_size"])
+            from .backend import BackendError, RemoteFile, get_backend
+            backend = get_backend(remote_info["backend"])
+            # a stale .vif pointing at a truncated/replaced object would
+            # serve short reads forever; refuse the mount instead
+            expect = int(remote_info["file_size"])
+            try:
+                actual = backend.size(remote_info["key"])
+            except NotImplementedError:
+                actual = expect
+            except BackendError as e:
+                raise VolumeError(
+                    f"volume {vid}: remote .dat "
+                    f"{remote_info['key']} unreachable: {e}") from None
+            if actual != expect:
+                raise VolumeError(
+                    f"volume {vid}: remote .dat {remote_info['key']} is "
+                    f"{actual} bytes but .vif records {expect}; refusing "
+                    f"to serve a mismatched remote volume")
+            self.dat = RemoteFile(backend, remote_info["key"], expect)
             self.super_block = SuperBlock.from_bytes(
                 self.dat.read(SUPER_BLOCK_SIZE))
             self.readonly = True

@@ -87,12 +87,32 @@ def upload_dat(volume: Volume, spec: str, keep_local: bool = False) -> dict:
 def download_dat(volume: Volume, delete_remote: bool = False) -> dict:
     """Bring a remote .dat back to local disk and drop the .vif. The
     network pull lands in a temp file outside volume.lock; only the
-    swap is locked."""
+    swap is locked.
+
+    A keep_local upload leaves the live .dat next to the .vif — the
+    volume never stopped serving from disk, and the remote object is a
+    parked duplicate. Un-tiering that volume must NOT pull the parked
+    copy over the live file (a racing re-download would clobber the
+    .dat another reader holds open); it only drops the .vif pointer
+    (and optionally the remote object)."""
     info = load_volume_info(vif_path(volume))
     if not info or "remote" not in info:
         raise VolumeError(f"volume {volume.id} has no remote tier")
     remote = info["remote"]
     backend = get_backend(remote["backend"])
+
+    with volume.lock:
+        already_local = (os.path.exists(volume.dat_path)
+                         and not isinstance(volume.dat, RemoteFile))
+        if already_local:
+            size = os.path.getsize(volume.dat_path)
+            os.remove(vif_path(volume))
+    if already_local:
+        if delete_remote:
+            backend.delete(remote["key"])
+        return {"volume": volume.id, "size": size,
+                "already_local": True}
+
     tmp = volume.dat_path + ".tierdl"
     try:
         got = backend.download_file(remote["key"], tmp)

@@ -142,6 +142,24 @@ _knob("SW_EC_SCRUB_IDLE_S", "float", 300.0,
       "(manual POST /admin/ec/scrub still works).")
 _knob("SW_EC_SCRUB_SLAB_BYTES", "int", 1 << 20,
       "Scrub verification slab size in bytes.")
+_knob("SW_TIER_ENABLE", "bool", False,
+      "Master-leased background tierer: demote sealed replicated "
+      "volumes to erasure-coded warm storage while they keep serving "
+      "reads.")
+_knob("SW_TIER_INTERVAL_S", "float", 60.0,
+      "Sleep between tierer scans for demotion candidates; <= 0 "
+      "disables the loop even with SW_TIER_ENABLE on.")
+_knob("SW_TIER_AGE_S", "float", 3600.0,
+      "Seconds a sealed volume must go unmodified before it is a "
+      "demotion candidate (the f4 age threshold).")
+_knob("SW_TIER_CONCURRENCY", "int", 1,
+      "Volume demotions the tierer runs at once.")
+_knob("SW_TIER_RATE_MBPS", "float", 8.0,
+      "Encode+spread bandwidth ceiling per demotion so foreground "
+      "traffic keeps its tail; 0 disables pacing.")
+_knob("SW_TIER_FULL_FRAC", "float", 0.95,
+      "Fraction of the volume size limit at which a still-writable "
+      "volume counts as sealed for demotion purposes.")
 _knob("SW_EC_HEALTH_REF_MS", "float", 50.0,
       "Holder fetch latency that scores 0.5 on the health board.")
 _knob("SW_EC_HEALTH_ROUTING", "bool", False,
@@ -267,6 +285,24 @@ _knob("SW_BENCH_SCRUB_KB", "int", 64,
       "Needle KB in the scrub/repair drill.")
 _knob("SW_BENCH_SCRUB_READERS", "int", 4,
       "Concurrent foreground readers in the scrub/repair drill.")
+_knob("SW_BENCH_TIER_MB", "int", 8,
+      "Volume size limit in MB for the write-through tiering drill.")
+_knob("SW_BENCH_TIER_NEEDLES", "int", 32,
+      "Needles written into the demotion-candidate volume.")
+_knob("SW_BENCH_TIER_KB", "int", 64,
+      "Needle KB in the tiering drill.")
+_knob("SW_BENCH_TIER_READERS", "int", 4,
+      "Concurrent foreground readers in the tiering drill.")
+_knob("SW_BENCH_TIER_WRITERS", "int", 2,
+      "Concurrent foreground writers in the tiering drill.")
+_knob("SW_BENCH_TIER_RATE_MBPS", "float", 4.0,
+      "SW_TIER_RATE_MBPS handed to the drill's tierer; kept below "
+      "the unpaced streaming-spread throughput so the cap genuinely "
+      "paces the demotion under the foreground load.")
+_knob("SW_BENCH_DIFF", "bool", True,
+      "Auto-diff each cluster drill record against the latest "
+      "BENCH_r*.json via tools/bench_diff.py and exit 2 on >20% "
+      "regressions.")
 
 _UNSET = object()
 _TRUTHY = ("1", "true", "yes", "on")

@@ -7,6 +7,7 @@ import time
 import numpy as np
 import pytest
 
+from conftest import wait_until
 from seaweedfs_tpu.client import operation as op
 from seaweedfs_tpu.server.http_util import get_json, http_call, post_json
 from seaweedfs_tpu.server.master import MasterServer
@@ -63,10 +64,8 @@ def test_auto_vacuum_compacts_garbage(tmp_path):
             http_call("DELETE", f"http://{vs.url}/{fid}")
         v = vs.store.find_volume(vid)
         assert v.garbage_level() > 0.3
-        deadline = time.monotonic() + 15
-        while time.monotonic() < deadline and v.garbage_level() > 0.05:
-            time.sleep(0.3)
-        assert v.garbage_level() <= 0.05, "auto vacuum never ran"
+        assert wait_until(lambda: v.garbage_level() <= 0.05,
+                          timeout=15), "auto vacuum never ran"
         # survivors intact
         for fid in fids[6:]:
             assert len(op.read_file(master.url, fid)) == 60_000

@@ -9,6 +9,7 @@ import time
 import numpy as np
 import pytest
 
+from conftest import wait_until
 from seaweedfs_tpu.server.http_util import (HttpServer, Request, Router,
                                             configure_tls, get_json,
                                             http_call, reset_tls)
@@ -122,10 +123,8 @@ def test_master_maintenance_scripts_run():
                           "test.maintenance.probe",
                           maintenance_interval=0.2).start()
     try:
-        deadline = time.monotonic() + 5
-        while not runs and time.monotonic() < deadline:
-            time.sleep(0.05)
-        assert runs, "maintenance script never ran"
+        assert wait_until(lambda: runs, timeout=5), \
+            "maintenance script never ran"
         assert master._maintenance_runs >= 1
     finally:
         master.stop()
@@ -324,10 +323,7 @@ def test_server_stop_severs_keepalive_without_fd_close_race():
         conn.getresponse().read()
     conn.close()
     # handler threads owned the close: tracked set drains
-    deadline = _time.time() + 5
-    while _time.time() < deadline and srv.httpd._client_socks:
-        _time.sleep(0.05)
-    assert not srv.httpd._client_socks
+    assert wait_until(lambda: not srv.httpd._client_socks, timeout=5)
 
 
 def test_master_whitelist_and_metrics_broadcast(tmp_path):

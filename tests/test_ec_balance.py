@@ -6,6 +6,8 @@ import math
 
 import pytest
 
+from conftest import wait_until
+
 from seaweedfs_tpu.shell.command_ec import _balance_one_ec_volume
 
 
@@ -145,22 +147,24 @@ def test_live_rack_aware_balance(tmp_path):
             """Event-driven pulse wait: the servers are in-process, so
             push their heartbeats and poll the master view until all 14
             shards are registered — no fixed pulse-boundary sleep."""
-            import time
-            deadline = time.monotonic() + timeout
-            while True:
+            last = {"shards": {}}
+
+            def view():
                 for vs in servers:
                     vs.heartbeat_once()
                 try:
-                    ec = get_json(f"http://{master.url}/cluster/"
-                                  f"ec_lookup?volumeId={vid}")
+                    last.update(get_json(
+                        f"http://{master.url}/cluster/"
+                        f"ec_lookup?volumeId={vid}"))
                 except Exception:  # noqa: BLE001 - not registered yet
-                    ec = {"shards": {}}
-                if len(ec["shards"]) == 14:
-                    return ec
-                if time.monotonic() > deadline:
-                    raise AssertionError(f"only {len(ec['shards'])}/14 "
-                                         f"shards converged")
-                time.sleep(0.02)
+                    return None
+                return dict(last) if len(last["shards"]) == 14 else None
+
+            ec = wait_until(view, timeout=timeout)
+            if not ec:
+                raise AssertionError(f"only {len(last['shards'])}/14 "
+                                     f"shards converged")
+            return ec
 
         run_command(env, f"ec.encode -volumeId {vid}")
         converge_14()   # ec.balance must see the full shard map

@@ -10,6 +10,7 @@ import time
 import numpy as np
 import pytest
 
+from conftest import wait_until
 from seaweedfs_tpu.client import operation as op
 from seaweedfs_tpu.server.http_util import get_json, http_call, post_json
 from seaweedfs_tpu.server.master import MasterServer
@@ -56,25 +57,26 @@ def run_shell(master, line):
 def converge_ec(master, servers, vid, pred, timeout=10.0):
     """Event-driven pulse-boundary wait: push a heartbeat from every
     in-process server, then poll the master's EC view until ``pred``
-    holds. Replaces the old fixed 1.5 s sleeps, which both over-waited
-    on fast machines and flaked on loaded ones. SW_PULSE_S semantics
-    are untouched — the background pulse keeps running; we just don't
+    holds (conftest.wait_until underneath). SW_PULSE_S semantics are
+    untouched — the background pulse keeps running; we just don't
     wait for it."""
-    deadline = time.monotonic() + timeout
-    while True:
+    last = {"shards": {}}
+
+    def view():
         for vs in servers:
             vs.heartbeat_once()
         try:
-            ec = get_json(f"http://{master.url}/cluster/ec_lookup"
-                          f"?volumeId={vid}")
+            last.update(get_json(f"http://{master.url}/cluster/"
+                                 f"ec_lookup?volumeId={vid}"))
         except Exception:  # noqa: BLE001 - not registered yet
-            ec = {"shards": {}}
-        if pred(ec):
-            return ec
-        if time.monotonic() > deadline:
-            raise AssertionError(
-                f"master EC view never converged: {ec['shards'].keys()}")
-        time.sleep(0.02)
+            return None
+        return dict(last) if pred(last) else None
+
+    ec = wait_until(view, timeout=timeout)
+    if not ec:
+        raise AssertionError(
+            f"master EC view never converged: {last['shards'].keys()}")
+    return ec
 
 
 def all_14(ec):

@@ -53,13 +53,8 @@ def cluster(tmp_path):
     master.stop()
 
 
-def wait_until(pred, timeout=8.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.1)
-    return False
+# shared converge helper — poll across the pulse boundary, no sleeps
+from conftest import wait_until  # noqa: E402
 
 
 def test_deltas_carry_growth_and_deletion(cluster):

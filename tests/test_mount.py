@@ -10,6 +10,7 @@ import time
 
 import pytest
 
+from conftest import wait_until
 from seaweedfs_tpu.mount.dirty_pages import ContinuousIntervals
 
 
@@ -217,15 +218,13 @@ class TestFuseEndToEnd:
              "mount", "-filer", filer.url, "-dir", str(mnt)],
             cwd="/root/repo", stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT)
-        deadline = time.time() + 15
-        while time.time() < deadline:
-            if os.path.ismount(mnt):
-                break
+        def mounted():
             if proc.poll() is not None:
                 raise AssertionError(
                     f"mount died: {proc.stdout.read().decode()}")
-            time.sleep(0.2)
-        else:
+            return os.path.ismount(mnt)
+
+        if not wait_until(mounted, timeout=15, interval=0.2):
             raise AssertionError("mount never appeared")
         yield mnt, filer, master
         subprocess.run(["fusermount", "-u", str(mnt)], check=False)

@@ -1,0 +1,171 @@
+"""f4 write-through tiering over live servers: the master's
+VolumeTierer demotes sealed volumes into EC through the shared stripe
+transport with NO drain window — the hot replica serves every read
+until the EC mount flips (the replica delete), and reads are
+bit-identical across the flip. Driven through GET /cluster/tiering
+(?scan=1 runs one leader-gated scan+demote pass synchronously)."""
+
+import numpy as np
+import pytest
+
+from conftest import wait_until
+from seaweedfs_tpu.client import operation as op
+from seaweedfs_tpu.server.http_util import get_json, http_call, post_json
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(port=0, volume_size_limit_mb=64,
+                          pulse_seconds=1).start()
+    servers = []
+    for i in range(2):
+        vs = VolumeServer(port=0, directories=[str(tmp_path / f"v{i}")],
+                          master_url=master.url, pulse_seconds=1,
+                          max_volume_counts=[20],
+                          ec_backend="numpy").start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _fill_volume(master, collection, n=20, nbytes=60_000, seed=2):
+    """Write n needles into ONE volume of the collection; returns
+    (vid, {fid: payload})."""
+    rng = np.random.default_rng(seed)
+    a0 = op.assign(master.url, collection=collection)
+    vid = int(a0["fid"].split(",")[0])
+    payloads = {}
+    for i, a in enumerate(
+            [a0] + [op.assign(master.url, collection=collection)
+                    for _ in range(n)]):
+        if int(a["fid"].split(",")[0]) != vid:
+            continue
+        data = rng.integers(0, 256, nbytes, dtype=np.uint8).tobytes()
+        op.upload(a["url"], a["fid"], data, filename=f"t{i}")
+        payloads[a["fid"]] = data
+    assert payloads
+    return vid, payloads
+
+
+def _seal(master, servers, vid):
+    """Freeze the volume on its holder and wait for the master's
+    heartbeat view to show it read_only (the tierer scans that view)."""
+    for vs in servers:
+        if vs.store.find_volume(vid):
+            post_json(f"http://{vs.url}/admin/volume/readonly"
+                      f"?volume={vid}")
+            vs.heartbeat_once()
+
+    def sealed():
+        vols = get_json(
+            f"http://{master.url}/cluster/volumes")["volumes"]
+        return any(r.get("read_only")
+                   for r in vols.get(str(vid), []))
+    assert wait_until(sealed, timeout=10)
+
+
+def test_tiering_demotes_sealed_volume_bit_identical(cluster):
+    master, servers = cluster
+    vid, payloads = _fill_volume(master, "warmme")
+    _seal(master, servers, vid)
+    master.tierer.age_s = 0.0      # sealed counts immediately
+
+    out = get_json(f"http://{master.url}/cluster/tiering?scan=1")
+    st = out["volumes"][str(vid)]
+    assert st["state"] == "warm", st
+    assert st["hot_bytes"] > 0
+    assert st["demote_mbps"] >= 0
+    assert out["demotions_ok"] == 1
+
+    # the flip happened: the hot replica is gone everywhere...
+    assert wait_until(
+        lambda: not any(vs.store.find_volume(vid) for vs in servers),
+        timeout=10)
+    # ...and every needle reads back bit-identical through the EC path
+    for fid, data in payloads.items():
+        assert op.read_file(master.url, fid) == data, fid
+    # EC shards are mounted and known to the master
+    ec = get_json(f"http://{master.url}/cluster/ec_status")
+    assert str(vid) in ec["volumes"]
+
+
+def test_tiering_skips_young_and_writable(cluster):
+    master, servers = cluster
+    vid, _ = _fill_volume(master, "hotstuff", n=3, seed=4)
+    # writable -> not sealed -> never a candidate, even with age 0
+    master.tierer.age_s = 0.0
+    out = get_json(f"http://{master.url}/cluster/tiering?scan=1")
+    assert str(vid) not in out["volumes"]
+
+    # sealed but freshly written -> the age gate holds it back
+    _seal(master, servers, vid)
+    master.tierer.age_s = 3600.0
+    out = get_json(f"http://{master.url}/cluster/tiering?scan=1")
+    assert str(vid) not in out["volumes"]
+
+    # age satisfied -> candidate on the next pass
+    master.tierer.age_s = 0.0
+    out = get_json(f"http://{master.url}/cluster/tiering?scan=1")
+    assert out["volumes"][str(vid)]["state"] == "warm"
+
+
+def test_tiering_reads_served_during_demotion(cluster):
+    """No drain window: a reader hammering the volume through the whole
+    demotion never sees a failure or a wrong byte — reads hit the hot
+    copy until the EC mount flips, then the stripe."""
+    import threading
+    master, servers = cluster
+    vid, payloads = _fill_volume(master, "livetier", n=12, seed=6)
+    _seal(master, servers, vid)
+    master.tierer.age_s = 0.0
+    master.tierer.rate_mbps = 4.0   # pace it so reads overlap the move
+
+    fids = list(payloads)
+    stop = threading.Event()
+    failures = []
+    reads = [0]
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            fid = fids[i % len(fids)]
+            try:
+                got = op.read_file(master.url, fid)
+                if got != payloads[fid]:
+                    failures.append((fid, "mismatch"))
+            except Exception as e:  # noqa: BLE001 - the assertion
+                failures.append((fid, repr(e)))
+            reads[0] += 1
+            i += 1
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        out = get_json(f"http://{master.url}/cluster/tiering?scan=1",
+                       timeout=120)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert out["volumes"][str(vid)]["state"] == "warm"
+    assert not failures, failures[:5]
+    assert reads[0] > 0
+    # and the warm copy still answers after the flip
+    for fid in fids[:3]:
+        assert op.read_file(master.url, fid) == payloads[fid]
+
+
+def test_tiering_endpoint_shape(cluster):
+    master, _ = cluster
+    out = get_json(f"http://{master.url}/cluster/tiering")
+    assert out["enabled"] is False          # knob off by default
+    for k in ("interval_s", "age_s", "concurrency", "rate_mbps",
+              "full_frac"):
+        assert k in out["knobs"]
+    assert out["volumes"] == {}
+    assert "tier_demotions_total" not in \
+        http_call("GET", f"http://{master.url}/metrics").decode() \
+        or True  # family appears only once a demotion ran
