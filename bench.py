@@ -728,6 +728,60 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
             lambda: (lambda m: m if set(m) == set(range(TOTAL))
                      else None)(lookup_shards()),
             "all shards back after the repair drill")) == set(range(TOTAL))
+
+        # -- piggyback layout drill: a second (smaller) volume encoded
+        # with SW_EC_LAYOUT=piggyback, one data shard destroyed, -repair
+        # auto routed to the plane repair. Its repair_bytes_frac lands
+        # at the coupled layout's (k+1)/(2k) floor — 0.55 for RS(10,4)
+        # — reported beside the trace drill's frac and the full-gather
+        # baseline (1.0) so all three repair strategies sit in one
+        # record.
+        pb_mb = max(size_mb // 4, 8)
+        a2 = op.assign(master.url, collection="bench")
+        vid2 = int(a2["fid"].split(",")[0])
+        written = 0
+        i = 0
+        while written < (pb_mb << 20):
+            i += 1
+            op.upload(a2["url"], f"{vid2},{i:x}00000001", chunk,
+                      filename=f"p{i}")
+            written += len(chunk)
+        os.environ["SW_EC_LAYOUT"] = "piggyback"
+        try:
+            pb_enc = {}
+            do_ec_encode(env, vid2, timings=pb_enc)
+        finally:
+            os.environ.pop("SW_EC_LAYOUT", None)
+
+        def lookup_shards2():
+            out2 = get_json(f"http://{master.url}/cluster/ec_lookup"
+                            f"?volumeId={vid2}")
+            return {int(s): urls for s, urls in out2["shards"].items()}
+
+        pb_map = poll(
+            lambda: (lambda m: m if set(m) == set(range(TOTAL))
+                     else None)(lookup_shards2()),
+            "all piggyback shards at the master")
+        pb_sid = 0  # a coupled data shard: the plane-repair fast path
+        pb_holder = pb_map[pb_sid][0]
+        post_json(f"http://{pb_holder}/admin/ec/unmount?volume={vid2}"
+                  f"&shards={pb_sid}")
+        post_json(f"http://{pb_holder}/admin/ec/delete_shards"
+                  f"?volume={vid2}&collection=bench&shards={pb_sid}")
+        pb_map = poll(
+            lambda: (lambda m: m if pb_holder not in
+                     m.get(pb_sid, []) else None)(lookup_shards2()),
+            "piggyback shard loss at the master")
+        pb_rep = {}
+        t_pb = time.perf_counter()
+        do_ec_rebuild(env, vid2, "bench", pb_map, [pb_sid],
+                      timings=pb_rep, repair="auto")
+        pb_repair_wall_s = time.perf_counter() - t_pb
+        ok = ok and set(poll(
+            lambda: (lambda m: m if set(m) == set(range(TOTAL))
+                     else None)(lookup_shards2()),
+            "piggyback shard back after plane repair")) \
+            == set(range(TOTAL))
         rep_dev = _dstats.delta(dsnap2)
         # compile/steady split: the headline MB/s must measure the
         # serving path a warm fleet runs, so compile wall (a once-per-
@@ -827,6 +881,19 @@ def measure_cluster_rebuild(size_mb: int = 256, n_servers: int = 4,
                "repair_wall_s": round(repair_wall_s, 2),
                "repair_helpers": repair_timings.get("repair_helpers", 0),
                "repair_fallback": repair_timings.get("repair_fallback"),
+               # piggyback layout drill (plane repair on the coupled
+               # sub-chunk layout vs the same k*shard baseline; the
+               # construction's floor is (k+1)/(2k) = 0.55 for RS(10,4),
+               # between trace's measured frac and full's 1.0)
+               "piggyback_volume_mb": pb_mb,
+               "piggyback_repair_mode": pb_rep.get("repair_mode", "?"),
+               "piggyback_repair_bytes_frac": round(
+                   pb_rep.get("repair_bytes_frac", 1.0), 3),
+               "piggyback_repair_wall_s": round(pb_repair_wall_s, 2),
+               "piggyback_repair_helpers": pb_rep.get(
+                   "repair_helpers", 0),
+               "piggyback_repair_fallback": pb_rep.get("repair_fallback"),
+               "full_repair_bytes_frac": 1.0,
                "all_shards_restored": ok}
         log(f"cluster rebuild: {out}")
         return out

@@ -50,10 +50,26 @@ def iterate_ecj_file(base_name: str):
 
 
 def write_idx_file_from_ec_index(base_name: str):
-    """.ecx + .ecj -> .idx (reference WriteIdxFileFromEcIndex)."""
+    """.ecx + .ecj -> .idx (reference WriteIdxFileFromEcIndex).
+
+    Only the record-aligned prefix of the .ecx is copied: a piggyback
+    volume's index carries a trailing layout version byte (ec/layout),
+    and copying it would misalign every tombstone record appended
+    below. The .idx format has no layout tag — the tag describes shard
+    parity, and the .idx outlives the shards."""
+    from ..storage.types import entry_size
+    from .layout import ecx_record_bytes
     width = read_ec_volume_superblock(base_name).offset_width
-    shutil.copyfile(base_name + ".ecx", base_name + ".idx")
-    with open(base_name + ".idx", "ab") as idx:
+    aligned = ecx_record_bytes(base_name + ".ecx", entry_size(width))
+    with open(base_name + ".ecx", "rb") as src, \
+            open(base_name + ".idx", "wb") as idx:
+        left = aligned
+        while left > 0:
+            chunk = src.read(min(8 << 20, left))
+            if not chunk:
+                break
+            idx.write(chunk)
+            left -= len(chunk)
         for nid in iterate_ecj_file(base_name):
             idx.write(entry_to_bytes(nid, 0, TOMBSTONE_FILE_SIZE, width))
 
@@ -259,6 +275,133 @@ def rebuild_ec_file_repair(base_name: str, lost_sid: int, source, plan,
         stats["repair_total_bits"] = plan.total_bits
         stats["repair_bits"] = {int(s): plan.bits_for(s)
                                 for s in plan.helpers}
+        stats["repair_bytes"] = gs.bytes
+        stats["repair_remote_bytes"] = gs.remote_bytes
+        stats["repair_baseline_bytes"] = baseline
+        stats["repair_bytes_frac"] = round(
+            gs.bytes / baseline, 4) if baseline else 0.0
+        stats["repair_mbps"] = round(gs.mbps(), 1)
+    return [lost_sid]
+
+
+def rebuild_ec_file_piggyback(base_name: str, lost_sid: int, source,
+                              rplan, window: int, codec=None,
+                              slab: int = 8 << 20,
+                              pipelined: Optional[bool] = None,
+                              stats: Optional[dict] = None) -> List[int]:
+    """Rebuild ONE coupled data shard from half-plane helper streams.
+
+    ``source`` is an ec.gather.PlaneGatherSource: each stripe arrives
+    as the restacked plane rows of every helper — k-1 data shards plus
+    2 parities, ((k+1)*alpha/2, w/alpha) uint8 for a w-byte shard
+    range. ``rplan.matrix`` (ops/codec.piggyback_repair_plan) turns
+    that stack into the lost shard's alpha sub-chunk rows in one
+    GF(2^8) matmul — the same fused kernels as the full decode — and
+    pb_merge interleaves the rows back into shard bytes. Download is
+    (k+1)/(2k) of the k*shard full-gather baseline: 0.55 for RS(10,4).
+
+    All-or-nothing: any failure removes the partial shard file before
+    propagating, so the caller can fall back to the full decode with a
+    clean slate."""
+    from ..ops import telemetry
+    from ..ops.codec import get_codec, pb_merge
+    from .constants import PARITY_SHARDS
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    if pipelined is None:
+        pipelined = codec.backend in ("tpu", "mesh")
+    if lost_sid != rplan.lost:
+        raise ValueError(f"plan repairs shard {rplan.lost}, not {lost_sid}")
+    alpha = rplan.alpha
+    before = telemetry.STATS.snapshot()
+    phases = {"gather": 0.0, "plan": 0.0, "dispatch": 0.0,
+              "drain": 0.0, "write": 0.0}
+    out_path = base_name + to_ext(lost_sid)
+    out = open(out_path, "wb")
+    rebuilt_bytes = 0
+    # stripe columns are w/alpha wide for a w-byte shard range
+    stride_cap = max(1, int(slab)) // alpha + 1
+    t_stream = time.perf_counter()
+    try:
+        if pipelined:
+            from ..ops.pipeline import PipelinedMatmul
+            ptimer = StageTimer()
+            pm = PipelinedMatmul(rplan.matrix, max_width=stride_cap,
+                                 codec=codec, timer=ptimer)
+            for meta, _, sub in pm.stream(source.slabs()):
+                _, _, w = meta
+                t0 = time.perf_counter()
+                merged = pb_merge(np.asarray(sub, dtype=np.uint8),
+                                  alpha, window)
+                out.write(merged[0].tobytes())
+                rebuilt_bytes += w
+                phases["write"] += time.perf_counter() - t0
+            phases["gather"] = ptimer.totals.get("read_wait", 0.0)
+            phases["dispatch"] = ptimer.totals.get("h2d", 0.0)
+            phases["drain"] = ptimer.totals.get("drain_wait", 0.0)
+        else:
+            it = source.slabs()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    meta, stacked = next(it)
+                except StopIteration:
+                    break
+                _, _, w = meta
+                t1 = time.perf_counter()
+                sub = codec._matmul(rplan.matrix, stacked)
+                t2 = time.perf_counter()
+                merged = pb_merge(np.asarray(sub, dtype=np.uint8),
+                                  alpha, window)
+                out.write(merged[0].tobytes())
+                rebuilt_bytes += w
+                t3 = time.perf_counter()
+                phases["gather"] += t1 - t0
+                phases["dispatch"] += t2 - t1
+                phases["write"] += t3 - t2
+    except BaseException:
+        out.close()
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
+        raise
+    finally:
+        if not out.closed:
+            out.close()
+    stream_s = time.perf_counter() - t_stream
+    residual = stream_s - (sum(phases.values()) - phases["plan"])
+    if residual > 0:
+        phases["dispatch"] += residual
+    for name, secs in phases.items():
+        if secs > 0:
+            tracing.record_span(name, secs, op="ec.rebuild",
+                                backend=codec.backend, repair="piggyback")
+    if stats is not None:
+        gs = source.stats
+        baseline = rplan.k * source.shard_size
+        stats.update(telemetry.delta(before))
+        stats.update(gs.snapshot())
+        stats["rebuilt_bytes"] = rebuilt_bytes
+        stats["stream_s"] = round(stream_s, 3)
+        stats["backend"] = codec.backend
+        stats["layout"] = "piggyback"
+        stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
+        gather_busy = gs.busy_s()
+        compute_busy = max(stream_s - phases["gather"], 0.0)
+        serialized = gather_busy + compute_busy
+        overlap = 0.0
+        if serialized > 0:
+            overlap = max(0.0, min(1.0,
+                                   (serialized - stream_s) / serialized))
+        stats["gather_busy_s"] = round(gather_busy, 3)
+        stats["compute_busy_s"] = round(compute_busy, 3)
+        stats["overlap_frac"] = round(overlap, 4)
+        stats["gather_mbps"] = round(gs.mbps(), 1)
+        stats["gather_remote_shards"] = gs.remote_shards
+        # the repair story: half-plane bytes moved vs the k*shard
+        # baseline the full-RS gather would have pulled
+        stats["repair_mode"] = "piggyback"
+        stats["repair_helpers"] = len(rplan.helpers)
         stats["repair_bytes"] = gs.bytes
         stats["repair_remote_bytes"] = gs.remote_bytes
         stats["repair_baseline_bytes"] = baseline

@@ -417,8 +417,27 @@ class DegradedReadEngine:
                 raise EcShardNotFound(
                     f"cannot reconstruct {vid}.{sid}: only "
                     f"{sum(present)} of {codec.k} survivors reachable")
-            with tracing.span("plan", backend=codec.backend):
-                src, row = codec.lost_row_coeffs(tuple(present), sid)
+            # the volume's layout picks the decode basis: flat volumes
+            # use the single lost-row coefficients over raw bytes,
+            # piggyback volumes need the coupled plan's alpha sub-chunk
+            # rows over window-split survivor slabs
+            li = self._layout(ev, codec)
+            with tracing.span("plan", backend=codec.backend,
+                              layout=li.layout):
+                if li.piggyback:
+                    from ..ops import codec as ops_codec
+                    src, pmissing, coeffs = \
+                        ops_codec.piggyback_decode_plan(
+                            codec.k, codec.m, tuple(present),
+                            matrix_kind=getattr(codec, "matrix_kind",
+                                                "vandermonde"),
+                            matrix=getattr(codec, "matrix", None),
+                            pairs=li.pairs)
+                    pos = pmissing.index(sid)
+                    row = np.ascontiguousarray(
+                        coeffs[pos * li.alpha:(pos + 1) * li.alpha])
+                else:
+                    src, row = codec.lost_row_coeffs(tuple(present), sid)
 
             stats = GatherStats()
             timeout = degraded_read_timeout_s()
@@ -439,6 +458,11 @@ class DegradedReadEngine:
             shard_size = self._shard_size(vid, ev, src, locations,
                                           self_url)
             runs = self._runs(idxs, shard_size)
+            if li.piggyback:
+                # the coupled transform is window-local: widen each run
+                # to window boundaries (shard sizes are window-aligned
+                # by construction, so the widened runs stay in range)
+                runs = self._window_runs(runs, li.window, shard_size)
             try:
                 blocks = self._gather(readers, runs, root)
             except Exception as e:
@@ -451,7 +475,11 @@ class DegradedReadEngine:
                     f"survivor gather for {vid}.{sid} failed: {e}") \
                     from e
 
-            out = self._dispatch(codec, row, blocks)
+            if li.piggyback:
+                out = self._dispatch_piggyback(codec, row, blocks,
+                                               li.alpha, li.window)
+            else:
+                out = self._dispatch(codec, row, blocks)
             slabs = self._split(runs, out, shard_size)
             for idx, data in slabs.items():
                 self.cache.put((vid, sid, idx), data)
@@ -512,6 +540,72 @@ class DegradedReadEngine:
             runs.append((off, max(0, end - off), idxs[i:j + 1]))
             i = j + 1
         return runs
+
+    def _layout(self, ev, codec):
+        """Resolve the volume's on-disk layout from its local sidecars;
+        a server with no mounted index (ev is None) cannot be serving
+        the needle lookup that led here, so flat is the safe default."""
+        from ..storage.types import entry_size
+        from .layout import LayoutInfo, volume_layout
+        base = getattr(ev, "base_name", None)
+        if base is None:
+            return LayoutInfo()
+        width = getattr(ev, "offset_width", None) or 4
+        return volume_layout(base, codec.k, record_size=entry_size(width))
+
+    @staticmethod
+    def _window_runs(runs, window: int, shard_size: int):
+        """Widen byte runs to sub-chunk window boundaries so the
+        piggyback transform sees whole windows; zero-width (past-tail)
+        runs stay empty."""
+        out = []
+        for off, w, members in runs:
+            if w <= 0:
+                out.append((off, w, members))
+                continue
+            aoff = off - off % window
+            end = off + w
+            aend = min(-(-end // window) * window, shard_size)
+            out.append((aoff, aend - aoff, members))
+        return out
+
+    def _dispatch_piggyback(self, codec, rows: np.ndarray,
+                            blocks: List[np.ndarray], alpha: int,
+                            window: int) -> np.ndarray:
+        """ONE coupled decode dispatch for the whole batch: window-split
+        the concatenated survivor slab, multiply by the lost shard's
+        alpha sub-chunk coefficient rows, and interleave the result back
+        into shard bytes. Same host/device crossover as the flat path,
+        measured on the sub-chunk width."""
+        from ..ops.codec import (dispatch_threshold, host_matmul, pb_merge,
+                                 pb_split)
+        data = blocks[0] if len(blocks) == 1 else \
+            np.concatenate(blocks, axis=1)
+        width = data.shape[1]
+        if width == 0:
+            return np.zeros(0, dtype=np.uint8)
+        sub = pb_split(data, alpha, window)
+        thr = dispatch_threshold(codec)
+        host = (not thr) or sub.shape[1] < thr
+        with tracing.span("dispatch", backend=codec.backend,
+                          bytes=int(data.nbytes), layout="piggyback",
+                          path="host" if host else "device"):
+            if host:
+                out = host_matmul(rows, sub)
+                with self._lock:
+                    self._c["host_dispatches"] += 1
+            else:
+                from ..ops.pipeline import PipelinedMatmul
+                pm = PipelinedMatmul(
+                    rows, max_width=max(sub.shape[1], 1 << 20),
+                    codec=codec)
+                out = None
+                for _meta, _d, o in pm.stream([(None, sub)]):
+                    out = o
+                with self._lock:
+                    self._c["device_dispatches"] += 1
+        merged = pb_merge(np.asarray(out, dtype=np.uint8), alpha, window)
+        return np.ascontiguousarray(merged[0])
 
     def _gather(self, readers, runs, root) -> List[np.ndarray]:
         """Fetch every (survivor row x run) range concurrently; returns

@@ -298,9 +298,19 @@ class EcVolume:
         return True
 
     def write_vif(self, version: int = None):
+        # merge-write: the .vif also carries the EC layout keys
+        # (ec_layout/ec_window/ec_pairs, ec/layout.py) which a version
+        # bump must not erase
+        info = {}
+        try:
+            with open(self.base_name + ".vif") as f:
+                info = json.load(f) or {}
+        except (OSError, ValueError):
+            pass
+        info["version"] = version or self.version
+        info["offset_width"] = self.offset_width or 4
         with open(self.base_name + ".vif", "w") as f:
-            json.dump({"version": version or self.version,
-                       "offset_width": self.offset_width or 4}, f)
+            json.dump(info, f)
 
     def close(self):
         self.ecx_file.close()

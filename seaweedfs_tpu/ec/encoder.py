@@ -97,6 +97,53 @@ def _dat_slabs(dat_path: str, dat_size: int, k: int, large_block: int,
             processed += small_row
 
 
+def _window_batches(slabs: Iterator[Tuple[None, np.ndarray]],
+                    window: int) -> Iterator[Tuple[None, np.ndarray]]:
+    """Re-chunk a slab stream onto sub-chunk window boundaries.
+
+    The piggyback parity transform is window-local (ops/codec.pb_split
+    interleaves alpha sub-chunks per window), so every batch fed to the
+    encode matmul must be a whole number of windows. Slab widths from
+    the block reader are arbitrary, but shards append contiguously, so
+    buffering the non-aligned remainder into the next batch preserves
+    shard bytes exactly. The stream total is window-aligned by
+    construction (both stripe blocks divide by the window), so the
+    buffer always drains."""
+    held: Optional[np.ndarray] = None
+    for _, data in slabs:
+        if held is not None:
+            data = np.concatenate([held, data], axis=1)
+            held = None
+        cut = (data.shape[1] // window) * window
+        if cut < data.shape[1]:
+            held = np.ascontiguousarray(data[:, cut:])
+            data = data[:, :cut]
+        if data.shape[1]:
+            yield None, np.ascontiguousarray(data)
+    if held is not None and held.shape[1]:
+        raise ValueError(
+            f"stream tail of {held.shape[1]} bytes is not window-aligned "
+            f"(window {window}); block sizes must divide by the window")
+
+
+def piggyback_geometry(codec: ReedSolomonCodec, layout,
+                       large_block: int, small_block: int):
+    """Resolve (plan, window) for a piggyback encode/rebuild and check
+    the stripe geometry supports sub-chunking: the window must divide
+    both stripe blocks so every shard size is window-aligned."""
+    from ..ops import codec as ops_codec
+    pplan = ops_codec.piggyback_plan(
+        codec.k, codec.m, matrix_kind=getattr(codec, "matrix_kind",
+                                              "vandermonde"),
+        matrix=getattr(codec, "matrix", None))
+    window = ops_codec.pb_window(small_block, pplan.alpha)
+    if large_block % window:
+        raise ValueError(
+            f"piggyback layout: large block {large_block} not divisible "
+            f"by the sub-chunk window {window}")
+    return pplan, window
+
+
 def _coalesce_slabs(slabs: Iterator[Tuple[None, np.ndarray]],
                     target_width: int) -> Iterator[Tuple[None, np.ndarray]]:
     """Hstack consecutive row-slabs up to target_width per device call.
@@ -128,7 +175,8 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
                    slab: int = DEFAULT_SLAB,
                    pipelined: Optional[bool] = None,
                    timer: Optional[StageTimer] = None,
-                   sink=None):
+                   sink=None,
+                   layout: str = "flat"):
     """Encode base_name.dat into base_name.ec00 .. .ec{k+m-1}.
 
     pipelined: None = auto (pipeline when the codec is device-backed);
@@ -141,11 +189,24 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
     local shard files — each stripe is the next slab-aligned byte range
     of every shard, pushed to its holder while later slabs encode. The
     caller owns the sink lifecycle (finish/abort).
+
+    ``layout``: "flat" (default; plain RS parity) or "piggyback"
+    (coupled sub-chunk parity, ops/codec.piggyback_plan). Data shard
+    bytes are identical under both layouts — only the parity rows
+    differ, computed per window by one (m*alpha, k*alpha) matmul on
+    the same kernels. Callers record the layout in the volume's
+    sidecars (ec/layout.py); this function only shapes the bytes.
     """
+    from ..ops import codec as ops_codec
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
     k, m = codec.k, codec.m
     if pipelined is None:
         pipelined = codec.backend in ("tpu", "mesh")
+    piggyback = layout == "piggyback"
+    pplan = window = None
+    if piggyback:
+        pplan, window = piggyback_geometry(codec, layout, large_block,
+                                           small_block)
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     # always collect stages: the per-phase spans below need them even
@@ -158,11 +219,39 @@ def write_ec_files(base_name: str, codec: Optional[ReedSolomonCodec] = None,
     # device-parallel compute feeding holder-parallel network: with a
     # piecewise-draining codec (mesh) and a sink, each device shard's
     # parity piece is routed to the per-target send queues the moment
-    # its d2h lands — the host never stages the full (m, slab) output
+    # its d2h lands — the host never stages the full (m, slab) output.
+    # The piggyback transform is window-interleaved, so its parity must
+    # merge whole slabs: no pieces.
     pieces = pipelined and sink is not None and \
-        hasattr(codec, "drain_pieces")
+        hasattr(codec, "drain_pieces") and not piggyback
     try:
-        if pipelined:
+        if piggyback:
+            batches = _window_batches(
+                _coalesce_slabs(slabs, max(slab - slab % window, window)),
+                window)
+            alpha = pplan.alpha
+
+            def pb_stream():
+                if pipelined:
+                    from ..ops.pipeline import PipelinedMatmul
+                    pm = PipelinedMatmul(
+                        pplan.emat,
+                        max_width=max(slab // alpha, window // alpha),
+                        timer=timer, codec=codec)
+                    split = ((data, ops_codec.pb_split(data, alpha, window))
+                             for _, data in batches)
+                    for orig, _sub, psub in pm.stream(split):
+                        yield orig, ops_codec.pb_merge(
+                            np.asarray(psub, dtype=np.uint8), alpha, window)
+                else:
+                    for _, data in batches:
+                        sub = ops_codec.pb_split(data, alpha, window)
+                        psub = np.asarray(
+                            codec._matmul(pplan.emat, sub), dtype=np.uint8)
+                        yield data, ops_codec.pb_merge(psub, alpha, window)
+
+            stream = ((None, data, parity) for data, parity in pb_stream())
+        elif pipelined:
             from ..ops.pipeline import PipelinedMatmul
             pm = PipelinedMatmul(codec.matrix[k:], max_width=slab,
                                  timer=timer, codec=codec, pieces=pieces)
@@ -201,7 +290,8 @@ def write_ec_files_spread(base_name: str, sink,
                           small_block: int = SMALL_BLOCK_SIZE,
                           slab: int = DEFAULT_SLAB,
                           pipelined: Optional[bool] = None,
-                          stats: Optional[dict] = None):
+                          stats: Optional[dict] = None,
+                          layout: str = "flat"):
     """Streaming encode+spread: tee write_ec_files' stripe stream into
     ``sink`` (an ec.spread.StripedSpreadSink) so each shard's slab
     ranges reach its holder while later slabs are still encoding —
@@ -226,7 +316,8 @@ def write_ec_files_spread(base_name: str, sink,
     try:
         write_ec_files(base_name, codec=codec, large_block=large_block,
                        small_block=small_block, slab=slab,
-                       pipelined=pipelined, timer=timer, sink=sink)
+                       pipelined=pipelined, timer=timer, sink=sink,
+                       layout=layout)
         sink.finish()
     except BaseException:
         sink.abort()
@@ -285,7 +376,8 @@ def rebuild_ec_files(base_name: str,
                      codec: Optional[ReedSolomonCodec] = None,
                      slab: int = DEFAULT_SLAB,
                      pipelined: Optional[bool] = None,
-                     stats: Optional[dict] = None) -> List[int]:
+                     stats: Optional[dict] = None,
+                     layout=None) -> List[int]:
     """Regenerate missing shard files from survivors. Returns the list of
     rebuilt shard ids. Raises if fewer than k survive.
 
@@ -296,11 +388,19 @@ def rebuild_ec_files(base_name: str,
     round-trip. ``stats``, when given, is filled with the dispatch
     telemetry of this rebuild (dispatches / bitmat_uploads /
     device_bytes / host_fallbacks deltas, survivor_bytes, stream_s) —
-    the bench's regression counters."""
+    the bench's regression counters.
+
+    ``layout``: an ec.layout.LayoutInfo (or None for flat). Piggyback
+    volumes decode through ops/codec.piggyback_decode_plan — the same
+    one-fused-dispatch-per-slab stream, with each survivor slab split
+    into sub-chunk rows per window before the matmul and each rebuilt
+    slab merged back before the write."""
+    from ..ops import codec as ops_codec
     codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
     k, total = codec.k, codec.total
     if pipelined is None:
         pipelined = codec.backend in ("tpu", "mesh")
+    piggyback = layout is not None and getattr(layout, "piggyback", False)
     present = [os.path.exists(base_name + to_ext(i)) for i in range(total)]
     missing = [i for i, p in enumerate(present) if not p]
     if not missing:
@@ -316,6 +416,10 @@ def rebuild_ec_files(base_name: str,
                 shard_size = sz
             elif shard_size != sz:
                 raise ValueError("surviving shards differ in size")
+    if piggyback:
+        return _rebuild_ec_files_piggyback(
+            base_name, codec, layout, present, missing, shard_size,
+            slab, stats)
     ins = [open(base_name + to_ext(i), "rb") if present[i] else None
            for i in range(total)]
     outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
@@ -409,6 +513,174 @@ def rebuild_ec_files(base_name: str,
         stats["backend"] = codec.backend
         stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
     return missing
+
+
+def _pb_slab(slab: int, window: int) -> int:
+    """Clamp a slab size to whole windows (never below one window) so
+    every stripe of a piggyback stream stays window-aligned."""
+    return max(window, slab - slab % window)
+
+
+def _rebuild_ec_files_piggyback(base_name, codec, layout, present,
+                                missing, shard_size, slab, stats
+                                ) -> List[int]:
+    """Local piggyback rebuild: decode every missing shard (data AND
+    parity) from the coupled decode plan's source set in one fused
+    matmul per slab. Shard sizes are window-aligned by construction
+    (both stripe blocks divide by the window), so slabs clamp to whole
+    windows with no tail special-case."""
+    import time as _time
+    from ..ops import codec as ops_codec
+    from ..ops import telemetry
+    k = codec.k
+    alpha, window = layout.alpha, layout.window
+    if shard_size % window:
+        raise ValueError(
+            f"piggyback shard size {shard_size} not window-aligned "
+            f"({window}); sidecar geometry is wrong for these shards")
+    src, plan_missing, coeffs = ops_codec.piggyback_decode_plan(
+        codec.k, codec.m, tuple(bool(p) for p in present),
+        matrix_kind=getattr(codec, "matrix_kind", "vandermonde"),
+        matrix=getattr(codec, "matrix", None),
+        pairs=layout.pairs)
+    rows = [plan_missing.index(i) for i in missing]
+    eff_slab = _pb_slab(slab, window)
+    before = telemetry.STATS.snapshot()
+    phases = {"gather": 0.0, "plan": 0.0, "dispatch": 0.0,
+              "drain": 0.0, "write": 0.0}
+    ins = {i: open(base_name + to_ext(i), "rb") for i in src}
+    outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
+    t_stream = _time.perf_counter()
+    try:
+        for off in range(0, shard_size, eff_slab):
+            n = min(eff_slab, shard_size - off)
+            t0 = _time.perf_counter()
+            stack = []
+            for i in src:
+                ins[i].seek(off)
+                stack.append(np.frombuffer(ins[i].read(n), dtype=np.uint8))
+            block = np.stack(stack, axis=0)
+            t1 = _time.perf_counter()
+            sub = ops_codec.pb_split(block, alpha, window)
+            out = np.asarray(codec._matmul(coeffs, sub), dtype=np.uint8)
+            merged = ops_codec.pb_merge(out, alpha, window)
+            t2 = _time.perf_counter()
+            for r, i in zip(rows, missing):
+                outs[i].write(merged[r].tobytes())
+            t3 = _time.perf_counter()
+            phases["gather"] += t1 - t0
+            phases["dispatch"] += t2 - t1
+            phases["write"] += t3 - t2
+    finally:
+        for h in ins.values():
+            h.close()
+        for h in outs.values():
+            h.close()
+    stream_s = _time.perf_counter() - t_stream
+    for name, secs in phases.items():
+        if secs > 0:
+            tracing.record_span(name, secs, op="ec.rebuild",
+                                backend=codec.backend, layout="piggyback")
+    if stats is not None:
+        stats.update(telemetry.delta(before))
+        stats["survivor_bytes"] = shard_size * len(src)
+        stats["rebuilt_bytes"] = shard_size * len(missing)
+        stats["stream_s"] = round(stream_s, 3)
+        stats["backend"] = codec.backend
+        stats["layout"] = "piggyback"
+        stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
+    return list(missing)
+
+
+def rebuild_ec_files_streaming_piggyback(base_name: str,
+                                         present: List[bool],
+                                         missing: List[int],
+                                         source,
+                                         layout,
+                                         codec: Optional[
+                                             ReedSolomonCodec] = None,
+                                         slab: int = DEFAULT_SLAB,
+                                         stats: Optional[dict] = None
+                                         ) -> List[int]:
+    """Streaming full decode for a piggyback volume: ``source`` yields
+    survivor stripes whose ROWS ARE THE DECODE PLAN'S src ORDER (every
+    surviving data shard, then the plan's parity picks — the caller
+    builds readers from piggyback_decode_plan's src list, not first-k).
+    Each stripe is window-split, pushed through the fused coupled
+    decode, merged, and appended to the missing shard files. Failure
+    removes partial outputs, same contract as the flat streaming
+    rebuild."""
+    import time as _time
+    from ..ops import codec as ops_codec
+    from ..ops import telemetry
+    codec = codec or get_codec(DATA_SHARDS, PARITY_SHARDS)
+    if not missing:
+        return []
+    alpha, window = layout.alpha, layout.window
+    before = telemetry.STATS.snapshot()
+    phases = {"gather": 0.0, "plan": 0.0, "dispatch": 0.0,
+              "drain": 0.0, "write": 0.0}
+    t0 = _time.perf_counter()
+    src, plan_missing, coeffs = ops_codec.piggyback_decode_plan(
+        codec.k, codec.m, tuple(bool(p) for p in present),
+        matrix_kind=getattr(codec, "matrix_kind", "vandermonde"),
+        matrix=getattr(codec, "matrix", None),
+        pairs=layout.pairs)
+    rows = [plan_missing.index(i) for i in missing]
+    phases["plan"] = _time.perf_counter() - t0
+    outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
+    rebuilt_bytes = 0
+    t_stream = _time.perf_counter()
+    try:
+        it = source.slabs()
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                _, block = next(it)
+            except StopIteration:
+                break
+            t1 = _time.perf_counter()
+            sub = ops_codec.pb_split(block, alpha, window)
+            out = np.asarray(codec._matmul(coeffs, sub), dtype=np.uint8)
+            merged = ops_codec.pb_merge(out, alpha, window)
+            t2 = _time.perf_counter()
+            for r, i in zip(rows, missing):
+                outs[i].write(merged[r].tobytes())
+                rebuilt_bytes += merged.shape[1]
+            t3 = _time.perf_counter()
+            phases["gather"] += t1 - t0
+            phases["dispatch"] += t2 - t1
+            phases["write"] += t3 - t2
+    except BaseException:
+        for i, h in outs.items():
+            h.close()
+            try:
+                os.remove(base_name + to_ext(i))
+            except OSError:
+                pass
+        raise
+    finally:
+        for h in outs.values():
+            h.close()
+    stream_s = _time.perf_counter() - t_stream
+    for name, secs in phases.items():
+        if secs > 0:
+            tracing.record_span(name, secs, op="ec.rebuild",
+                                backend=codec.backend, streaming=True,
+                                layout="piggyback")
+    if stats is not None:
+        gs = source.stats
+        stats.update(telemetry.delta(before))
+        stats.update(gs.snapshot())
+        stats["survivor_bytes"] = source.shard_size * len(src)
+        stats["rebuilt_bytes"] = rebuilt_bytes
+        stats["stream_s"] = round(stream_s, 3)
+        stats["backend"] = codec.backend
+        stats["layout"] = "piggyback"
+        stats["phases"] = {n: round(s, 6) for n, s in phases.items()}
+        stats["gather_mbps"] = round(gs.mbps(), 1)
+        stats["gather_remote_shards"] = gs.remote_shards
+    return list(missing)
 
 
 def rebuild_ec_files_streaming(base_name: str,

@@ -211,6 +211,73 @@ class LocalRepairReader:
         return planes.tobytes()
 
 
+class RemotePlaneReader(RemoteShardReader):
+    """Half-plane reads for piggyback repair: asks the holder to apply
+    the sub-chunk plane selection server-side (ops/codec.pb_plane_slice)
+    and ship only the lost shard's repair plane — ``n/2`` bytes for an
+    n-byte window-aligned range. Rotation, failover and hedging come
+    from the shared transport reader."""
+
+    _method = "POST"
+    _health_kind = "plane_read"
+
+    def __init__(self, vid: int, sid: int, holders: Sequence[str],
+                 alpha: int, window: int, plane_bit: int, plane_side: int,
+                 stats: Optional[TransportStats] = None,
+                 timeout: float = 300.0,
+                 hedge_ms: Optional[float] = None):
+        super().__init__(vid, sid, holders, stats=stats, timeout=timeout,
+                         hedge_ms=hedge_ms)
+        self.alpha = int(alpha)
+        self.window = int(window)
+        self.plane_bit = int(plane_bit)
+        self.plane_side = int(plane_side)
+
+    def _url(self, holder: str, off: int, n: int) -> str:
+        return (f"http://{holder}/admin/ec/shard_plane_read"
+                f"?volume={self.vid}&shard={self.sid}"
+                f"&offset={off}&size={n}&alpha={self.alpha}"
+                f"&window={self.window}&bit={self.plane_bit}"
+                f"&side={self.plane_side}")
+
+    def _expect_len(self, n: int) -> int:
+        return n // 2
+
+
+class LocalPlaneReader:
+    """Plane slice of a helper shard already on the rebuilder's disk:
+    read the window-aligned range locally, slice the repair plane, and
+    account only the plane bytes (the range never crossed the
+    network)."""
+
+    remote = False
+
+    def __init__(self, path: str, alpha: int, window: int,
+                 plane_bit: int, plane_side: int,
+                 stats: Optional[TransportStats] = None):
+        self.path = path
+        self.alpha = int(alpha)
+        self.window = int(window)
+        self.plane_bit = int(plane_bit)
+        self.plane_side = int(plane_side)
+        self.stats = stats or GatherStats()
+
+    def read(self, off: int, n: int, stripe_idx: int = 0) -> bytes:
+        from ..ops.codec import pb_plane_slice
+        t0 = time.perf_counter()
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            data = f.read(n)
+        if len(data) != n:
+            raise IOError(f"short read of {self.path} at {off}: "
+                          f"{len(data)} < {n}")
+        plane = pb_plane_slice(np.frombuffer(data, dtype=np.uint8),
+                               self.alpha, self.window,
+                               self.plane_bit, self.plane_side)
+        self.stats.add_fetch(plane.nbytes, t0, time.perf_counter())
+        return plane.tobytes()
+
+
 def fetch_index_files(base_name: str, holders: Sequence[str],
                       timeout: float = 300.0) -> List[str]:
     """Pull the small index sidecars onto the rebuilder: .ecx required
@@ -283,5 +350,46 @@ class RepairGatherSource(StripedPull):
     def _assemble(self, bufs: List[bytes], w: int) -> np.ndarray:
         stride = (w + 7) // 8
         rows = [np.frombuffer(b, dtype=np.uint8).reshape(-1, stride)
+                for b in bufs]
+        return np.concatenate(rows, axis=0)
+
+
+class PlaneGatherSource(StripedPull):
+    """Piggyback-repair plane stream: the readers are one plane reader
+    per plan helper (``ops/codec.PiggybackRepairPlan.helpers`` order —
+    k-1 data shards then the 2 parities), each returning its half-plane
+    bytes for the stripe range. ``slabs()`` yields
+    ``(meta, ((k+1)*alpha/2, w/alpha) uint8)`` blocks — the restacked
+    plane rows in plan column order, ready for the fused repair matmul.
+    Stripes are clamped to sub-chunk windows so every holder-side slice
+    and rebuilder-side restack is window-local."""
+
+    def __init__(self, readers: Sequence, shard_size: int, plan,
+                 window: int, slab: int = 8 << 20,
+                 gather_window: Optional[int] = None,
+                 stats: Optional[TransportStats] = None,
+                 parent_span=None):
+        if len(readers) != len(plan.helpers):
+            raise ValueError(
+                f"need one reader per helper: {len(readers)} != "
+                f"{len(plan.helpers)}")
+        if shard_size % window:
+            raise ValueError(
+                f"piggyback shard size {shard_size} not aligned to "
+                f"window {window}")
+        slab = max(window, slab - slab % window)
+        super().__init__(readers, shard_size, slab=slab,
+                         window=gather_window, stats=stats,
+                         parent_span=parent_span)
+        self.plan = plan
+        self.pb_window = int(window)
+
+    def _stripe_nbytes(self, w: int) -> int:
+        return len(self.readers) * (w // 2)
+
+    def _assemble(self, bufs: List[bytes], w: int) -> np.ndarray:
+        from ..ops.codec import pb_plane_rows
+        rows = [pb_plane_rows(np.frombuffer(b, dtype=np.uint8),
+                              self.plan.alpha, self.pb_window)
                 for b in bufs]
         return np.concatenate(rows, axis=0)

@@ -231,8 +231,27 @@ class ScrubEngine:
             return {"volume": vid, "skipped": "not_owner"}
 
         codec = self.codec()
-        h = codec.syndrome_plan()
-        total = h.shape[1]
+        # the volume's layout picks the parity-check rows: flat volumes
+        # verify H·x=0 over raw shard bytes, piggyback volumes over the
+        # sub-chunk rows ([E|I] from the coupled plan) of window-split
+        # slabs — same fused dispatch, different basis
+        li = self._layout(ev)
+        alpha = wnd = None
+        slab_eff = self.slab
+        if li.piggyback:
+            from ..ops import codec as ops_codec
+            pplan = ops_codec.piggyback_plan(
+                codec.k, codec.m,
+                matrix_kind=getattr(codec, "matrix_kind", "vandermonde"),
+                matrix=getattr(codec, "matrix", None),
+                pairs=li.pairs)
+            h = pplan.syndrome_rows()
+            total = codec.total
+            alpha, wnd = li.alpha, li.window
+            slab_eff = max(wnd, self.slab - self.slab % wnd)
+        else:
+            h = codec.syndrome_plan()
+            total = h.shape[1]
         gstats = GatherStats()
         readers, missing = self._readers(vid, local, total, gstats)
         if missing:
@@ -244,7 +263,14 @@ class ScrubEngine:
                     "missing": missing}
 
         shard_size = max(s.size for s in local.values())
-        n_slabs = (shard_size + self.slab - 1) // self.slab
+        if li.piggyback and shard_size % wnd:
+            # sidecar geometry disagrees with the shard bytes: a split
+            # would misattribute every column, so surface it instead
+            self._set_volume_state(vid, skipped="bad_geometry",
+                                   window=wnd, shard_size=shard_size)
+            return {"volume": vid, "skipped": "bad_geometry",
+                    "window": wnd, "shard_size": shard_size}
+        n_slabs = (shard_size + slab_eff - 1) // slab_eff
         corrupt_slabs: List[int] = []
         corrupt_shards: set = set()
         corrupt_columns = 0
@@ -255,7 +281,7 @@ class ScrubEngine:
 
         from ..ops.codec import dispatch_threshold, host_matmul
         thr = dispatch_threshold(codec)
-        use_device = bool(thr) and self.slab >= thr
+        use_device = bool(thr) and slab_eff >= thr
 
         def slabs():
             nonlocal pass_bytes
@@ -263,8 +289,8 @@ class ScrubEngine:
                 for idx in range(n_slabs):
                     if self._stop.is_set():
                         return
-                    off = idx * self.slab
-                    w = min(self.slab, shard_size - off)
+                    off = idx * slab_eff
+                    w = min(slab_eff, shard_size - off)
                     g0 = time.perf_counter()
                     futs = [pool.submit(readers[s].read, off, w, idx)
                             for s in range(total)]
@@ -274,6 +300,9 @@ class ScrubEngine:
                     block = np.stack(rows, axis=0)
                     pass_bytes += block.nbytes
                     self._pace(t0, pass_bytes)
+                    if li.piggyback:
+                        from ..ops.codec import pb_split
+                        block = pb_split(block, alpha, wnd)
                     yield (idx, off, w), np.ascontiguousarray(block)
 
         def check(meta, out):
@@ -291,14 +320,18 @@ class ScrubEngine:
                 self._c["corrupt_slabs"] += 1
                 self._c["corrupt_columns"] += int(bad.size)
             for col in bad[:_LOCATE_SAMPLE]:
-                corrupt_shards.add(locate_corrupt_shard(h, out[:, col]))
+                c = locate_corrupt_shard(h, out[:, col])
+                # piggyback columns live in sub-chunk space: alpha
+                # consecutive columns per shard
+                corrupt_shards.add(
+                    c // alpha if li.piggyback and c >= 0 else c)
 
         with tracing.span("ec.scrub", volume=vid, shards=len(local_sids),
-                          slab=self.slab,
+                          slab=slab_eff, layout=li.layout,
                           path="device" if use_device else "host") as root:
             if use_device:
                 from ..ops.pipeline import PipelinedMatmul
-                pm = PipelinedMatmul(h, max_width=max(self.slab, 1 << 20),
+                pm = PipelinedMatmul(h, max_width=max(slab_eff, 1 << 20),
                                      codec=codec)
                 for meta, _data, out in pm.stream(slabs()):
                     d0 = time.perf_counter()
@@ -348,6 +381,16 @@ class ScrubEngine:
                 "slabs": corrupt_slabs, "columns": corrupt_columns,
                 "source": self.self_url(), "detected_at": now})
         return res
+
+    def _layout(self, ev):
+        """The volume's on-disk layout, resolved from its local
+        sidecars (ec/layout.volume_layout)."""
+        from ..storage.types import entry_size
+        from .layout import volume_layout
+        codec = self.codec()
+        width = getattr(ev, "offset_width", None) or 4
+        return volume_layout(ev.base_name, codec.k,
+                             record_size=entry_size(width))
 
     def _readers(self, vid: int, local: Dict[int, object], total: int,
                  gstats: GatherStats) -> Tuple[list, List[int]]:

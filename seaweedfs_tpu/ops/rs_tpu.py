@@ -39,6 +39,13 @@ from ..util import config
 #: tunable and visible in ec_xla_jit_cache_total.
 _JIT_CACHE_SIZE = config.env_int("SW_EC_JIT_CACHE_SIZE")
 
+#: trace-size crossover for _packed_fn: matrices with r*8*nw at or
+#: below this unroll fully (constant indices, ms traces); above it the
+#: rolled lax.scan form keeps the graph O(1) in the matrix dims (the
+#: piggyback emat would otherwise unroll to ~10^5 ops and stall XLA
+#: CPU compilation for minutes).
+_PACKED_UNROLL_LIMIT = 4096
+
 
 def _jax():
     import jax
@@ -92,28 +99,61 @@ def _packed_fn(k: int, r: int, n: int):
     jax, jnp = _jax()
     nw = (k * 8 + 31) // 32
 
-    def fn(bmp, data):
-        d32 = data.astype(jnp.uint32)
-        words = []
-        for wi in range(nw):
-            acc = jnp.zeros((n,), jnp.uint32)
-            for b in range(4):
-                j = wi * 4 + b
-                if j < k:
-                    acc = acc | (d32[j] << (8 * b))
-            words.append(acc)
-        outs = []
-        for i in range(r):
-            byte = jnp.zeros((n,), jnp.uint32)
-            for bit in range(8):
-                col = i * 8 + bit
-                ones = jnp.zeros((n,), jnp.uint32)
-                for wi in range(nw):
-                    ones = ones + jax.lax.population_count(
-                        words[wi] & bmp[wi, col])
-                byte = byte | ((ones & 1) << bit)
-            outs.append(byte.astype(jnp.uint8))
-        return jnp.stack(outs)
+    if r * 8 * nw <= _PACKED_UNROLL_LIMIT:
+        # flat-geometry matrices (parity rows, decode coeffs, repair
+        # rows: r*8*nw in the hundreds): full unroll traces in
+        # milliseconds and lets XLA see every constant index
+        def fn(bmp, data):
+            d32 = data.astype(jnp.uint32)
+            words = []
+            for wi in range(nw):
+                acc = jnp.zeros((n,), jnp.uint32)
+                for b in range(4):
+                    j = wi * 4 + b
+                    if j < k:
+                        acc = acc | (d32[j] << (8 * b))
+                words.append(acc)
+            outs = []
+            for i in range(r):
+                byte = jnp.zeros((n,), jnp.uint32)
+                for bit in range(8):
+                    col = i * 8 + bit
+                    ones = jnp.zeros((n,), jnp.uint32)
+                    for wi in range(nw):
+                        ones = ones + jax.lax.population_count(
+                            words[wi] & bmp[wi, col])
+                    byte = byte | ((ones & 1) << bit)
+                outs.append(byte.astype(jnp.uint8))
+            return jnp.stack(outs)
+    else:
+        # sub-chunk matrices (the piggyback emat is (m*alpha, k*alpha):
+        # r*8*nw ~ 10^5) would make the unrolled trace an XLA compile
+        # bomb — tens of minutes on CPU. Same math, rolled: lax.scan
+        # over output bytes keeps the graph O(1) in r and k, and the
+        # per-step live set at nw*n words.
+        def fn(bmp, data):
+            d32 = data.astype(jnp.uint32)
+            pad = nw * 4 - k
+            if pad:
+                d32 = jnp.concatenate(
+                    [d32, jnp.zeros((pad, n), jnp.uint32)])
+            lanes = d32.reshape(nw, 4, n)
+            words = (lanes[:, 0] | (lanes[:, 1] << 8)
+                     | (lanes[:, 2] << 16) | (lanes[:, 3] << 24))
+
+            def row(carry, cols):  # cols: (8, nw) one output byte
+                byte = jnp.zeros((n,), jnp.uint32)
+                for bit in range(8):
+                    ones = jax.lax.population_count(
+                        words & cols[bit][:, None]).sum(axis=0)
+                    byte = byte | ((ones & 1) << bit)
+                return carry, byte.astype(jnp.uint8)
+
+            # bmp is (nw, r*8) with column i*8+bit; transpose/reshape
+            # regroups it as (r, 8, nw) scan steps
+            _, out = jax.lax.scan(
+                row, None, bmp.T.reshape(r, 8, nw))
+            return out
 
     return device_stats.wrap(jax.jit(fn), "rs_tpu._packed_fn")
 

@@ -67,6 +67,8 @@ class VolumeServer:
         router.add("GET", "/admin/ec/shard_read", self.admin_ec_shard_read)
         router.add("POST", "/admin/ec/shard_repair_read",
                    self.admin_ec_shard_repair_read)
+        router.add("POST", "/admin/ec/shard_plane_read",
+                   self.admin_ec_shard_plane_read)
         router.add("POST", "/admin/ec/scrub", self.admin_ec_scrub)
         router.add("GET", "/admin/ec/scrub_status",
                    self.admin_ec_scrub_status)
@@ -674,6 +676,10 @@ class VolumeServer:
         observe_device_stats(_ds.DEVICE_STATS.snapshot(),
                              _ds.jit_factory_snapshot(),
                              _ds.device_inventory())
+        # EC plan caches (repair/piggyback schemes, process-global
+        # LRUs in ops/codec) — same monotonic mirror pattern
+        from ..stats.metrics import observe_plan_cache
+        observe_plan_cache()
         # degraded-read engine counters (engine-global, same mirror
         # pattern; the per-read latency histogram streams in live via
         # the engine's on_read hook)
@@ -1321,6 +1327,54 @@ class VolumeServer:
             headers={
                 "X-Repair-Planes": str(planes.shape[0]),
                 "X-Repair-Stride": str(planes.shape[1]),
+            })
+
+    def admin_ec_shard_plane_read(self, req: Request):
+        """Half-plane shard read for piggyback repair: read the
+        window-aligned ``offset``/``size`` range of a local shard and
+        return only the sub-chunks of the caller's repair plane
+        (ops/codec.pb_plane_slice) — ``size/2`` bytes. This is where
+        the (k+1)/2k byte reduction happens: the full range is read off
+        disk but only half of it leaves the holder."""
+        from ..ops import codec as ops_codec
+        vid = int(req.query["volume"])
+        sid = int(req.query["shard"])
+        ev = self.store.find_ec_volume(vid)
+        if ev is None or sid not in ev.shards:
+            raise HttpError(404, f"shard {vid}.{sid} not here")
+        shard = ev.shards[sid]
+        try:
+            offset = int(req.query.get("offset", 0))
+            size = int(req.query["size"])
+            alpha = int(req.query["alpha"])
+            window = int(req.query["window"])
+            bit = int(req.query["bit"])
+            side = int(req.query["side"])
+        except (KeyError, ValueError):
+            raise HttpError(
+                400, "need offset/size/alpha/window/bit/side query params")
+        if offset < 0 or size <= 0:
+            raise HttpError(400, f"bad range {offset}+{size}")
+        if alpha < 2 or alpha & (alpha - 1) or window % alpha:
+            raise HttpError(
+                400, f"bad sub-chunk geometry alpha={alpha} "
+                     f"window={window}")
+        if not (0 <= bit < alpha.bit_length() - 1) or side not in (0, 1):
+            raise HttpError(400, f"bad plane bit={bit} side={side}")
+        if offset % window or size % window:
+            raise HttpError(
+                400, f"range {offset}+{size} not aligned to "
+                     f"window {window}")
+        if offset + size > shard.size:
+            raise HttpError(
+                416, f"range {offset}+{size} beyond shard size {shard.size}")
+        data = np.frombuffer(shard.read_at(offset, size), dtype=np.uint8)
+        plane = ops_codec.pb_plane_slice(data, alpha, window, bit, side)
+        return Response(
+            plane.tobytes(),
+            headers={
+                "X-Plane-Alpha": str(alpha),
+                "X-Plane-Window": str(window),
             })
 
     def admin_tier_upload(self, req: Request):

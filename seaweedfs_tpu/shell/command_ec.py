@@ -317,11 +317,13 @@ def _encode_spread_copy(env: CommandEnv, vid: int, collection: str,
 
 @command("ec.rebuild",
          "[-collection <name>] [-mode stream|copy] "
-         "[-repair auto|trace|full] : regenerate missing shards "
-         "(stream = ranged survivor gather overlapped with the decode; "
-         "copy = legacy whole-shard copies; repair = single-shard "
-         "strategy — trace ships projected sub-shard symbols from all "
-         "survivors, full pulls k whole ranges, auto picks)")
+         "[-repair auto|trace|piggyback|full] : regenerate missing "
+         "shards (stream = ranged survivor gather overlapped with the "
+         "decode; copy = legacy whole-shard copies; repair = "
+         "single-shard strategy — trace ships projected sub-shard "
+         "symbols from all survivors on flat volumes, piggyback ships "
+         "half-shard planes on piggyback-layout volumes, full pulls k "
+         "whole ranges, auto picks by the volume's layout)")
 def ec_rebuild(env: CommandEnv, args: List[str]):
     flags = parse_flags(args)
     for vid_s, info in env.ec_volumes().items():
@@ -378,9 +380,12 @@ def do_ec_rebuild(env: CommandEnv, vid: int, collection: str,
     streaming endpoint.
 
     repair: "auto" (default; `SW_EC_REPAIR_MODE` overrides) lets the
-    rebuilder use trace repair — projected sub-shard symbols from all
-    survivors — when exactly one shard is lost; "trace" forces it,
-    "full" forces the k-survivor gather. Stream mode only."""
+    rebuilder pick the cheapest single-shard strategy for the volume's
+    layout — trace repair (projected sub-shard symbols from all
+    survivors) on flat volumes, plane repair (half-shard planes from
+    k+1 helpers) on piggyback volumes. "trace"/"piggyback" force the
+    matching strategy and error on the other layout; "full" forces the
+    k-survivor gather on either. Stream mode only."""
     from ..util import config as _config
     from ..util import tracing
     mode = (mode or _config.env_str("SW_EC_GATHER_MODE") or
@@ -426,8 +431,9 @@ def _rebuild_streaming(env: CommandEnv, vid: int, collection: str,
                        repair: str = "auto") -> List[int]:
     """One POST: the rebuilder pulls slab-aligned survivor ranges from
     the holder map and feeds them straight into the pipelined decode
-    (or, single-shard loss with ``repair`` auto/trace, pulls projected
-    repair symbols from ALL survivors)."""
+    (or, single-shard loss with ``repair`` auto/trace/piggyback, pulls
+    projected repair symbols or half-shard planes from the helpers the
+    volume's layout prescribes)."""
     import time as _time
     sources = {str(sid): urls for sid, urls in shards.items()
                if rebuilder not in urls}

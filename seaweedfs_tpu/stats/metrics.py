@@ -829,15 +829,45 @@ VOLUME_EC_REPAIR_SYMBOL_BITS = VOLUME_SERVER_GATHER.counter(
     labels=("bits",))
 
 
+# -- piggyback plane repair (ec/decoder.rebuild_ec_file_piggyback) -----------
+
+VOLUME_EC_PIGGYBACK_COUNTER = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_piggyback_total",
+    "Piggyback-layout plane repair events by kind (plane_rebuilds, "
+    "plane_bytes, baseline_bytes).",
+    labels=("kind",))
+VOLUME_EC_PIGGYBACK_BYTES_FRAC_GAUGE = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_piggyback_bytes_frac",
+    "Repair traffic of the last piggyback plane repair as a fraction "
+    "of the k*shard baseline the full gather would move (the coupled "
+    "layout's floor is (k+1)/(2k); lower is better).")
+
+
 def observe_repair(stats: Dict):
     """Export one rebuild's repair-mode stats (the dict filled by
-    ec.decoder.rebuild_ec_file_repair, or the fallback markers left by
-    storage/store) onto the volume registry."""
+    ec.decoder.rebuild_ec_file_repair / rebuild_ec_file_piggyback, or
+    the fallback markers left by storage/store) onto the volume
+    registry."""
     if not stats or "repair_mode" not in stats:
         return
     if stats.get("repair_fallback"):
         VOLUME_EC_REPAIR_COUNTER.inc("fallbacks")
-    if stats["repair_mode"] != "trace":
+    mode = stats["repair_mode"]
+    if mode == "piggyback":
+        VOLUME_EC_PIGGYBACK_COUNTER.inc("plane_rebuilds")
+        for kind, key in (("plane_bytes", "repair_bytes"),
+                          ("baseline_bytes", "repair_baseline_bytes")):
+            n = stats.get(key)
+            if n:
+                VOLUME_EC_PIGGYBACK_COUNTER.inc(kind, amount=n)
+        busy = stats.get("gather_busy_s")
+        if busy:
+            VOLUME_EC_REPAIR_SECONDS.inc(amount=busy)
+        if "repair_bytes_frac" in stats:
+            VOLUME_EC_PIGGYBACK_BYTES_FRAC_GAUGE.set(
+                stats["repair_bytes_frac"])
+        return
+    if mode != "trace":
         VOLUME_EC_REPAIR_COUNTER.inc("full_rebuilds")
         return
     VOLUME_EC_REPAIR_COUNTER.inc("trace_rebuilds")
@@ -853,6 +883,34 @@ def observe_repair(stats: Dict):
         VOLUME_EC_REPAIR_BYTES_FRAC_GAUGE.set(stats["repair_bytes_frac"])
     for bits in (stats.get("repair_bits") or {}).values():
         VOLUME_EC_REPAIR_SYMBOL_BITS.inc(str(bits), amount=bits)
+
+
+# -- EC plan caches (ops/codec plan_cache_stats via observe_plan_cache) ------
+
+VOLUME_EC_PLAN_CACHE_EVENTS = VOLUME_SERVER_GATHER.counter(
+    "SeaweedFS_volumeServer_ec_plan_cache_events_total",
+    "Cumulative LRU events across the repair/piggyback plan caches "
+    "(hits, misses, evictions). SW_EC_PLAN_CACHE_SIZE bounds each "
+    "cache.",
+    labels=("event",))
+VOLUME_EC_PLAN_CACHE_ENTRIES = VOLUME_SERVER_GATHER.gauge(
+    "SeaweedFS_volumeServer_ec_plan_cache_entries",
+    "Current entry count per plan cache (repair, piggyback, "
+    "piggyback_repair, piggyback_decode).",
+    labels=("cache",))
+
+
+def observe_plan_cache(snap: Dict = None):
+    """Mirror the codec plan-cache snapshot onto the volume registry
+    (process-global monotonic events -> set_total, entry counts ->
+    gauge). Called on scrape; pass a snapshot to override (tests)."""
+    if snap is None:
+        from ..ops.codec import plan_cache_stats
+        snap = plan_cache_stats()
+    for event, total in (snap.get("events") or {}).items():
+        VOLUME_EC_PLAN_CACHE_EVENTS.set_total(total, event)
+    for cache, n in (snap.get("entries") or {}).items():
+        VOLUME_EC_PLAN_CACHE_ENTRIES.set(n, cache)
 
 
 # -- streaming spread (ec/spread.py via observe_spread) ----------------------

@@ -144,25 +144,79 @@ class Store:
         return v.delete_needle(n)
 
     # -- EC lifecycle (reference volume_grpc_erasure_coding.go) ------------
+    def _encode_layout(self):
+        """(layout name, plan, window) for NEW ec volumes, from
+        SW_EC_LAYOUT. Unsupported geometries (m < 2) raise rather than
+        silently downgrading an operator's explicit piggyback choice."""
+        from ..ec import layout as ec_layout
+        from ..ec.constants import (LARGE_BLOCK_SIZE, PARITY_SHARDS,
+                                    SMALL_BLOCK_SIZE)
+        from ..ops import codec as ops_codec
+        from ..util import config as _config
+        name = (_config.env_str("SW_EC_LAYOUT") or
+                ec_layout.LAYOUT_FLAT).lower()
+        if name == ec_layout.LAYOUT_FLAT:
+            return ec_layout.LAYOUT_FLAT, None, None
+        if name != ec_layout.LAYOUT_PIGGYBACK:
+            raise VolumeError(f"unknown SW_EC_LAYOUT {name!r}")
+        k = self.codec.k if self.codec is not None else DATA_SHARDS
+        m = (self.codec.m if self.codec is not None else PARITY_SHARDS)
+        if not ops_codec.piggyback_supported(k, m):
+            raise VolumeError(
+                f"SW_EC_LAYOUT=piggyback unsupported for RS({k},{m})")
+        from ..ops.codec import get_codec
+        codec = self.codec or get_codec(k, m)
+        pplan, window = ec_encoder.piggyback_geometry(
+            codec, None, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE)
+        return ec_layout.LAYOUT_PIGGYBACK, pplan, window
+
+    def _volume_layout(self, base):
+        """Resolve an existing volume's on-disk layout from its
+        sidecars (ec/layout.volume_layout): the routing predicate for
+        every layout-sensitive path below."""
+        from ..ec import layout as ec_layout
+        from .types import entry_size
+        k = self.codec.k if self.codec is not None else DATA_SHARDS
+        try:
+            width = ec_offset_width(base)
+        except Exception:  # noqa: BLE001 - no sidecars at all: flat
+            width = 4
+        return ec_layout.volume_layout(base, k,
+                                       record_size=entry_size(width))
+
+    def _write_layout_sidecars(self, base, v, layout, pplan, window):
+        """Record the volume metadata AND layout in one .vif/.ecx-tag
+        write (ec/layout). offset_width must ride along: a shard
+        receiver holding only parity shards has no .ec00 superblock to
+        infer the .ecx record width from."""
+        from ..ec import layout as ec_layout
+        from .types import entry_size
+        ec_layout.write_layout_sidecars(
+            base, layout,
+            window=window,
+            pairs=(pplan.npairs if pplan is not None else None),
+            record_size=entry_size(v.offset_width),
+            version=v.version, offset_width=v.offset_width)
+
     def generate_ec_shards(self, vid: int, collection: str = "") -> str:
-        """Volume .dat/.idx -> .ec00-13 + .ecx + .vif on the same disk."""
+        """Volume .dat/.idx -> .ec00-13 + .ecx + .vif on the same disk.
+        SW_EC_LAYOUT picks the parity layout for the new shards; the
+        choice is stamped into the sidecars so every later reader
+        (scrub, degraded reads, rebuild) routes by the volume, not the
+        environment."""
         v = self.find_volume(vid)
         if v is None:
             raise VolumeError(f"volume {vid} not found")
         if not v.readonly:
             raise VolumeError(f"volume {vid} must be readonly for ec encode")
         base = v.file_name()
+        layout, pplan, window = self._encode_layout()
         from ..util import tracing
-        with tracing.span("ec.encode.local", volume=vid):
+        with tracing.span("ec.encode.local", volume=vid, layout=layout):
             ec_encoder.write_sorted_file_from_idx(base)
-            ec_encoder.write_ec_files(base, codec=self.codec)
-        import json
-        with open(base + ".vif", "w") as f:
-            # offset_width must ride along: a shard receiver holding only
-            # parity shards has no .ec00 superblock to infer the .ecx
-            # record width from
-            json.dump({"version": v.version,
-                       "offset_width": v.offset_width}, f)
+            ec_encoder.write_ec_files(base, codec=self.codec,
+                                      layout=layout)
+        self._write_layout_sidecars(base, v, layout, pplan, window)
         return base
 
     def generate_ec_shards_streaming(self, vid: int, collection: str = "",
@@ -203,7 +257,9 @@ class Store:
         from ..ec.gather import auto_slab
         slab = auto_slab(ec_encoder.ec_shard_base_size(
             os.path.getsize(base + ".dat")))
-        with tracing.span("ec.encode.stream", volume=vid) as root:
+        layout, pplan, pb_window = self._encode_layout()
+        with tracing.span("ec.encode.stream", volume=vid,
+                          layout=layout) as root:
             ec_encoder.write_sorted_file_from_idx(base)
             sink = spread.StripedSpreadSink(
                 vid, base, assignment, total, collection=collection,
@@ -212,7 +268,8 @@ class Store:
                 rate_mbps=rate_mbps)
             try:
                 ec_encoder.write_ec_files_spread(
-                    base, sink, codec=self.codec, slab=slab, stats=stats)
+                    base, sink, codec=self.codec, slab=slab, stats=stats,
+                    layout=layout)
             except BaseException:
                 # the sink already aborted every holder's stage; drop
                 # anything the local fast path finalized plus the index
@@ -227,10 +284,7 @@ class Store:
                 except OSError:
                     pass
                 raise
-            import json
-            with open(base + ".vif", "w") as f:
-                json.dump({"version": v.version,
-                           "offset_width": v.offset_width}, f)
+            self._write_layout_sidecars(base, v, layout, pplan, pb_window)
         observe_transport("push", sstats, window=sink.window)
         return base, sink.assignment()
 
@@ -292,9 +346,12 @@ class Store:
         for loc in self.locations:
             base = volume_file_prefix(loc.directory, collection, vid)
             if os.path.exists(base + ".ecx"):
-                with tracing.span("ec.rebuild.local", volume=vid):
+                li = self._volume_layout(base)
+                with tracing.span("ec.rebuild.local", volume=vid,
+                                  layout=li.layout):
                     rebuilt = ec_encoder.rebuild_ec_files(
-                        base, codec=self.codec, stats=stats)
+                        base, codec=self.codec, stats=stats,
+                        layout=(li if li.piggyback else None))
                     from ..ec.decoder import read_ec_volume_superblock
                     t0 = _time.perf_counter()
                     rebuild_ecx_file(
@@ -325,11 +382,17 @@ class Store:
         ``repair`` picks the single-shard repair strategy: ``trace``
         gathers per-survivor projected symbols over
         ``/admin/ec/shard_repair_read`` (sub-k*slab network bytes, see
-        ops/codec.repair_plan), ``full`` is the k-survivor streaming
-        gather, ``auto`` (default) tries trace whenever exactly one
-        shard is lost and the scheme has gain, and falls back to full —
-        bit-identically — for multi-shard loss, no-gain geometries, or
-        holders that predate the repair route."""
+        ops/codec.repair_plan), ``piggyback`` gathers half-plane
+        sub-chunk streams over ``/admin/ec/shard_plane_read``
+        ((k+1)/2k of the baseline, piggyback-layout volumes only),
+        ``full`` is the full streaming decode, ``auto`` (default)
+        routes by the volume's layout — piggyback repair on coupled
+        layouts, trace on flat — and falls back to the layout's full
+        decode bit-identically for multi-shard loss, no-gain
+        geometries, uncoupled shards, or holders that predate the
+        repair routes. Forcing ``trace`` on a piggyback volume (or
+        ``piggyback`` on flat) is an error: the modes read parity bytes
+        the other layout does not have."""
         import time as _time
         from ..ec import gather
         from ..util import tracing
@@ -370,8 +433,21 @@ class Store:
                     f"cannot rebuild {vid}: only {sum(present)} of "
                     f"{total} shards reachable")
             mode = (repair or "auto").lower()
-            if mode not in ("auto", "trace", "full"):
+            if mode not in ("auto", "trace", "piggyback", "full"):
                 raise VolumeError(f"unknown repair mode {mode!r}")
+            # sidecars are local now (fetched above when remote): the
+            # volume's layout routes every path below
+            li = self._volume_layout(base)
+            if mode == "trace" and li.piggyback:
+                raise VolumeError(
+                    "-repair trace: volume has the piggyback layout "
+                    "(trace masks read flat parity bytes); use "
+                    "piggyback, auto or full")
+            if mode == "piggyback" and not li.piggyback:
+                raise VolumeError(
+                    "-repair piggyback: volume has the flat layout "
+                    "(no coupled parity planes); use trace, auto or "
+                    "full")
             # one wire probe per (vid, sid) for this whole rebuild, no
             # matter how many paths need a size below
             size_cache = gather.ShardSizeCache()
@@ -400,10 +476,58 @@ class Store:
 
             rebuilt = None
             if mode != "full":
-                rebuilt = self._rebuild_streaming_trace(
-                    vid, base, local, present, missing, sources, sized,
-                    stats, slab, window, hedge_ms, root, mode)
-            if rebuilt is None:
+                if li.piggyback:
+                    rebuilt = self._rebuild_streaming_piggyback(
+                        vid, base, local, present, missing, sources,
+                        sized, stats, slab, window, hedge_ms, root,
+                        mode, li)
+                else:
+                    rebuilt = self._rebuild_streaming_trace(
+                        vid, base, local, present, missing, sources,
+                        sized, stats, slab, window, hedge_ms, root,
+                        mode)
+            if rebuilt is None and li.piggyback:
+                # full coupled decode: readers follow the decode
+                # plan's src order (surviving data, then just enough
+                # parities), stripes clamp to sub-chunk windows
+                from ..ops import codec as ops_codec
+                src, _, _ = ops_codec.piggyback_decode_plan(
+                    k, self.codec.m if self.codec is not None
+                    else total - k,
+                    tuple(bool(p) for p in present),
+                    matrix_kind=(self.codec.matrix_kind
+                                 if self.codec is not None
+                                 else "vandermonde"),
+                    matrix=(self.codec.matrix
+                            if self.codec is not None else None),
+                    pairs=li.pairs)
+                gstats = gather.GatherStats()
+                readers = []
+                for i in src:
+                    if local[i]:
+                        readers.append(gather.LocalShardReader(
+                            base + to_ext(i), gstats))
+                    else:
+                        readers.append(gather.RemoteShardReader(
+                            vid, i, sources[i], gstats,
+                            hedge_ms=hedge_ms))
+                shard_size = sized(src)
+                eff_slab = slab or gather.auto_slab(
+                    shard_size, default=ec_encoder.DEFAULT_SLAB)
+                eff_slab = max(li.window,
+                               eff_slab - eff_slab % li.window)
+                source = gather.StripedGatherSource(
+                    readers, shard_size, slab=eff_slab,
+                    window=window, stats=gstats, parent_span=root)
+                rebuilt = \
+                    ec_encoder.rebuild_ec_files_streaming_piggyback(
+                        base, present, missing, source, li,
+                        codec=self.codec, slab=eff_slab, stats=stats)
+                from ..stats.metrics import observe_transport
+                observe_transport("pull", gstats, window=source.window)
+                if stats is not None:
+                    stats["repair_mode"] = "full"
+            elif rebuilt is None:
                 gather_present = self._health_survivor_mask(
                     present, local, sources, k, stats)
                 src = [i for i, p in enumerate(gather_present) if p][:k]
@@ -471,6 +595,102 @@ class Store:
         if stats is not None:
             stats["health_demoted_shards"] = demoted
         return masked
+
+    def _rebuild_streaming_piggyback(self, vid, base, local, present,
+                                     missing, sources, sized, stats,
+                                     slab, window, hedge_ms, root, mode,
+                                     li):
+        """Attempt the half-plane piggyback repair; returns the rebuilt
+        shard list or None to signal 'use the full coupled decode
+        instead'. Forced mode ('piggyback') converts every fallback
+        into an error; 'auto' records the reason in stats and lets the
+        caller fall through bit-identically."""
+        from ..ec import decoder as ec_decoder
+        from ..ec import gather
+        from ..ops import codec as ops_codec
+        from ..server.http_util import HttpError
+
+        def bail(reason: str):
+            if mode == "piggyback":
+                raise VolumeError(f"-repair piggyback: {reason}")
+            if stats is not None:
+                stats["repair_fallback"] = reason
+            return None
+
+        if len(missing) != 1:
+            return bail(
+                f"{len(missing)} shards lost, piggyback repairs one")
+        lost = missing[0]
+        k = self.codec.k if self.codec is not None else DATA_SHARDS
+        m = (self.codec.m if self.codec is not None
+             else TOTAL_SHARDS - DATA_SHARDS)
+        try:
+            pplan = ops_codec.piggyback_plan(
+                k, m,
+                matrix_kind=(self.codec.matrix_kind
+                             if self.codec is not None else "vandermonde"),
+                matrix=(self.codec.matrix
+                        if self.codec is not None else None),
+                pairs=li.pairs)
+        except ValueError as e:
+            return bail(f"no piggyback scheme: {e}")
+        if lost >= pplan.coupled:
+            return bail(f"shard {lost} not coupled "
+                        f"(coupled prefix is 0..{pplan.coupled - 1})")
+        par = [k + j for j in range(m) if present[k + j]]
+        if len(par) < 2:
+            return bail(f"{len(par)} surviving parities, plane repair "
+                        f"needs 2")
+        if any(not present[i] for i in range(k) if i != lost):
+            return bail("a data helper is unreachable")
+        try:
+            rplan = ops_codec.piggyback_repair_plan(
+                k, m, lost, parity_sids=tuple(par[:2]),
+                matrix_kind=pplan.matrix_kind,
+                matrix=(self.codec.matrix
+                        if self.codec is not None else None),
+                pairs=li.pairs)
+        except ValueError as e:
+            return bail(f"no repair plan: {e}")
+        shard_size = sized(rplan.helpers)
+        if shard_size % li.window:
+            return bail(
+                f"shard size {shard_size} not aligned to sidecar "
+                f"window {li.window}")
+        gstats = gather.GatherStats()
+        readers = []
+        for i in rplan.helpers:
+            if local[i]:
+                readers.append(gather.LocalPlaneReader(
+                    base + to_ext(i), li.alpha, li.window,
+                    rplan.plane_bit, rplan.plane_side, gstats))
+            else:
+                readers.append(gather.RemotePlaneReader(
+                    vid, i, sources[i], li.alpha, li.window,
+                    rplan.plane_bit, rplan.plane_side, gstats,
+                    hedge_ms=hedge_ms))
+        eff_slab = slab or gather.auto_slab(
+            shard_size, default=ec_encoder.DEFAULT_SLAB)
+        source = gather.PlaneGatherSource(
+            readers, shard_size, rplan, li.window, slab=eff_slab,
+            gather_window=window, stats=gstats, parent_span=root)
+        rstats: dict = {}
+        try:
+            rebuilt = ec_decoder.rebuild_ec_file_piggyback(
+                base, lost, source, rplan, li.window, codec=self.codec,
+                slab=source.slab, stats=rstats)
+        except HttpError as e:
+            if e.status in (404, 405, 501):
+                # a holder predates /admin/ec/shard_plane_read (or
+                # never had the shard): the repair output was already
+                # cleaned up, rerun as a full coupled decode
+                return bail(f"holder refused plane read ({e.status})")
+            raise
+        from ..stats.metrics import observe_transport
+        observe_transport("pull", gstats, window=source.window)
+        if stats is not None:
+            stats.update(rstats)
+        return rebuilt
 
     def _rebuild_streaming_trace(self, vid, base, local, present,
                                  missing, sources, sized, stats, slab,

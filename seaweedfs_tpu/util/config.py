@@ -119,8 +119,19 @@ _knob("SW_EC_SPREAD_WINDOW", "int", 4,
 _knob("SW_EC_SPREAD_MODE", "str", "stream",
       "ec.encode default transfer mode: stream or copy.")
 _knob("SW_EC_REPAIR_MODE", "str", "auto",
-      "Single-shard rebuild mode: auto (trace with fallback), trace, "
+      "Single-shard rebuild mode: auto (layout-routed: piggyback on "
+      "coupled layouts, else trace, with fallback), trace, piggyback, "
       "or full.")
+_knob("SW_EC_LAYOUT", "str", "flat",
+      "On-disk EC layout for NEW volumes: flat (plain RS) or piggyback "
+      "(coupled sub-chunk parities; single-data-shard repair downloads "
+      "(k+1)/2k of k*shard). Existing volumes keep their layout.")
+_knob("SW_EC_PLAN_CACHE_SIZE", "int", 128,
+      "LRU bound on each derived-plan cache (repair/piggyback plans); "
+      "read live, so operators can resize without a restart.")
+_knob("SW_EC_PIGGYBACK_PAIRS", "int", 5,
+      "Cap on coupled data-shard pairs (alpha = 2^pairs sub-chunks); "
+      "shards beyond the paired prefix repair via the flat paths.")
 _knob("SW_EC_DEGRADED_CACHE_BYTES", "int", 64 << 20,
       "Byte budget of the reconstructed-slab LRU; 0 disables caching.")
 _knob("SW_EC_DEGRADED_SLAB_BYTES", "int", 128 << 10,

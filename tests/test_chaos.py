@@ -44,7 +44,13 @@ def _spawn_cluster(tmp, n_vols=3, replication="001"):
                             max_volume_counts=[20],
                             ec_backend="numpy").start()
                for i in range(n_vols)]
-    time.sleep(2.0)
+    # converge on heartbeat registration instead of sleeping across a
+    # pulse boundary (conftest knob policy: poll, don't sleep)
+    from conftest import wait_until
+    from seaweedfs_tpu.server.http_util import get_json
+    assert wait_until(
+        lambda: len(get_json(f"http://{master.url}/cluster/status")
+                    .get("nodes", [])) >= n_vols, timeout=15)
     filer = FilerServer(port=0, master_url=master.url,
                         chunk_size=64 << 10,
                         replication=replication).start()
@@ -212,7 +218,17 @@ def test_chaos_ec_degraded_reads_through_holder_death():
         payloads[fid] = data
     env = CommandEnv(master.url, out=io.StringIO())
     run_command(env, f"ec.encode -volumeId {vid}")
-    time.sleep(2.0)
+    # all 14 shards registered at the master before readers start —
+    # poll the lookup instead of sleeping across the pulse
+    from conftest import wait_until
+    from seaweedfs_tpu.ec import TOTAL_SHARDS
+    from seaweedfs_tpu.server.http_util import get_json
+
+    def _all_shards():
+        out = get_json(f"http://{master.url}/cluster/ec_lookup"
+                       f"?volumeId={vid}")
+        return len(out.get("shards", {})) == TOTAL_SHARDS
+    assert wait_until(_all_shards, timeout=15)
 
     errors, stop = [], threading.Event()
 
